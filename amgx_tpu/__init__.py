@@ -1,0 +1,70 @@
+"""amgx_tpu — a TPU-native algebraic multigrid + Krylov sparse solver
+framework with the capabilities of NVIDIA AmgX (reference:
+``/root/reference``), built on JAX/XLA/Pallas.
+
+Architecture (see SURVEY.md for the reference layer map this mirrors):
+
+* irregular *setup* (coarsening, coloring, SpGEMM structure) runs on host
+  over scipy CSR, producing frozen, statically-shaped device packs;
+* the regular *solve* phase is a single jitted XLA computation —
+  ``lax.while_loop`` over a state pytree, with preconditioner/smoother
+  stacks composed at trace time;
+* distribution is row-wise domain decomposition over a
+  ``jax.sharding.Mesh`` with ``ppermute``/``psum`` collectives replacing the
+  reference's MPI halo exchange.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# fp64 host modes (hDDI) and convergence-parity testing need x64 enabled.
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"
+#: reference parity target (ReleaseVersion.txt:1)
+__reference_version__ = "2.1.0.131-opensource"
+
+from . import errors
+from .errors import RC, SolveStatus, AMGXError
+from .modes import Mode, parse_mode, PUBLIC_MODES
+from .config import AMGConfig
+from .core import Matrix, DeviceMatrix
+from .ops import blas, spmv, spmm
+from .solvers import Solver, SolverFactory, SolveResult
+from . import io
+from .utils import register_print_callback, amgx_output
+
+_initialized = False
+
+
+def initialize():
+    """Library init (reference ``AMGX_initialize``, core.cu:739)."""
+    global _initialized
+    _initialized = True
+    return RC.OK
+
+
+def finalize():
+    global _initialized
+    _initialized = False
+    return RC.OK
+
+
+def get_api_version():
+    return (2, 0)
+
+
+def create_solver(config, mode: str = "dDDI") -> Solver:
+    """Convenience: build the outer solver described by a config
+    (JSON dict/string/path or AMGConfig)."""
+    cfg = config if isinstance(config, AMGConfig) else AMGConfig(config)
+    return SolverFactory.allocate(cfg, "default", "solver")
+
+
+__all__ = [
+    "initialize", "finalize", "get_api_version", "create_solver",
+    "AMGConfig", "Matrix", "DeviceMatrix", "Solver", "SolverFactory",
+    "SolveResult", "Mode", "parse_mode", "PUBLIC_MODES", "RC", "SolveStatus",
+    "AMGXError", "blas", "spmv", "spmm", "io", "register_print_callback",
+    "amgx_output",
+]

@@ -1,0 +1,3 @@
+from .matrix import Matrix, DeviceMatrix, pack_device
+
+__all__ = ["Matrix", "DeviceMatrix", "pack_device"]

@@ -1,0 +1,73 @@
+"""Error codes and exception model.
+
+TPU-native re-implementation of the reference error model
+(``base/include/error.h``, ``base/include/amgx_c.h:74-92``): exceptions raised
+internally are caught at the API boundary and translated into ``AMGX_RC``
+return codes.
+"""
+from __future__ import annotations
+
+import enum
+
+
+class RC(enum.IntEnum):
+    """Return codes — numeric values match ``amgx_c.h:74-92`` (AMGX_RC)."""
+
+    OK = 0
+    BAD_PARAMETERS = 1
+    UNKNOWN = 2
+    NOT_SUPPORTED_TARGET = 3
+    NOT_SUPPORTED_BLOCKSIZE = 4
+    CUDA_FAILURE = 5          # kept for ABI parity; maps to device failure
+    THRUST_FAILURE = 6        # kept for ABI parity
+    NO_MEMORY = 7
+    IO_ERROR = 8
+    BAD_MODE = 9
+    CORE = 10
+    PLUGIN = 11
+    BAD_CONFIGURATION = 12
+    NOT_IMPLEMENTED = 13
+    LICENSE_NOT_FOUND = 14
+    INTERNAL = 15
+
+
+class SolveStatus(enum.IntEnum):
+    """Solve status — values match ``amgx_c.h`` AMGX_SOLVE_STATUS."""
+
+    SUCCESS = 0
+    FAILED = 1
+    DIVERGED = 2
+    NOT_CONVERGED = 2  # alias, as in the reference header
+
+
+class AMGXError(Exception):
+    """Internal exception carrying an RC code (reference: ``FatalError``)."""
+
+    def __init__(self, message: str, rc: RC = RC.UNKNOWN):
+        super().__init__(message)
+        self.rc = RC(rc)
+
+
+class BadParametersError(AMGXError):
+    def __init__(self, message: str):
+        super().__init__(message, RC.BAD_PARAMETERS)
+
+
+class BadConfigurationError(AMGXError):
+    def __init__(self, message: str):
+        super().__init__(message, RC.BAD_CONFIGURATION)
+
+
+class IOError_(AMGXError):
+    def __init__(self, message: str):
+        super().__init__(message, RC.IO_ERROR)
+
+
+class NotImplementedError_(AMGXError):
+    def __init__(self, message: str):
+        super().__init__(message, RC.NOT_IMPLEMENTED)
+
+
+class BadModeError(AMGXError):
+    def __init__(self, message: str):
+        super().__init__(message, RC.BAD_MODE)

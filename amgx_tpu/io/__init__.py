@@ -1,0 +1,7 @@
+from .matrix_market import read_matrix_market, write_matrix_market, SystemData
+from .poisson import (poisson5pt, poisson7pt, poisson9pt, poisson27pt,
+                      generate_distributed_poisson_7pt)
+
+__all__ = ["read_matrix_market", "write_matrix_market", "SystemData",
+           "poisson5pt", "poisson7pt", "poisson9pt", "poisson27pt",
+           "generate_distributed_poisson_7pt"]

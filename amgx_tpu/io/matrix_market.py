@@ -1,0 +1,177 @@
+"""MatrixMarket reader/writer with the reference's AMGX extensions.
+
+Reference: ``base/src/matrix_io.cu`` (reader/writer registry) and
+``core/src/readers.cu:666-1500`` (``ReadMatrixMarket``).  Supported beyond
+standard MatrixMarket:
+
+* a second header line ``%%AMGX <tokens>`` (also accepts ``%%NVAMG``) with
+  tokens: ``rhs`` / ``solution`` (vectors appended after the entries),
+  ``diagonal`` (external diagonal block stored after the entries),
+  ``sorted``, ``base0``, and one or two integers giving the block size
+  (``readers.cu:795-835``).
+* ``symmetric`` / ``skew-symmetric`` / ``hermitian`` qualifiers (mirrored on
+  read).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..errors import IOError_
+
+
+@dataclasses.dataclass
+class SystemData:
+    """A linear system read from disk: A, and optionally b and x0."""
+
+    A: sp.spmatrix
+    rhs: Optional[np.ndarray]
+    solution: Optional[np.ndarray]
+    block_dimx: int = 1
+    block_dimy: int = 1
+
+    @property
+    def block_dim(self):
+        return self.block_dimx
+
+
+def _tokens(line: str):
+    return line.strip().lower().split()
+
+
+def read_matrix_market(path: str) -> SystemData:
+    with open(path) as f:
+        header = f.readline()
+        if not header.startswith("%%MatrixMarket"):
+            raise IOError_(f"{path}: missing %%MatrixMarket header")
+        htok = _tokens(header[2:])
+        is_complex = "complex" in htok
+        symmetric = "symmetric" in htok or "skew-symmetric" in htok
+        skew = "skew-symmetric" in htok
+        hermitian = "hermitian" in htok
+        if "pattern" in htok:
+            raise IOError_("'pattern' matrices are not supported")
+
+        block_dimx = block_dimy = 1
+        index_base = 1
+        has_rhs = has_soln = has_diag = False
+        # comment lines; %%AMGX/%%NVAMG extension line
+        pos = f.tell()
+        line = f.readline()
+        while line.startswith("%"):
+            if line.startswith("%%AMGX") or line.startswith("%%NVAMG"):
+                sizes = []
+                for t in _tokens(line[2:])[1:]:
+                    if t == "rhs":
+                        has_rhs = True
+                    elif t == "solution":
+                        has_soln = True
+                    elif t == "diagonal":
+                        has_diag = True
+                    elif t == "base0":
+                        index_base = 0
+                    elif t and t[0].isdigit():
+                        sizes.append(int(t))
+                if len(sizes) == 1:
+                    block_dimx = block_dimy = sizes[0]
+                elif len(sizes) >= 2:
+                    block_dimx, block_dimy = sizes[0], sizes[1]
+            pos = f.tell()
+            line = f.readline()
+        first_data_line = line
+
+        parts = first_data_line.split()
+        if len(parts) != 3:
+            raise IOError_(f"{path}: expected 'rows cols nnz' line")
+        rows, cols, entries = (int(p) for p in parts)
+
+        rest = f.read().split()
+
+    vals_per_entry = 4 if is_complex else 3
+    need = entries * vals_per_entry
+    if len(rest) < need:
+        raise IOError_(f"{path}: truncated entry data "
+                       f"({len(rest)} tokens < {need})")
+    entry_tok = np.asarray(rest[:need])
+    rest = rest[need:]
+    ijv = entry_tok.reshape(entries, vals_per_entry)
+    i = ijv[:, 0].astype(np.int64) - index_base
+    j = ijv[:, 1].astype(np.int64) - index_base
+    if is_complex:
+        v = ijv[:, 2].astype(np.float64) + 1j * ijv[:, 3].astype(np.float64)
+    else:
+        v = ijv[:, 2].astype(np.float64)
+
+    if symmetric or hermitian:
+        off = i != j
+        i2, j2 = j[off], i[off]
+        v2 = v[off]
+        if skew:
+            v2 = -v2
+        elif hermitian:
+            v2 = np.conj(v2)
+        i = np.concatenate([i, i2])
+        j = np.concatenate([j, j2])
+        v = np.concatenate([v, v2])
+
+    A = sp.csr_matrix((v, (i, j)), shape=(rows, cols))
+    A.sum_duplicates()
+    A.sort_indices()
+
+    if has_diag:
+        # external diagonal: rows scalar values appended (readers.cu diag path)
+        nvals = rows
+        dvals = np.asarray(rest[:nvals], dtype=np.float64)
+        rest = rest[nvals:]
+        A = A + sp.diags(dvals, shape=(rows, cols))
+        A = sp.csr_matrix(A)
+
+    rhs = soln = None
+    if has_rhs:
+        if len(rest) < rows:
+            raise IOError_(f"{path}: truncated RHS")
+        rhs = np.asarray(rest[:rows], dtype=np.float64)
+        rest = rest[rows:]
+    if has_soln:
+        if len(rest) < rows:
+            raise IOError_(f"{path}: truncated solution")
+        soln = np.asarray(rest[:rows], dtype=np.float64)
+
+    return SystemData(A=A, rhs=rhs, solution=soln,
+                      block_dimx=block_dimx, block_dimy=block_dimy)
+
+
+def write_matrix_market(path: str, A: sp.spmatrix,
+                        rhs: Optional[np.ndarray] = None,
+                        solution: Optional[np.ndarray] = None,
+                        block_dim: int = 1):
+    """Write a system in the reference's extended MatrixMarket format
+    (``MatrixIO::writeSystemMatrixMarket``, base/src/matrix_io.cu)."""
+    A = sp.coo_matrix(A)
+    is_complex = np.iscomplexobj(A.data)
+    field = "complex" if is_complex else "real"
+    with open(path, "w") as f:
+        f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
+        ext = []
+        if block_dim != 1:
+            ext.append(str(block_dim))
+        if rhs is not None:
+            ext.append("rhs")
+        if solution is not None:
+            ext.append("solution")
+        if ext:
+            f.write("%%AMGX " + " ".join(ext) + "\n")
+        f.write(f"{A.shape[0]} {A.shape[1]} {A.nnz}\n")
+        if is_complex:
+            for i, j, v in zip(A.row, A.col, A.data):
+                f.write(f"{i+1} {j+1} {v.real:.17g} {v.imag:.17g}\n")
+        else:
+            for i, j, v in zip(A.row, A.col, A.data):
+                f.write(f"{i+1} {j+1} {v:.17g}\n")
+        for vec in (rhs, solution):
+            if vec is not None:
+                for v in np.asarray(vec).ravel():
+                    f.write(f"{v:.17g}\n")

@@ -1,0 +1,110 @@
+"""Poisson problem generators.
+
+Equivalent of the bundled CUSP gallery generators used throughout the
+reference tests (``cusp::gallery::poisson5pt/7pt/9pt/27pt``,
+``base/include/cusp/gallery/poisson.h``) and the distributed generator
+``AMGX_generate_distributed_poisson_7pt`` (``amgx_c.h:515-526``,
+``examples/generate_poisson7_dist_renum.cu``).
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def _laplace_1d(n: int) -> sp.csr_matrix:
+    return sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n), format="csr")
+
+
+def _eye(n):
+    return sp.identity(n, format="csr")
+
+
+def poisson5pt(nx: int, ny: int) -> sp.csr_matrix:
+    """2D 5-point Laplacian on an nx×ny grid."""
+    return (sp.kron(_eye(ny), _laplace_1d(nx)) +
+            sp.kron(_laplace_1d(ny), _eye(nx))).tocsr()
+
+
+def poisson7pt(nx: int, ny: int, nz: int) -> sp.csr_matrix:
+    """3D 7-point Laplacian on an nx×ny×nz grid — the reference's headline
+    benchmark operator (BASELINE.md configs 2-3)."""
+    Ax, Ay, Az = _laplace_1d(nx), _laplace_1d(ny), _laplace_1d(nz)
+    Ix, Iy, Iz = _eye(nx), _eye(ny), _eye(nz)
+    return (sp.kron(Iz, sp.kron(Iy, Ax)) +
+            sp.kron(Iz, sp.kron(Ay, Ix)) +
+            sp.kron(Az, sp.kron(Iy, Ix))).tocsr()
+
+
+def poisson9pt(nx: int, ny: int) -> sp.csr_matrix:
+    """2D 9-point stencil (8 neighbours + center)."""
+    n = nx * ny
+    ii, jj, vv = [], [], []
+    idx = lambda x, y: y * nx + x
+    for y in range(ny):
+        for x in range(nx):
+            r = idx(x, y)
+            for dy in (-1, 0, 1):
+                for dx in (-1, 0, 1):
+                    X, Y = x + dx, y + dy
+                    if 0 <= X < nx and 0 <= Y < ny:
+                        ii.append(r)
+                        jj.append(idx(X, Y))
+                        vv.append(8.0 if (dx == 0 and dy == 0) else -1.0)
+    return sp.csr_matrix((vv, (ii, jj)), shape=(n, n))
+
+
+def poisson27pt(nx: int, ny: int, nz: int) -> sp.csr_matrix:
+    """3D 27-point stencil."""
+    n = nx * ny * nz
+    idx3 = lambda x, y, z: (z * ny + y) * nx + x
+    ii, jj, vv = [], [], []
+    X, Y, Z = np.meshgrid(np.arange(nx), np.arange(ny), np.arange(nz),
+                          indexing="ij")
+    X, Y, Z = X.ravel(), Y.ravel(), Z.ravel()
+    rows = idx3(X, Y, Z)
+    for dz in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                Xn, Yn, Zn = X + dx, Y + dy, Z + dz
+                ok = ((0 <= Xn) & (Xn < nx) & (0 <= Yn) & (Yn < ny) &
+                      (0 <= Zn) & (Zn < nz))
+                ii.append(rows[ok])
+                jj.append(idx3(Xn[ok], Yn[ok], Zn[ok]))
+                center = (dx == 0 and dy == 0 and dz == 0)
+                vv.append(np.full(ok.sum(), 26.0 if center else -1.0))
+    return sp.csr_matrix(
+        (np.concatenate(vv), (np.concatenate(ii), np.concatenate(jj))),
+        shape=(n, n))
+
+
+def generate_distributed_poisson_7pt(nx: int, ny: int, nz: int,
+                                     px: int = 1, py: int = 1, pz: int = 1):
+    """Generate the global 7-pt Poisson and a partition vector for a
+    px×py×pz processor grid over the (nx·px, ny·py, nz·pz) global grid.
+
+    Mirrors ``AMGX_generate_distributed_poisson_7pt``: each rank owns an
+    nx×ny×nz brick; rows are numbered rank-contiguously (the "renumbered"
+    layout of ``generate_poisson7_dist_renum.cu``).  Returns
+    (A_global_csr, partition_vector) with rows ordered rank-major.
+    """
+    gx, gy, gz = nx * px, ny * py, nz * pz
+    n = gx * gy * gz
+    # global lexicographic index → rank-contiguous permutation
+    X, Y, Z = np.meshgrid(np.arange(gx), np.arange(gy), np.arange(gz),
+                          indexing="ij")
+    X, Y, Z = X.ravel(), Y.ravel(), Z.ravel()
+    lex = (Z * gy + Y) * gx + X
+    rank = (Z // nz) * (px * py) + (Y // ny) * px + (X // nx)
+    # local index within the brick
+    lx, ly, lz = X % nx, Y % ny, Z % nz
+    local = (lz * ny + ly) * nx + lx
+    per_rank = nx * ny * nz
+    newids = rank * per_rank + local
+    perm = np.empty(n, dtype=np.int64)
+    perm[lex] = newids
+    A = poisson7pt(gx, gy, gz)
+    P = sp.csr_matrix((np.ones(n), (perm, np.arange(n))), shape=(n, n))
+    A_renum = (P @ A @ P.T).tocsr()
+    partition = np.repeat(np.arange(px * py * pz), per_rank)
+    return A_renum, partition

@@ -1,0 +1,94 @@
+"""Mode system: (memory space, vector precision, matrix precision, index precision).
+
+TPU-native equivalent of the reference's ``TemplateConfig`` / ``AMGX_Mode``
+machinery (``base/include/basic_types.h:76-125``,
+``base/include/amgx_config.h:102-147``).  The reference explicitly instantiates
+every algorithm for each of 10 modes via C++ templates; JAX is dtype-generic,
+so a mode here is a small runtime policy object that selects the backend
+("host" → CPU, "device" → TPU/default accelerator) and the dtypes used for
+vectors, matrix values and indices.
+
+Mode strings follow the reference naming: e.g. ``dDDI`` = device memory,
+double vectors, double matrix, int indices.  Complex modes (``dZZI`` …) map to
+``complex128``/``complex64``.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .errors import BadModeError
+
+_MEM = {"h": "host", "d": "device"}
+_PREC = {
+    "D": np.float64,
+    "F": np.float32,
+    "Z": np.complex128,
+    "C": np.complex64,
+    "I": np.int32,
+}
+
+#: The 12 public modes of the reference (amgx_config.h:125-147).
+PUBLIC_MODES = (
+    "hDDI", "hDFI", "hFFI",
+    "dDDI", "dDFI", "dFFI",
+    "hZZI", "hZCI", "hCCI",
+    "dZZI", "dZCI", "dCCI",
+)
+
+#: Numeric mode ids matching AMGX_Mode enum ordering (amgx_config.h:125-147).
+MODE_IDS = {name: i for i, name in enumerate(PUBLIC_MODES)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Mode:
+    """Runtime policy: where data lives and which dtypes are used."""
+
+    name: str
+    mem_space: str        # "host" | "device"
+    vec_dtype: np.dtype
+    mat_dtype: np.dtype
+    ind_dtype: np.dtype
+
+    @property
+    def is_complex(self) -> bool:
+        return np.issubdtype(self.vec_dtype, np.complexfloating)
+
+    @property
+    def is_device(self) -> bool:
+        return self.mem_space == "device"
+
+    def jax_platform(self) -> str:
+        """The JAX platform this mode runs on."""
+        if self.mem_space == "host":
+            return "cpu"
+        import jax
+
+        return jax.default_backend()
+
+
+def parse_mode(mode: "str | int | Mode") -> Mode:
+    """Parse a mode string like ``dDDI`` (or AMGX_Mode integer) into a Mode."""
+    if isinstance(mode, Mode):
+        return mode
+    if isinstance(mode, int):
+        if not 0 <= mode < len(PUBLIC_MODES):
+            raise BadModeError(f"unknown mode id {mode}")
+        mode = PUBLIC_MODES[mode]
+    if not (isinstance(mode, str) and len(mode) == 4):
+        raise BadModeError(f"bad mode {mode!r}")
+    mem, vp, mp, ip = mode[0], mode[1], mode[2], mode[3]
+    if mem not in _MEM or vp not in _PREC or mp not in _PREC or ip != "I":
+        raise BadModeError(f"unknown mode {mode!r}")
+    return Mode(
+        name=mode,
+        mem_space=_MEM[mem],
+        vec_dtype=np.dtype(_PREC[vp]),
+        mat_dtype=np.dtype(_PREC[mp]),
+        ind_dtype=np.dtype(np.int32),
+    )
+
+
+def default_mode() -> Mode:
+    return parse_mode("dDDI")

@@ -1,0 +1,4 @@
+from . import blas
+from .spmv import spmv, spmm, residual
+
+__all__ = ["blas", "spmv", "spmm", "residual"]

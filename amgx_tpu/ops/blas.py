@@ -1,0 +1,83 @@
+"""BLAS-1 style vector operations and norms.
+
+Reference: ``base/include/blas.h:40-104`` (axpy family, dotc, nrm1/nrm2,
+fill) and ``base/src/norm.cu`` (L1/L2/LMAX block norms).  In JAX these are
+one-liners that XLA fuses into surrounding computations; they exist as named
+functions so solver code reads like the reference and so the distributed
+layer can swap in psum-reduced variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NORM_L1 = "L1"
+NORM_L2 = "L2"
+NORM_LMAX = "LMAX"
+NORM_L1_SCALED = "L1_SCALED"
+
+
+def axpy(y, x, alpha):
+    """y ← y + alpha·x"""
+    return y + alpha * x
+
+
+def axpby(x, y, alpha, beta):
+    """alpha·x + beta·y"""
+    return alpha * x + beta * y
+
+
+def axmb(a_x, b):
+    """r = b − A·x given A·x (reference axmb computes b−Ax)."""
+    return b - a_x
+
+
+def dot(x, y):
+    """Conjugated dot product (reference ``dotc``)."""
+    if jnp.iscomplexobj(x):
+        return jnp.vdot(x, y)
+    return jnp.dot(x, y)
+
+
+def nrm2(x):
+    return jnp.sqrt(jnp.real(dot(x, x)))
+
+
+def nrm1(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def nrmmax(x):
+    return jnp.max(jnp.abs(x))
+
+
+def fill(x, value):
+    return jnp.full_like(x, value)
+
+
+def norm(v: jax.Array, norm_type: str = NORM_L2, block_dim: int = 1,
+         use_scalar_norm: bool = True) -> jax.Array:
+    """Compute a convergence norm.
+
+    With ``use_scalar_norm`` (or block_dim 1) returns a scalar; otherwise a
+    per-block-component norm vector of shape (block_dim,) as the reference's
+    block norms do (``norm.cu``; ``use_scalar_norm`` param core.cu:542).
+    """
+    if use_scalar_norm or block_dim == 1:
+        if norm_type == NORM_L1 or norm_type == NORM_L1_SCALED:
+            r = nrm1(v)
+            if norm_type == NORM_L1_SCALED:
+                r = r / v.shape[0]
+            return r
+        if norm_type == NORM_LMAX:
+            return nrmmax(v)
+        return nrm2(v)
+    vb = v.reshape(-1, block_dim)
+    if norm_type == NORM_L1 or norm_type == NORM_L1_SCALED:
+        r = jnp.sum(jnp.abs(vb), axis=0)
+        if norm_type == NORM_L1_SCALED:
+            r = r / vb.shape[0]
+        return r
+    if norm_type == NORM_LMAX:
+        return jnp.max(jnp.abs(vb), axis=0)
+    return jnp.sqrt(jnp.sum(jnp.abs(vb) ** 2, axis=0))

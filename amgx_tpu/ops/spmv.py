@@ -1,0 +1,50 @@
+"""Sparse matrix–vector products.
+
+TPU-native equivalent of the reference SpMV dispatch
+(``base/src/multiply.cu:75-196``): blocked SpMV for 1×1 and b×b blocks.
+Instead of warp-specialised CUDA kernels, the ELL pack turns SpMV into a
+dense gather + contraction that XLA vectorises onto the VPU (and the MXU for
+block matrices); the CSR pack falls back to a segment-sum.
+
+The distributed interior/boundary latency-hiding split of the reference lives
+in :mod:`amgx_tpu.distributed.spmv`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.matrix import DeviceMatrix
+
+
+def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
+    """y = A @ x.  ``x`` is a flat (n_cols * block_dim,) vector."""
+    b = A.block_dim
+    if A.fmt == "ell":
+        if b == 1:
+            # cols: (n, K); vals: (n, K); x: (m,)
+            return jnp.sum(A.vals * x[A.cols], axis=1)
+        xb = x.reshape(A.n_cols, b)
+        xg = xb[A.cols]                      # (n, K, b)
+        y = jnp.einsum("nkab,nkb->na", A.vals, xg,
+                       preferred_element_type=A.vals.dtype)
+        return y.reshape(-1)
+    # CSR segment-sum path
+    if b == 1:
+        prod = A.vals * x[A.cols]
+        return jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
+    xb = x.reshape(A.n_cols, b)
+    prod = jnp.einsum("eab,eb->ea", A.vals, xb[A.cols],
+                      preferred_element_type=A.vals.dtype)
+    y = jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
+    return y.reshape(-1)
+
+
+def spmm(A: DeviceMatrix, X: jax.Array) -> jax.Array:
+    """Y = A @ X for a block of vectors X (n, m) — used by eigensolvers."""
+    return jax.vmap(lambda v: spmv(A, v), in_axes=1, out_axes=1)(X)
+
+
+def residual(A: DeviceMatrix, b: jax.Array, x: jax.Array) -> jax.Array:
+    """r = b − A·x (reference ``axmb``, fixed_cycle.cu:151)."""
+    return b - spmv(A, x)

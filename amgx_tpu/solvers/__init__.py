@@ -1,0 +1,11 @@
+"""Solver registry — importing this package registers all solvers
+(reference: ``registerClasses`` in ``core/src/core.cu:612-641``)."""
+from .base import (Solver, SolverFactory, SolveResult, register_solver,
+                   check_convergence)
+from . import jacobi      # BLOCK_JACOBI, JACOBI_L1, CF_JACOBI
+from . import dense_lu    # DENSE_LU_SOLVER, NOSOLVER
+from . import krylov      # CG, PCG, PCGF, BICGSTAB, PBICGSTAB, GMRES, FGMRES
+from . import chebyshev   # CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL, KPZ_POLYNOMIAL
+
+__all__ = ["Solver", "SolverFactory", "SolveResult", "register_solver",
+           "check_convergence"]

@@ -1,0 +1,343 @@
+"""Solver framework.
+
+TPU-native re-design of the reference solver contract
+(``base/include/solvers/solver.h:44-325``, ``base/src/solvers/solver.cu``):
+
+* :class:`Solver` — base class owning A, convergence criterion, norms and the
+  generic ``setup()`` / ``solve()`` drivers (reference ``solver.cu:380-970``).
+* :class:`SolverFactory` — named registry; nested solvers are allocated from
+  a config scope (reference ``solver.h:287-325`` + ``core.cu:612-641``).
+* Convergence criteria: ABSOLUTE / RELATIVE_INI(_CORE) / RELATIVE_MAX(_CORE) /
+  COMBINED_REL_INI_ABS (``core/src/convergence/``).
+
+Execution model (the TPU-first redesign): ``setup()`` runs on host (irregular
+graph work → frozen device arrays); the whole ``solve()`` loop is traced once
+and executed as a single XLA computation via ``lax.while_loop`` over a state
+pytree.  Preconditioner/smoother application is traced inline into the outer
+iteration (the reference achieves composition via virtual calls at run time;
+here composition happens at trace time, letting XLA fuse across the stack).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AMGConfig
+from ..core.matrix import DeviceMatrix, Matrix
+from ..errors import BadConfigurationError, SolveStatus
+from ..ops import blas
+from ..ops.spmv import spmv
+from ..utils.logging import amgx_output
+
+
+# --------------------------------------------------------------------------
+# Convergence criteria (core/src/convergence/)
+# --------------------------------------------------------------------------
+def check_convergence(criterion: str, nrm, nrm_ini, nrm_max, tolerance,
+                      alt_rel_tolerance):
+    """Return a boolean scalar: has the solve converged?
+
+    All comparisons are per block-component and must hold for every
+    component (reference block norms).
+    """
+    if criterion in ("ABSOLUTE",):
+        ok = nrm <= tolerance
+    elif criterion in ("RELATIVE_INI", "RELATIVE_INI_CORE"):
+        ok = nrm <= tolerance * nrm_ini
+    elif criterion in ("RELATIVE_MAX", "RELATIVE_MAX_CORE"):
+        ok = nrm <= tolerance * nrm_max
+    elif criterion == "COMBINED_REL_INI_ABS":
+        ok = (nrm <= tolerance) | (nrm <= alt_rel_tolerance * nrm_ini)
+    else:
+        raise BadConfigurationError(f"unknown convergence {criterion!r}")
+    return jnp.all(ok)
+
+
+@dataclasses.dataclass
+class SolveResult:
+    x: jax.Array
+    iterations: int
+    status: SolveStatus
+    residual_norm: Optional[np.ndarray]
+    residual_history: Optional[np.ndarray]
+    setup_time: float = 0.0
+    solve_time: float = 0.0
+
+
+# --------------------------------------------------------------------------
+# Factory registry (reference SolverFactory, solver.h:287-325)
+# --------------------------------------------------------------------------
+_solver_registry: Dict[str, Type["Solver"]] = {}
+
+
+def register_solver(name: str):
+    def deco(cls):
+        _solver_registry[name] = cls
+        cls.config_name = name
+        return cls
+    return deco
+
+
+class SolverFactory:
+    @staticmethod
+    def allocate(cfg: AMGConfig, scope: str, param_name: str) -> "Solver":
+        """Allocate the solver named by config param ``param_name`` in
+        ``scope``; the solver reads its own params from its new scope.
+
+        Reference: ``SolverFactory::allocate(cfg, current_scope,
+        solver_name)`` pattern used e.g. at ``fgmres_solver.cu:243-253``.
+        """
+        value, new_scope = cfg.get_scoped(param_name, scope)
+        return SolverFactory.create(str(value), cfg, new_scope)
+
+    @staticmethod
+    def create(name: str, cfg: Optional[AMGConfig] = None,
+               scope: str = "default") -> "Solver":
+        if name not in _solver_registry:
+            raise BadConfigurationError(f"unknown solver {name!r}; known: "
+                                        f"{sorted(_solver_registry)}")
+        return _solver_registry[name](cfg or AMGConfig(), scope)
+
+    @staticmethod
+    def registered() -> Dict[str, Type["Solver"]]:
+        return dict(_solver_registry)
+
+
+# --------------------------------------------------------------------------
+# Solver base
+# --------------------------------------------------------------------------
+class Solver:
+    """Base solver: common parameter handling + generic solve driver.
+
+    Subclasses implement host-side :meth:`solver_setup` and the traced
+    :meth:`solve_init` / :meth:`solve_iteration`.
+    """
+
+    config_name = "?"
+    #: True for relaxation methods whose one iteration is one sweep
+    is_smoother = False
+
+    def __init__(self, cfg: AMGConfig, scope: str = "default"):
+        self.cfg = cfg
+        self.scope = scope
+        g = lambda name: cfg.get(name, scope)
+        self.max_iters = int(g("max_iters"))
+        self.tolerance = float(g("tolerance"))
+        self.alt_rel_tolerance = float(g("alt_rel_tolerance"))
+        self.convergence = str(g("convergence"))
+        self.norm_type = str(g("norm"))
+        self.monitor_residual = bool(g("monitor_residual"))
+        self.use_scalar_norm = bool(g("use_scalar_norm"))
+        self.store_res_history = bool(g("store_res_history"))
+        self.print_solve_stats = bool(g("print_solve_stats"))
+        self.obtain_timings = bool(g("obtain_timings"))
+        self.relaxation_factor = float(g("relaxation_factor"))
+        self.A: Optional[Matrix] = None
+        self.Ad: Optional[DeviceMatrix] = None
+        self._solve_fn = None
+        self.setup_time = 0.0
+
+    # ------------------------------------------------------------ lifecycle
+    def setup(self, A: "Matrix | DeviceMatrix"):
+        """Host-side setup (reference ``Solver::setup``, solver.cu:380-556)."""
+        t0 = time.perf_counter()
+        if isinstance(A, Matrix):
+            self.A = A
+            self.Ad = A.device()
+        else:
+            self.A = None
+            self.Ad = A
+        self.solver_setup()
+        self._solve_fn = None
+        self.setup_time = time.perf_counter() - t0
+        return self
+
+    def solver_setup(self):
+        """Override: build device-side data (diag inverse, hierarchy, ...)."""
+
+    # ------------------------------------------------------- traced protocol
+    def solve_init(self, b: jax.Array, x: jax.Array) -> Any:
+        """Return the solver-specific iteration state pytree."""
+        return ()
+
+    def solve_iteration(self, b: jax.Array, x: jax.Array, state: Any,
+                        iter_idx: jax.Array):
+        """One iteration: return (x_new, state_new).
+
+        ``iter_idx`` is the traced global iteration counter (used e.g. by
+        FGMRES for its restart-cycle position).
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------ preconditioner protocol
+    def apply(self, b: jax.Array, x0: Optional[jax.Array] = None,
+              n_iters: Optional[int] = None) -> jax.Array:
+        """Traced application as a preconditioner/smoother: run a fixed
+        number of iterations with no convergence monitoring (reference
+        ``Solver::smooth`` / preconditioner ``solve`` with small max_iters).
+
+        Must be called inside a trace; assumes :meth:`setup` has run.
+        """
+        n = self.max_iters if n_iters is None else n_iters
+        x = jnp.zeros_like(b) if x0 is None else x0
+        state = self.solve_init(b, x)
+        for i in range(n):
+            x, state = self.solve_iteration(b, x, state, jnp.asarray(i))
+        return x
+
+    def compute_residual_norm(self, b, x):
+        r = b - spmv(self.Ad, x)
+        return blas.norm(r, self.norm_type, self.Ad.block_dim,
+                         self.use_scalar_norm)
+
+    # ------------------------------------------------------------- solve API
+    def solve(self, b, x0=None, zero_initial_guess: bool = False
+              ) -> SolveResult:
+        """Full solve with convergence monitoring (solver.cu:589-970).
+
+        The entire loop runs as one jitted ``lax.while_loop``; the residual
+        history (when requested) is written into a fixed-size device buffer.
+        """
+        if self.Ad is None:
+            raise BadConfigurationError("solve() before setup()")
+        dtype = self.Ad.dtype
+        b = jnp.asarray(b, dtype=dtype)
+        if x0 is None or zero_initial_guess:
+            x0 = jnp.zeros_like(b)
+        else:
+            x0 = jnp.asarray(x0, dtype=dtype)
+
+        if self._solve_fn is None:
+            self._solve_fn = jax.jit(self._build_solve_fn())
+        t0 = time.perf_counter()
+        x, iters, nrm, nrm_ini, history = self._solve_fn(b, x0)
+        x.block_until_ready()
+        solve_time = time.perf_counter() - t0
+
+        iters = int(iters)
+        nrm = np.asarray(nrm)
+        nrm_ini_np = np.asarray(nrm_ini)
+        if self.monitor_residual:
+            conv = bool(np.all(self._host_converged(nrm, nrm_ini_np)))
+            diverged = bool(np.any(~np.isfinite(nrm)))
+            status = (SolveStatus.SUCCESS if conv else
+                      (SolveStatus.DIVERGED if diverged
+                       else SolveStatus.NOT_CONVERGED))
+        else:
+            status = SolveStatus.SUCCESS
+        history_np = None
+        if self.store_res_history or self.print_solve_stats:
+            history_np = np.asarray(history)[:iters + 1]
+        if self.print_solve_stats:
+            self._print_solve_stats(history_np, iters, status)
+        if self.obtain_timings:
+            amgx_output(f"Total Time: {self.setup_time + solve_time:10.6f}\n"
+                        f"    setup: {self.setup_time:10.6f} s\n"
+                        f"    solve: {solve_time:10.6f} s\n"
+                        f"    solve(per iteration): "
+                        f"{solve_time / max(iters, 1):10.6f} s\n")
+        return SolveResult(x=x, iterations=iters, status=status,
+                           residual_norm=nrm, residual_history=history_np,
+                           setup_time=self.setup_time, solve_time=solve_time)
+
+    def _host_converged(self, nrm, nrm_ini):
+        crit = self.convergence
+        tol = self.tolerance
+        if crit == "ABSOLUTE":
+            return nrm <= tol
+        if crit in ("RELATIVE_INI", "RELATIVE_INI_CORE"):
+            return nrm <= tol * nrm_ini
+        if crit in ("RELATIVE_MAX", "RELATIVE_MAX_CORE"):
+            return nrm <= tol * nrm_ini  # max ≥ ini; conservative host check
+        if crit == "COMBINED_REL_INI_ABS":
+            return (nrm <= tol) | (nrm <= self.alt_rel_tolerance * nrm_ini)
+        return nrm <= tol
+
+    def _print_solve_stats(self, history, iters, status):
+        if history is None:
+            return
+        lines = ["           iter      Mem Usage (GB)       residual      "
+                 "rate\n",
+                 "         --------------------------------------------------"
+                 "------------\n"]
+        prev = None
+        for i, h in enumerate(history):
+            hval = float(np.max(h))
+            rate = "" if prev in (None, 0.0) else f"{hval / prev:9.4f}"
+            label = "Ini" if i == 0 else f"{i - 1:4d}"
+            lines.append(f"        {label}              -         "
+                         f"{hval:15.6e}  {rate}\n")
+            prev = hval
+        lines.append("         ----------------------------------------------"
+                     "----------------\n")
+        lines.append(f"        Total Iterations: {iters}\n")
+        amgx_output("".join(lines))
+
+    # ------------------------------------------------------- the jitted loop
+    def _build_solve_fn(self) -> Callable:
+        monitor = self.monitor_residual
+        keep_history = self.store_res_history or self.print_solve_stats
+        max_iters = self.max_iters
+        crit = self.convergence
+        tol = self.tolerance
+        alt_tol = self.alt_rel_tolerance
+
+        def solve_fn(b, x0):
+            r0 = b - spmv(self.Ad, x0)
+            nrm_ini = blas.norm(r0, self.norm_type, self.Ad.block_dim,
+                                self.use_scalar_norm)
+            nrm_ini = jnp.atleast_1d(nrm_ini)
+            history = jnp.zeros((max_iters + 1,) + nrm_ini.shape,
+                                dtype=nrm_ini.dtype)
+            history = history.at[0].set(nrm_ini)
+            state0 = self.solve_init(b, x0)
+
+            def cond(carry):
+                x, state, it, nrm, nmax, done, hist = carry
+                return (~done) & (it < max_iters)
+
+            def body(carry):
+                x, state, it, nrm, nmax, done, hist = carry
+                x, state = self.solve_iteration(b, x, state, it)
+                if monitor:
+                    est = self.residual_norm_estimate(b, x, state)
+                    if est is None:
+                        est = self.compute_residual_norm(b, x)
+                    nrm = jnp.atleast_1d(est)
+                    nmax = jnp.maximum(nmax, nrm)
+                    done = check_convergence(crit, nrm, nrm_ini, nmax,
+                                             tol, alt_tol)
+                    done = done | ~jnp.all(jnp.isfinite(nrm))
+                if keep_history:
+                    hist = hist.at[it + 1].set(nrm)
+                return x, state, it + 1, nrm, nmax, done, hist
+
+            done0 = jnp.asarray(False)
+            if monitor:
+                done0 = check_convergence(crit, nrm_ini, nrm_ini, nrm_ini,
+                                          tol, alt_tol)
+            carry = (x0, state0, jnp.asarray(0, jnp.int32), nrm_ini, nrm_ini,
+                     done0, history)
+            x, state, it, nrm, nmax, done, history = jax.lax.while_loop(
+                cond, body, carry)
+            x = self.solve_finalize(b, x, state)
+            return x, it, nrm, nrm_ini, history
+
+        return solve_fn
+
+    def residual_norm_estimate(self, b, x, state):
+        """Solvers with an implicit residual estimate (FGMRES quasi-residual)
+        override this to avoid an extra SpMV per iteration."""
+        return None
+
+    def solve_finalize(self, b, x, state):
+        return x
+
+    # ------------------------------------------------------------- utilities
+    def grid_stats(self) -> str:
+        return ""

@@ -1,0 +1,188 @@
+"""Chebyshev iteration solver and polynomial smoothers.
+
+Reference: ``core/src/solvers/cheb_solver.cu`` (CHEBYSHEV with λ-estimation
+modes 0-3: eigensolver / max-abs-row-sum / user-supplied,
+``cheb_solver.cu:105-112``), ``chebyshev_poly.cu`` (CHEBYSHEV_POLY
+polynomial smoother), ``polynomial_solver.cu`` / ``kpz_polynomial_solver.cu``.
+
+Chebyshev smoothing is the TPU-first smoother of choice: unlike multicolor
+GS/ILU it is pure SpMV + axpy (no sequential per-color sweeps), so it maps
+onto the VPU with no irregular control flow.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import blas
+from ..ops.spmv import spmv
+from .base import Solver, register_solver
+from .jacobi import _apply_dinv, _invert_block_diag
+from .krylov import _PrecondMixin
+
+
+def _power_iteration_lambda_max(Ad, dinv, n_iters=20, seed=0):
+    """Estimate λmax of D⁻¹A by power iteration (device, fixed iterations)."""
+    n = Ad.n_rows * Ad.block_dim
+    x = jnp.asarray(np.random.default_rng(seed).standard_normal(n),
+                    dtype=Ad.dtype)
+
+    def body(i, carry):
+        x, lam = carry
+        y = _apply_dinv(dinv, spmv(Ad, x))
+        nrm = blas.nrm2(y)
+        lam = nrm / jnp.maximum(blas.nrm2(x), 1e-30)
+        return y / jnp.maximum(nrm, 1e-30), lam
+
+    _, lam = jax.lax.fori_loop(0, n_iters, body,
+                               (x, jnp.asarray(1.0, Ad.dtype)))
+    return lam
+
+
+@register_solver("CHEBYSHEV")
+class ChebyshevSolver(_PrecondMixin, Solver):
+    """Chebyshev iteration on the preconditioned operator M⁻¹A over
+    [λmin, λmax] (reference ``cheb_solver.cu``)."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.lambda_mode = int(cfg.get("chebyshev_lambda_estimate_mode",
+                                       scope))
+        self.user_max = float(cfg.get("cheby_max_lambda", scope))
+        self.user_min = float(cfg.get("cheby_min_lambda", scope))
+
+    def solver_setup(self):
+        self._setup_preconditioner(True)
+        dinv_ident = jnp.ones((self.Ad.n,), self.Ad.dtype)
+        if self.lambda_mode == 0:
+            # estimate λmax(M⁻¹A) by power iteration on the preconditioned op
+            n = self.Ad.n
+            x = jnp.asarray(
+                np.random.default_rng(0).standard_normal(n),
+                dtype=self.Ad.dtype)
+            lam = jnp.asarray(1.0, self.Ad.dtype)
+            for _ in range(15):
+                y = self._apply_M(spmv(self.Ad, x))
+                nrm = blas.nrm2(y)
+                lam = nrm / jnp.maximum(blas.nrm2(x), 1e-30)
+                x = y / jnp.maximum(nrm, 1e-30)
+            lmax = float(lam)
+            lmin = lmax * (self.user_min / max(self.user_max, 1e-30))
+        elif self.lambda_mode == 1:
+            # max abs row sum bound (Gershgorin)
+            if self.A is not None:
+                csr = self.A.scalar_csr()
+                lmax = float(np.abs(csr).sum(axis=1).max())
+            else:
+                lmax = float(jnp.max(jnp.sum(jnp.abs(self.Ad.vals),
+                                             axis=tuple(range(1, self.Ad.vals.ndim)))))
+            lmin = 0.125 * lmax
+        else:
+            lmax, lmin = self.user_max, self.user_min
+        self.lmax = lmax * 1.05  # safety margin, as usual for Chebyshev
+        self.lmin = lmin
+
+    def solve_init(self, b, x):
+        r = b - spmv(self.Ad, x)
+        d = jnp.zeros_like(b)
+        rho = jnp.asarray(0.0, b.dtype)
+        return (r, d, rho)
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        r, d, rho = state
+        theta = 0.5 * (self.lmax + self.lmin)
+        delta = max(0.5 * (self.lmax - self.lmin), 1e-30)
+        sigma = theta / delta
+        z = self._apply_M(r)
+
+        def first(_):
+            return z / theta, jnp.asarray(1.0 / sigma, b.dtype)
+
+        def later(_):
+            rho_new = 1.0 / (2.0 * sigma - rho)
+            d_new = rho_new * rho * d + (2.0 * rho_new / delta) * z
+            return d_new, rho_new.astype(b.dtype)
+
+        d_new, rho_new = jax.lax.cond(iter_idx == 0, first, later, None)
+        x = x + d_new
+        r = r - spmv(self.Ad, d_new)
+        return x, (r, d_new, rho_new)
+
+    def residual_norm_estimate(self, b, x, state):
+        r = state[0]
+        return blas.norm(r, self.norm_type, self.Ad.block_dim,
+                         self.use_scalar_norm)
+
+
+@register_solver("CHEBYSHEV_POLY")
+class ChebyshevPolySmoother(Solver):
+    """Chebyshev polynomial smoother on the Jacobi-preconditioned operator
+    D⁻¹A (reference ``chebyshev_poly.cu``): one 'iteration' applies a
+    degree-``chebyshev_polynomial_order`` Chebyshev polynomial."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.order = int(cfg.get("chebyshev_polynomial_order", scope))
+
+    def solver_setup(self):
+        self.dinv = _invert_block_diag(self.Ad.diag)
+        lmax = float(_power_iteration_lambda_max(self.Ad, self.dinv))
+        self.lmax = 1.05 * lmax
+        self.lmin = self.lmax / 30.0  # standard smoothing interval upper part
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        # classic three-term Chebyshev smoothing (Adams et al.)
+        theta = 0.5 * (self.lmax + self.lmin)
+        delta = 0.5 * (self.lmax - self.lmin)
+        sigma = theta / delta
+        rho = 1.0 / sigma
+        r = b - spmv(self.Ad, x)
+        d = _apply_dinv(self.dinv, r) / theta
+        x = x + d
+        for _ in range(self.order - 1):
+            rho_new = 1.0 / (2.0 * sigma - rho)
+            r = b - spmv(self.Ad, x)
+            d = rho_new * rho * d + 2.0 * rho_new / delta * _apply_dinv(
+                self.dinv, r)
+            x = x + d
+            rho = rho_new
+        return x, state
+
+
+@register_solver("POLYNOMIAL")
+class PolynomialSmoother(Solver):
+    """Neumann-series polynomial smoother (reference
+    ``polynomial_solver.cu``): x += Σ_k (I − D⁻¹A)^k D⁻¹ r."""
+
+    is_smoother = True
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.mu = int(cfg.get("kpz_mu", scope))
+
+    def solver_setup(self):
+        self.dinv = _invert_block_diag(self.Ad.diag)
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        r = b - spmv(self.Ad, x)
+        z = _apply_dinv(self.dinv, r)
+        acc = z
+        for _ in range(self.mu - 1):
+            z = z - _apply_dinv(self.dinv, spmv(self.Ad, z))
+            acc = acc + z
+        return x + acc, state
+
+
+@register_solver("KPZ_POLYNOMIAL")
+class KPZPolynomialSmoother(ChebyshevPolySmoother):
+    """KPZ polynomial smoother (reference ``kpz_polynomial_solver.cu``) —
+    implemented as a Chebyshev polynomial of order ``kpz_order`` on D⁻¹A."""
+
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.order = int(cfg.get("kpz_order", scope))

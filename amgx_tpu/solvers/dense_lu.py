@@ -1,0 +1,77 @@
+"""Dense LU coarse solver.
+
+Reference: ``core/src/solvers/dense_lu_solver.cu`` — densifies the (small)
+coarsest AMG level and LU-factorises it with cusolverDn.  Here the dense
+factorisation happens once at setup with ``jax.scipy.linalg.lu_factor`` and
+each application is a pair of triangular solves — small dense work the MXU
+handles well.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from .base import Solver, register_solver
+
+
+@register_solver("DENSE_LU_SOLVER")
+class DenseLUSolver(Solver):
+    is_smoother = False
+
+    def solver_setup(self):
+        if self.A is not None:
+            dense = np.asarray(self.A.host.todense(), dtype=self.Ad.dtype)
+        else:
+            dense = _densify_device(self.Ad)
+        self._lu, self._piv = jax.scipy.linalg.lu_factor(jnp.asarray(dense))
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        x = jax.scipy.linalg.lu_solve((self._lu, self._piv), b)
+        return x, state
+
+    def apply(self, b, x0=None, n_iters=None):
+        return jax.scipy.linalg.lu_solve((self._lu, self._piv), b)
+
+
+def _densify_device(Ad) -> np.ndarray:
+    """Densify a DeviceMatrix on host (coarse levels are tiny)."""
+    cols = np.asarray(Ad.cols)
+    vals = np.asarray(Ad.vals)
+    b = Ad.block_dim
+    n = Ad.n_rows * b
+    m = Ad.n_cols * b
+    out = np.zeros((n, m), dtype=vals.dtype)
+    if Ad.fmt == "ell":
+        for i in range(Ad.n_rows):
+            for k in range(cols.shape[1]):
+                j = cols[i, k]
+                v = vals[i, k]
+                if b == 1:
+                    out[i, j] += v
+                else:
+                    out[i * b:(i + 1) * b, j * b:(j + 1) * b] += v
+    else:
+        rows = np.asarray(Ad.row_ids)
+        for e in range(len(rows)):
+            i, j = rows[e], cols[e]
+            if b == 1:
+                out[i, j] += vals[e]
+            else:
+                out[i * b:(i + 1) * b, j * b:(j + 1) * b] += vals[e]
+    return out
+
+
+@register_solver("NOSOLVER")
+class DummySolver(Solver):
+    """Identity solver (reference ``base/src/solvers/dummy_solver.cu``):
+    as a preconditioner M = I, so the 'solve' returns the right-hand side."""
+
+    is_smoother = True
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        return b, state
+
+    def apply(self, b, x0=None, n_iters=None):
+        return b

@@ -1,0 +1,5 @@
+from .logging import (amgx_output, error_output, amgx_distributed_output,
+                      register_print_callback, set_verbosity)
+
+__all__ = ["amgx_output", "error_output", "amgx_distributed_output",
+           "register_print_callback", "set_verbosity"]

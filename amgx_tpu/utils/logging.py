@@ -1,0 +1,46 @@
+"""Print-callback logging.
+
+The reference routes all library output through a host-app-registered
+callback (``AMGX_register_print_callback``, ``amgx_c.h:212``;
+``amgx_output`` / ``error_output`` / ``amgx_distributed_output``,
+``base/include/misc.h:33-36``).  Same indirection here.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+_print_callback: Optional[Callable[[str], None]] = None
+_verbosity = 3
+
+
+def register_print_callback(fn: Optional[Callable[[str], None]]):
+    global _print_callback
+    _print_callback = fn
+
+
+def set_verbosity(level: int):
+    global _verbosity
+    _verbosity = int(level)
+
+
+def amgx_output(msg: str):
+    if _verbosity <= 0:
+        return
+    if _print_callback is not None:
+        _print_callback(msg)
+    else:
+        sys.stdout.write(msg)
+
+
+def error_output(msg: str):
+    if _print_callback is not None:
+        _print_callback(msg)
+    else:
+        sys.stderr.write(msg)
+
+
+def amgx_distributed_output(msg: str, rank: int = 0):
+    """Only rank 0 prints (reference amgx_distributed_output)."""
+    if rank == 0:
+        amgx_output(msg)
