@@ -1,0 +1,6 @@
+from .hierarchy import AMGHierarchy
+from .cycles import build_cycle
+from .level import AMGLevel, AggregationLevel, ClassicalLevel
+
+__all__ = ["AMGHierarchy", "build_cycle", "AMGLevel", "AggregationLevel",
+           "ClassicalLevel"]

@@ -1,0 +1,299 @@
+"""Aggregation selectors: parallel-matching aggregation.
+
+Reference: ``core/src/aggregation/selectors/`` — SIZE_2/SIZE_4/SIZE_8
+(handshaking parallel matching over edge weights,
+``size2_selector.cu``; params ``max_matching_iterations``,
+``max_unassigned_percentage``, ``merge_singletons``, ``weight_formula``,
+core.cu:486-502), MULTI_PAIRWISE (Notay-style repeated pairwise passes),
+PARALLEL_GREEDY, DUMMY (fixed-size blocks).
+
+Host-side numpy implementation: aggregation is the irregular setup phase;
+the resulting ``aggregates`` array is the only thing the device ever sees
+(restriction/prolongation are segment-sum/gather on it, mirroring
+``aggregation_amg_level.cu:115-196``).
+"""
+from __future__ import annotations
+
+from typing import Dict, Type
+
+import numpy as np
+import scipy.sparse as sp
+
+from ...errors import BadConfigurationError
+
+_selector_registry: Dict[str, type] = {}
+
+
+def register_selector(name):
+    def deco(cls):
+        _selector_registry[name] = cls
+        cls.config_name = name
+        return cls
+    return deco
+
+
+def create_selector(name, cfg, scope):
+    if name not in _selector_registry:
+        raise BadConfigurationError(
+            f"unknown aggregation selector {name!r}; known: "
+            f"{sorted(_selector_registry)}")
+    return _selector_registry[name](cfg, scope)
+
+
+# --------------------------------------------------------------------------
+def edge_weights(A: sp.csr_matrix, formula: int = 0,
+                 deterministic: bool = True) -> sp.csr_matrix:
+    """Symmetric edge-weight matrix for matching.
+
+    formula 0: w_ij = 0.5(|a_ij|+|a_ji|)/max(|a_ii|,|a_jj|)
+    formula 1: w_ij = −0.5(a_ij/a_ii + a_ji/a_jj)
+    (reference ``weight_formula`` param, core.cu:491)
+    """
+    A = sp.csr_matrix(A)
+    d = A.diagonal()
+    d_safe = np.where(d == 0, 1.0, d)
+    if formula == 1:
+        Di = sp.diags(1.0 / d_safe)
+        W = -0.5 * (Di @ A + (Di @ A).T)
+    else:
+        absA = abs(A)
+        W = 0.5 * (absA + absA.T)
+        ad = np.abs(d_safe)
+        # divide entry (i,j) by max(|a_ii|,|a_jj|)
+        W = sp.csr_matrix(W)
+        rows = np.repeat(np.arange(W.shape[0]), np.diff(W.indptr))
+        denom = np.maximum(ad[rows], ad[W.indices])
+        W.data = W.data / np.where(denom == 0, 1.0, denom)
+    W = sp.csr_matrix(W)
+    W.setdiag(0)
+    W.eliminate_zeros()
+    return W
+
+
+def _row_argmax(indptr, indices, data, valid_entry_mask):
+    """Per-row argmax over masked entries → column index or −1."""
+    n = len(indptr) - 1
+    out = np.full(n, -1, dtype=np.int64)
+    d = np.where(valid_entry_mask, data, -np.inf)
+    rows_nonempty = np.flatnonzero(np.diff(indptr) > 0)
+    if len(rows_nonempty) == 0:
+        return out
+    maxw = np.full(n, -np.inf)
+    np.maximum.at(maxw, np.repeat(np.arange(n), np.diff(indptr)), d)
+    # first entry achieving the max in each row
+    row_of = np.repeat(np.arange(n), np.diff(indptr))
+    is_max = (d == maxw[row_of]) & np.isfinite(d) & valid_entry_mask
+    entry_idx = np.where(is_max, np.arange(len(d)), len(d))
+    first = np.full(n, len(d), dtype=np.int64)
+    np.minimum.at(first, row_of, entry_idx)
+    got = first < len(d)
+    out[got] = indices[first[got]]
+    return out
+
+
+def pairwise_aggregate(W: sp.csr_matrix, max_iterations: int = 15,
+                       max_unassigned_frac: float = 0.05,
+                       merge_singletons: int = 1,
+                       rng: "np.random.Generator | None" = None,
+                       deterministic: bool = True) -> np.ndarray:
+    """Handshaking matching: nodes point at their heaviest unmatched
+    neighbour; mutual pairs aggregate.  Reference ``size2_selector.cu``.
+
+    Returns ``aggregates``: (n,) aggregate id per node.
+    """
+    W = sp.csr_matrix(W)
+    n = W.shape[0]
+    indptr, indices, data = W.indptr, W.indices, W.data
+    # deterministic symmetric tie-break jitter keyed on node ids
+    if not deterministic:
+        rng = rng or np.random.default_rng(0)
+        jitter = rng.random(len(data)) * 1e-12
+    else:
+        h = ((indices.astype(np.uint64) * 2654435761) % 1000003).astype(float)
+        jitter = h * 1e-15
+    data = data + jitter
+
+    partner = np.full(n, -1, dtype=np.int64)
+    row_of = np.repeat(np.arange(n), np.diff(indptr))
+    for _ in range(max_iterations):
+        unmatched = partner < 0
+        n_un = int(unmatched.sum())
+        if n_un == 0 or n_un <= max_unassigned_frac * n:
+            break
+        valid = unmatched[row_of] & unmatched[indices]
+        best = _row_argmax(indptr, indices, data, valid)
+        # handshake: i—j match iff best[i]==j and best[j]==i
+        cand = (best >= 0) & unmatched
+        idx = np.flatnonzero(cand)
+        mutual = idx[best[best[idx]] == idx]
+        keep = mutual < best[mutual]  # record each pair once
+        a, bq = mutual[keep], best[mutual[keep]]
+        partner[a] = bq
+        partner[bq] = a
+
+    # aggregate numbering: pairs get one id, leftovers are singletons
+    agg = np.full(n, -1, dtype=np.int64)
+    next_id = 0
+    firsts = np.flatnonzero((partner >= 0) & (np.arange(n) < partner))
+    agg[firsts] = np.arange(len(firsts))
+    agg[partner[firsts]] = agg[firsts]
+    next_id = len(firsts)
+    single = np.flatnonzero(agg < 0)
+    if merge_singletons and len(single):
+        # merge each singleton into its heaviest neighbour's aggregate
+        valid = np.ones(len(data), dtype=bool)
+        best = _row_argmax(indptr, indices, data, valid)
+        for i in single:
+            j = best[i]
+            if j >= 0 and agg[j] >= 0:
+                agg[i] = agg[j]
+        single = np.flatnonzero(agg < 0)
+    if len(single):
+        agg[single] = next_id + np.arange(len(single))
+        next_id += len(single)
+    return agg
+
+
+def collapse_weights(W: sp.csr_matrix, agg: np.ndarray) -> sp.csr_matrix:
+    """Galerkin-collapse a weight graph onto aggregates (for multi-pass
+    size-4/size-8 matching)."""
+    n = W.shape[0]
+    nc = int(agg.max()) + 1 if len(agg) else 0
+    S = sp.csr_matrix((np.ones(n), (np.arange(n), agg)), shape=(n, nc))
+    Wc = sp.csr_matrix(S.T @ W @ S)
+    Wc.setdiag(0)
+    Wc.eliminate_zeros()
+    return Wc
+
+
+class _SelectorBase:
+    config_name = "?"
+
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+        g = lambda name: cfg.get(name, scope)
+        self.max_matching_iterations = int(g("max_matching_iterations"))
+        self.max_unassigned_percentage = float(g("max_unassigned_percentage"))
+        self.merge_singletons = int(g("merge_singletons"))
+        self.weight_formula = int(g("weight_formula"))
+        self.deterministic = bool(cfg.get("determinism_flag"))
+
+    def select(self, A: sp.csr_matrix) -> np.ndarray:
+        """Return aggregates array (n_block_rows,)."""
+        raise NotImplementedError
+
+
+class _SizeKSelector(_SelectorBase):
+    passes = 1
+
+    def select(self, A):
+        W = edge_weights(A, self.weight_formula, self.deterministic)
+        agg_cur = pairwise_aggregate(
+            W, self.max_matching_iterations, self.max_unassigned_percentage,
+            self.merge_singletons, deterministic=self.deterministic)
+        agg_total = agg_cur
+        for _ in range(self.passes - 1):
+            W = collapse_weights(W, agg_cur)
+            agg_cur = pairwise_aggregate(
+                W, self.max_matching_iterations,
+                self.max_unassigned_percentage, self.merge_singletons,
+                deterministic=self.deterministic)
+            agg_total = agg_cur[agg_total]
+        return agg_total
+
+
+@register_selector("SIZE_2")
+class Size2Selector(_SizeKSelector):
+    """One matching pass → aggregates of ~2 (``size2_selector.cu``)."""
+    passes = 1
+
+
+@register_selector("SIZE_4")
+class Size4Selector(_SizeKSelector):
+    """Two passes → aggregates of ~4 (``size4_selector.cu``)."""
+    passes = 2
+
+
+@register_selector("SIZE_8")
+class Size8Selector(_SizeKSelector):
+    """Three passes → aggregates of ~8 (``size8_selector.cu``)."""
+    passes = 3
+
+
+@register_selector("MULTI_PAIRWISE")
+class MultiPairwiseSelector(_SizeKSelector):
+    """Notay-style repeated pairwise aggregation
+    (``multi_pairwise.cu``); ``aggregation_passes`` sets the pass count
+    and ``filter_weights`` drops weak edges first."""
+
+    def __init__(self, cfg, scope):
+        super().__init__(cfg, scope)
+        self.passes = int(cfg.get("aggregation_passes", scope))
+        self.filter_weights = int(cfg.get("filter_weights", scope))
+        self.filter_alpha = float(cfg.get("filter_weights_alpha", scope))
+
+    def select(self, A):
+        W = edge_weights(A, self.weight_formula, self.deterministic)
+        if self.filter_weights:
+            Wc = sp.csr_matrix(W)
+            rowmax = np.zeros(W.shape[0])
+            rows = np.repeat(np.arange(W.shape[0]), np.diff(Wc.indptr))
+            np.maximum.at(rowmax, rows, Wc.data)
+            thresh = self.filter_alpha * np.sqrt(
+                rowmax[rows] * rowmax[Wc.indices])
+            Wc.data = np.where(Wc.data < thresh, 0.0, Wc.data)
+            Wc.eliminate_zeros()
+            W = Wc
+        agg_cur = pairwise_aggregate(
+            W, self.max_matching_iterations, self.max_unassigned_percentage,
+            self.merge_singletons, deterministic=self.deterministic)
+        agg_total = agg_cur
+        for _ in range(self.passes - 1):
+            W = collapse_weights(W, agg_cur)
+            agg_cur = pairwise_aggregate(
+                W, self.max_matching_iterations,
+                self.max_unassigned_percentage, self.merge_singletons,
+                deterministic=self.deterministic)
+            agg_total = agg_cur[agg_total]
+        return agg_total
+
+
+@register_selector("PARALLEL_GREEDY")
+class ParallelGreedySelector(_SelectorBase):
+    """Greedy aggregation: seed nodes grab their unaggregated neighbourhood
+    (approximation of ``parallel_greedy_selector.cu``)."""
+
+    def select(self, A):
+        W = edge_weights(A, self.weight_formula, self.deterministic)
+        n = W.shape[0]
+        indptr, indices = W.indptr, W.indices
+        agg = np.full(n, -1, dtype=np.int64)
+        order = np.argsort(-np.diff(indptr), kind="stable")  # high degree first
+        next_id = 0
+        for i in order:
+            if agg[i] >= 0:
+                continue
+            nbrs = indices[indptr[i]:indptr[i + 1]]
+            free = nbrs[agg[nbrs] < 0]
+            agg[i] = next_id
+            agg[free] = next_id
+            next_id += 1
+        return agg
+
+
+@register_selector("DUMMY")
+class DummySelector(_SelectorBase):
+    """Fixed-size consecutive-row aggregates (``dummy_selector.cu``);
+    ``aggregate_size`` param."""
+
+    def select(self, A):
+        size = int(self.cfg.get("aggregate_size", self.scope))
+        n = A.shape[0]
+        return np.arange(n, dtype=np.int64) // max(size, 1)
+
+
+@register_selector("GEO")
+class GeoSelector(DummySelector):
+    """Geometric selector placeholder — reference ``geo_selector.cu`` uses
+    attached geometry; without geometry we fall back to block aggregates."""

@@ -1,0 +1,206 @@
+"""C/F splitting selectors for classical AMG.
+
+Reference: ``core/src/classical/selectors/`` — PMIS, HMIS, RS, CR,
+AGGRESSIVE_PMIS/AGGRESSIVE_HMIS, DUMMY (registered core.cu:662-667).
+
+PMIS is the TPU-natural choice: a randomized maximal independent set over
+the strength graph, embarrassingly parallel per sweep.  The
+``determinism_flag`` seeds the hash so runs reproduce exactly (§5.2 of the
+survey).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+import scipy.sparse as sp
+
+from ...errors import BadConfigurationError
+
+_selector_registry: Dict[str, type] = {}
+
+COARSE, FINE, UNDECIDED = 1, 0, -1
+
+
+def register_cf_selector(name):
+    def deco(cls):
+        _selector_registry[name] = cls
+        cls.config_name = name
+        return cls
+    return deco
+
+
+def create_cf_selector(name, cfg, scope):
+    if name not in _selector_registry:
+        raise BadConfigurationError(f"unknown classical selector {name!r}")
+    return _selector_registry[name](cfg, scope)
+
+
+class _CFSelectorBase:
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+        self.deterministic = bool(cfg.get("determinism_flag"))
+
+    def select(self, S: sp.csr_matrix) -> np.ndarray:
+        """Given the strength matrix S (i strongly depends on j), return
+        cf_map: (n,) with COARSE=1 / FINE=0."""
+        raise NotImplementedError
+
+
+def _pmis(S: sp.csr_matrix, seed: int = 7) -> np.ndarray:
+    """Parallel modified independent set over the symmetrised strength
+    graph (Luby-style, as in the reference's PMIS)."""
+    n = S.shape[0]
+    G = (S + S.T).tocsr()  # undirected influence graph
+    G.eliminate_zeros()
+    indptr, indices = G.indptr, G.indices
+    deg = np.diff(indptr)
+    # weight = #nodes i influences + deterministic hash in [0,1)
+    ST = sp.csr_matrix(S.T)
+    lam = np.diff(ST.indptr).astype(np.float64)
+    h = (np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
+         np.uint64(seed)) % np.uint64(1 << 20)
+    w = lam + h.astype(np.float64) / float(1 << 20)
+
+    state = np.full(n, UNDECIDED, dtype=np.int8)
+    state[deg == 0] = FINE  # isolated nodes: fine (nothing to interpolate)
+    # nodes with no influence at all become F immediately (reference PMIS)
+    while np.any(state == UNDECIDED):
+        und = state == UNDECIDED
+        # i becomes C iff w_i > w_j for all undecided neighbours j
+        rows = np.repeat(np.arange(n), deg)
+        nb_und = und[rows] & und[indices]
+        max_nb_w = np.zeros(n)
+        np.maximum.at(max_nb_w, rows[nb_und], w[indices[nb_und]])
+        has_nb = np.zeros(n, dtype=bool)
+        has_nb[rows[nb_und]] = True
+        become_c = und & ((~has_nb) | (w > max_nb_w))
+        state[become_c] = COARSE
+        # undecided neighbours of new C points become F
+        new_c_entries = become_c[indices] & (state[rows] == UNDECIDED)
+        f_nodes = np.unique(rows[new_c_entries])
+        state[f_nodes] = FINE
+    return (state == COARSE).astype(np.int8)
+
+
+@register_cf_selector("PMIS")
+class PMISSelector(_CFSelectorBase):
+    """Parallel Modified Independent Set (``selectors/pmis.cu``)."""
+
+    def select(self, S):
+        seed = 7 if self.deterministic else np.random.randint(1 << 16)
+        return _pmis(S, seed)
+
+
+@register_cf_selector("HMIS")
+class HMISSelector(_CFSelectorBase):
+    """HMIS (``selectors/hmis.cu``): PMIS on the distance-2 strength graph
+    (S·Sᵀ sparsity), giving the sparser coarse grids of Hybrid-MIS."""
+
+    def select(self, S):
+        S2 = sp.csr_matrix(S.astype(np.float64) @ S.T.astype(np.float64))
+        S2.setdiag(0)
+        S2.eliminate_zeros()
+        S2.data[:] = 1
+        seed = 7 if self.deterministic else np.random.randint(1 << 16)
+        return _pmis(sp.csr_matrix(S2.astype(np.int8)), seed)
+
+
+@register_cf_selector("RS")
+class RSSelector(_CFSelectorBase):
+    """Sequential Ruge-Stüben first pass (``selectors/rs.cu``): greedy
+    max-λ selection with neighbour updates (host-side; setup only)."""
+
+    def select(self, S):
+        n = S.shape[0]
+        lam = np.diff(sp.csr_matrix(S.T).indptr).astype(np.int64)
+        state = np.full(n, UNDECIDED, dtype=np.int8)
+        Su = sp.csr_matrix(S)
+        STu = sp.csr_matrix(S.T)
+        import heapq
+        heap = [(-lam[i], i) for i in range(n)]
+        heapq.heapify(heap)
+        while heap:
+            nl, i = heapq.heappop(heap)
+            if state[i] != UNDECIDED or -nl != lam[i]:
+                continue
+            state[i] = COARSE
+            # dependents of i become F; their influences gain weight
+            deps = STu.indices[STu.indptr[i]:STu.indptr[i + 1]]
+            for j in deps:
+                if state[j] == UNDECIDED:
+                    state[j] = FINE
+                    infl = Su.indices[Su.indptr[j]:Su.indptr[j + 1]]
+                    for k in infl:
+                        if state[k] == UNDECIDED:
+                            lam[k] += 1
+                            heapq.heappush(heap, (-lam[k], k))
+        state[state == UNDECIDED] = FINE
+        return (state == COARSE).astype(np.int8)
+
+
+@register_cf_selector("AGGRESSIVE_PMIS")
+class AggressivePMISSelector(PMISSelector):
+    """Aggressive coarsening: PMIS, then a second PMIS among the C points
+    over the distance-2 graph (``classical_amg_level.cu:155-201``)."""
+
+    def select(self, S):
+        cf = super().select(S)
+        c_idx = np.flatnonzero(cf)
+        if len(c_idx) < 2:
+            return cf
+        # strength graph among C points at distance ≤ 2
+        Sf = sp.csr_matrix(S.astype(np.float64))
+        S2 = sp.csr_matrix(Sf @ Sf + Sf)
+        Scc = S2[c_idx][:, c_idx]
+        Scc = sp.csr_matrix(Scc)
+        Scc.setdiag(0)
+        Scc.eliminate_zeros()
+        Scc.data[:] = 1
+        seed = 11 if self.deterministic else np.random.randint(1 << 16)
+        cf_c = _pmis(sp.csr_matrix(Scc.astype(np.int8)), seed)
+        out = np.zeros_like(cf)
+        out[c_idx[cf_c.astype(bool)]] = 1
+        return out
+
+
+@register_cf_selector("AGGRESSIVE_HMIS")
+class AggressiveHMISSelector(HMISSelector):
+    def select(self, S):
+        cf = super().select(S)
+        c_idx = np.flatnonzero(cf)
+        if len(c_idx) < 2:
+            return cf
+        Sf = sp.csr_matrix(S.astype(np.float64))
+        S2 = sp.csr_matrix(Sf @ Sf + Sf)
+        Scc = sp.csr_matrix(S2[c_idx][:, c_idx])
+        Scc.setdiag(0)
+        Scc.eliminate_zeros()
+        if Scc.nnz:
+            Scc.data[:] = 1
+        seed = 11 if self.deterministic else np.random.randint(1 << 16)
+        cf_c = _pmis(sp.csr_matrix(Scc.astype(np.int8)), seed)
+        out = np.zeros_like(cf)
+        out[c_idx[cf_c.astype(bool)]] = 1
+        return out
+
+
+@register_cf_selector("DUMMY")
+class DummyCFSelector(_CFSelectorBase):
+    """Every other point coarse (``selectors/dummy.cu`` parity)."""
+
+    def select(self, S):
+        n = S.shape[0]
+        cf = np.zeros(n, dtype=np.int8)
+        cf[::2] = 1
+        return cf
+
+
+@register_cf_selector("CR")
+class CRSelector(_CFSelectorBase):
+    """Compatible-relaxation selector (used by energymin; reference
+    ``selectors/cr.cu``): start from PMIS and promote slow-to-relax points."""
+
+    def select(self, S):
+        return _pmis(S, 13)

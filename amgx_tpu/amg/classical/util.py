@@ -1,0 +1,29 @@
+"""Setup-phase sparse helpers shared by classical AMG components."""
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def entry_mask_in(A: sp.csr_matrix, S: sp.csr_matrix) -> np.ndarray:
+    """For each stored entry (i,j) of A, True iff (i,j) is stored in S.
+
+    O(nnz log nnz) merge on (row, col) keys — both matrices must have
+    sorted indices.
+    """
+    A = sp.csr_matrix(A)
+    S = sp.csr_matrix(S)
+    A.sort_indices()
+    S.sort_indices()
+    ncols = np.int64(A.shape[1])
+    a_rows = np.repeat(np.arange(A.shape[0], dtype=np.int64),
+                       np.diff(A.indptr))
+    s_rows = np.repeat(np.arange(S.shape[0], dtype=np.int64),
+                       np.diff(S.indptr))
+    a_keys = a_rows * ncols + A.indices
+    s_keys = s_rows * ncols + S.indices
+    pos = np.searchsorted(s_keys, a_keys)
+    pos_c = np.minimum(pos, max(len(s_keys) - 1, 0))
+    if len(s_keys) == 0:
+        return np.zeros(len(a_keys), dtype=bool)
+    return (pos < len(s_keys)) & (s_keys[pos_c] == a_keys)

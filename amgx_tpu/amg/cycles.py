@@ -1,0 +1,112 @@
+"""Multigrid cycles: V, W, F, CG (K-cycle), CGF.
+
+Reference: ``core/src/cycles/`` — ``FixedCycle::cycle`` recursion
+(``fixed_cycle.cu:48-255``): pre-smooth → r = b−Ax → restrict →
+recurse-or-coarse-solve → prolongate+correct → post-smooth; V/W/F/CG/CGF
+dispatchers registered at ``core.cu:647-651``.
+
+TPU design: the recursion unrolls at trace time over the static level list,
+producing one fused XLA computation for the whole cycle — there is no
+run-time dispatch.  The K-cycle (CG/CGF) nests a 2-iteration flexible-CG
+acceleration at each coarse level (``cycle_iters`` param).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops.spmv import spmv
+
+
+def build_cycle(hierarchy, cycle_type: str = None):
+    """Return cycle_fn(b, x) -> x for the hierarchy (traced)."""
+    ct = cycle_type or hierarchy.cycle_type
+    levels = hierarchy.levels
+    h = hierarchy
+
+    def smooth(lvl, b, x, sweeps):
+        if sweeps <= 0:
+            return x
+        return lvl.smoother.apply(b, x0=x, n_iters=sweeps)
+
+    def coarse_solve(b, x):
+        cs = h.coarse_solver
+        if h.coarse_solver_is_smoother:
+            return cs.apply(b, x0=x, n_iters=h.coarsest_sweeps)
+        return cs.apply(b, x0=x)
+
+    def presweeps_at(i):
+        if i == 0 and h.finest_sweeps >= 0:
+            return h.finest_sweeps
+        return h.presweeps
+
+    def postsweeps_at(i):
+        if i == 0 and h.finest_sweeps >= 0:
+            return h.finest_sweeps
+        return h.postsweeps
+
+    def cycle(i, b, x, flavor):
+        """One multigrid cycle starting at level i (trace-time recursion)."""
+        if i == len(levels):
+            return coarse_solve(b, x)
+        lvl = levels[i]
+        x = smooth(lvl, b, x, presweeps_at(i))
+        r = b - spmv(lvl.Ad, x)
+        bc = lvl.restrict_residual(r)
+        xc = jnp.zeros_like(bc)
+        if flavor == "V":
+            xc = cycle(i + 1, bc, xc, "V")
+        elif flavor == "W":
+            xc = cycle(i + 1, bc, xc, "W")
+            if i + 1 < len(levels):
+                xc = cycle(i + 1, bc, xc, "W")
+        elif flavor == "F":
+            # F-cycle: one F-recursion then one V-recursion per level
+            xc = cycle(i + 1, bc, xc, "F")
+            if i + 1 < len(levels):
+                xc = cycle(i + 1, bc, xc, "V")
+        elif flavor in ("CG", "CGF"):
+            xc = _kcycle(i + 1, bc, xc, flavor)
+        else:
+            raise ValueError(f"unknown cycle {flavor!r}")
+        x = lvl.prolongate_and_correct(x, xc)
+        x = smooth(lvl, b, x, postsweeps_at(i))
+        return x
+
+    def _kcycle(i, b, x, flavor):
+        """K-cycle: accelerate the level-i solve with `cycle_iters`
+        iterations of flexible CG preconditioned by the next cycle
+        (reference CG_Flex_Cycle, cycles/cg_flex_cycle.cu)."""
+        if i == len(levels):
+            return coarse_solve(b, x)
+        inner_flavor = "V" if flavor == "CGF" else flavor
+        Ad = levels[i].Ad
+
+        r = b - spmv(Ad, x)
+        p = None
+        z_prev = None
+        r_prev = None
+        for _ in range(max(h.cycle_iters, 1)):
+            z = cycle(i, r, jnp.zeros_like(r), inner_flavor)
+            if p is None:
+                p = z
+            else:
+                # flexible (Notay) beta
+                rz = jnp.vdot(r_prev, z_prev)
+                beta_num = jnp.vdot(r, z) - jnp.vdot(r_prev, z)
+                beta = jnp.where(rz != 0,
+                                 beta_num / jnp.where(rz == 0, 1.0, rz), 0.0)
+                p = z + beta * p
+            q = spmv(Ad, p)
+            pq = jnp.vdot(p, q)
+            alpha = jnp.where(pq != 0,
+                              jnp.vdot(r, z) / jnp.where(pq == 0, 1.0, pq),
+                              0.0)
+            x = x + alpha * p
+            r_prev, z_prev = r, z
+            r = r - alpha * q
+        return x
+
+    def cycle_fn(b, x):
+        return cycle(0, b, x, ct)
+
+    return cycle_fn

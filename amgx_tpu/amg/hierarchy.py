@@ -1,0 +1,235 @@
+"""AMG hierarchy: the setup loop.
+
+Reference: ``base/src/amg.cu`` — the ``AMG`` class (level list, coarse
+solver, setup-loop parameters, ``amg.cu:69-82``) and the hot setup loop
+``AMG_Setup::setup`` (``amg.cu:177-450``): per level —
+
+1. termination checks (``max_levels``, ``min_coarse_rows``),
+2. createCoarseVertices (selector),
+3. coarsening-rate guard (``coarsen_threshold``, ``amg.cu:394``),
+4. createCoarseMatrices (interpolation + Galerkin RAP),
+5. setup_smoother.
+
+Setup runs on host (irregular graph work); every produced level is a frozen
+device pack.  Structure reuse across re-setups (``structure_reuse_levels``,
+``amg.cu:260-290``) keeps selector/interpolation structure and refreshes
+numeric values only.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..config import AMGConfig
+from ..core.matrix import Matrix
+from ..errors import BadConfigurationError
+from ..solvers.base import SolverFactory
+from ..utils.logging import amgx_output
+from .aggregation.galerkin import galerkin_coarse
+from .aggregation.selectors import create_selector
+from .classical.interpolators import create_interpolator
+from .classical.selectors import create_cf_selector
+from .classical.strength import create_strength
+from .level import AggregationLevel, AMGLevel, ClassicalLevel
+
+
+class AMGHierarchy:
+    def __init__(self, cfg: AMGConfig, scope: str):
+        self.cfg = cfg
+        self.scope = scope
+        g = lambda name: cfg.get(name, scope)
+        self.algorithm = str(g("algorithm"))
+        self.max_levels = int(g("max_levels"))
+        self.min_coarse_rows = int(g("min_coarse_rows"))
+        self.min_fine_rows = int(g("min_fine_rows"))
+        self.coarsen_threshold = float(g("coarsen_threshold"))
+        self.cycle_type = str(g("cycle"))
+        self.presweeps = int(g("presweeps"))
+        self.postsweeps = int(g("postsweeps"))
+        self.finest_sweeps = int(g("finest_sweeps"))
+        self.coarsest_sweeps = int(g("coarsest_sweeps"))
+        self.cycle_iters = int(g("cycle_iters"))
+        self.structure_reuse_levels = int(g("structure_reuse_levels"))
+        self.dense_lu_num_rows = int(g("dense_lu_num_rows"))
+        self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
+        self.print_grid_stats = bool(g("print_grid_stats"))
+        self.aggressive_levels = int(g("aggressive_levels"))
+        self.levels: List[AMGLevel] = []
+        self.coarse_solver = None
+        self.coarse_solver_is_smoother = False
+        self._structure: Optional[list] = None  # for structure reuse
+
+    # ------------------------------------------------------------------ setup
+    def setup(self, A: Matrix):
+        t0 = time.perf_counter()
+        reuse = (self._structure is not None and
+                 self.structure_reuse_levels != 0)
+        if reuse:
+            self._setup_reuse(A)
+        else:
+            self._setup_fresh(A)
+        self.setup_time = time.perf_counter() - t0
+        if self.print_grid_stats:
+            amgx_output(self.grid_stats())
+        return self
+
+    def _setup_fresh(self, A: Matrix):
+        self.levels = []
+        structure = []
+        cur = A
+        while True:
+            n = cur.n_block_rows
+            if len(self.levels) + 1 >= self.max_levels:
+                break
+            if n <= self.min_coarse_rows:
+                break
+            level, Ac, struct = self._coarsen_once(cur, len(self.levels))
+            if level is None:
+                break
+            nc = Ac.n_block_rows
+            # coarsening-rate guard (amg.cu:394): stop if the grid stops
+            # shrinking
+            if nc >= self.coarsen_threshold * n or nc >= n or nc == 0:
+                break
+            self.levels.append(level)
+            structure.append(struct)
+            cur = Ac
+        self._structure = structure
+        self._setup_smoothers_and_coarse(cur)
+
+    def _setup_reuse(self, A: Matrix):
+        """Keep coarsening structure; refresh numeric values
+        (``structure_reuse_levels``: N levels reuse structure)."""
+        cur = A
+        new_levels = []
+        for i, (level, struct) in enumerate(zip(self.levels,
+                                                self._structure)):
+            if i >= self.structure_reuse_levels and \
+                    self.structure_reuse_levels > 0:
+                # rebuild the rest fresh
+                break
+            kind, data = struct
+            if kind == "aggregation":
+                agg, nc = data
+                Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
+                lvl = AggregationLevel(cur, i, agg, nc)
+            else:
+                P_host, = data
+                R_host = sp.csr_matrix(P_host.T)
+                Ac_host = sp.csr_matrix(R_host @ cur.scalar_csr() @ P_host)
+                lvl = ClassicalLevel(cur, i, Matrix(P_host).device(),
+                                     Matrix(R_host).device())
+            new_levels.append(lvl)
+            cur = Matrix(Ac_host, block_dim=cur.block_dim)
+        self.levels = new_levels
+        self._setup_smoothers_and_coarse(cur)
+
+    def _coarsen_once(self, cur: Matrix, idx: int):
+        if self.algorithm == "AGGREGATION":
+            name = str(self.cfg.get("selector", self.scope))
+            selector = create_selector(name, self.cfg, self.scope)
+            Asc = cur.scalar_csr() if cur.block_dim == 1 else \
+                _block_condensed(cur)
+            agg = selector.select(Asc)
+            nc = int(agg.max()) + 1 if len(agg) else 0
+            if nc == 0:
+                return None, None, None
+            Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
+            level = AggregationLevel(cur, idx, agg, nc)
+            Ac = Matrix(Ac_host, block_dim=cur.block_dim)
+            return level, Ac, ("aggregation", (agg, nc))
+        elif self.algorithm in ("CLASSICAL", "ENERGYMIN"):
+            if cur.block_dim != 1:
+                raise BadConfigurationError(
+                    "classical AMG requires block_dim=1 (use AGGREGATION "
+                    "for block systems), as in the reference defaults")
+            Asc = cur.scalar_csr()
+            strength = create_strength(
+                str(self.cfg.get("strength", self.scope)), self.cfg,
+                self.scope)
+            S = strength.compute(Asc)
+            sel_name = str(self.cfg.get("selector", self.scope))
+            if self.algorithm == "ENERGYMIN":
+                sel_name = str(self.cfg.get("energymin_selector", self.scope))
+            interp_name = str(self.cfg.get("interpolator", self.scope))
+            # aggressive coarsening on the first `aggressive_levels` levels
+            # switches selector/interpolator (classical_amg_level.cu:155-201)
+            if idx < self.aggressive_levels:
+                asel = str(self.cfg.get("aggressive_selector", self.scope))
+                if asel == "DEFAULT":
+                    asel = "AGGRESSIVE_" + sel_name \
+                        if not sel_name.startswith("AGGRESSIVE") else sel_name
+                sel_name = asel
+                interp_name = str(self.cfg.get("aggressive_interpolator",
+                                               self.scope))
+            selector = create_cf_selector(sel_name, self.cfg, self.scope)
+            cf_map = selector.select(S)
+            nc = int(cf_map.sum())
+            if nc == 0 or nc >= Asc.shape[0]:
+                return None, None, None
+            interp = create_interpolator(interp_name, self.cfg, self.scope)
+            P_host = interp.compute(Asc, S, cf_map)
+            R_host = sp.csr_matrix(P_host.T)
+            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host)
+            Ac_host.sum_duplicates()
+            Ac_host.sort_indices()
+            level = ClassicalLevel(cur, idx, Matrix(P_host).device(),
+                                   Matrix(R_host).device(), cf_map)
+            return level, Matrix(Ac_host), ("classical", (P_host,))
+        raise BadConfigurationError(f"unknown AMG algorithm "
+                                    f"{self.algorithm!r}")
+
+    def _setup_smoothers_and_coarse(self, coarsest: Matrix):
+        for lvl in self.levels:
+            lvl.smoother = SolverFactory.allocate(self.cfg, self.scope,
+                                                  "smoother")
+            lvl.smoother.setup(lvl.A)
+        self.coarsest = coarsest
+        self.coarse_solver = SolverFactory.allocate(self.cfg, self.scope,
+                                                    "coarse_solver")
+        self.coarse_solver.setup(coarsest)
+        self.coarse_solver_is_smoother = self.coarse_solver.is_smoother
+
+    # ------------------------------------------------------------------ info
+    def num_levels(self):
+        return len(self.levels) + 1
+
+    def grid_stats(self) -> str:
+        """Grid-stats table mirroring the reference README sample output."""
+        rows = []
+        tot_rows = tot_nnz = 0
+        all_levels = [(l.Ad.n_rows, l.A.nnz) for l in self.levels]
+        all_levels.append((self.coarsest.n_block_rows, self.coarsest.nnz))
+        for i, (n, nnz) in enumerate(all_levels):
+            sprs = nnz / max(n * n, 1)
+            rows.append(f"         {i}(D)  {n:12d}  {nnz:12d} "
+                        f" {sprs:9.3g}\n")
+            tot_rows += n
+            tot_nnz += nnz
+        op_cmpl = tot_nnz / max(all_levels[0][1], 1)
+        grid_cmpl = tot_rows / max(all_levels[0][0], 1)
+        return ("        Number of Levels: "
+                f"{self.num_levels()}\n"
+                "            LVL         ROWS           NNZ    SPRSTY\n"
+                "         ------------------------------------------\n"
+                + "".join(rows) +
+                "         ------------------------------------------\n"
+                f"         Grid Complexity: {grid_cmpl:.5g}\n"
+                f"         Operator Complexity: {op_cmpl:.5g}\n")
+
+
+def _block_condensed(m: Matrix) -> sp.csr_matrix:
+    """Condense a block matrix to a scalar weight graph for selectors
+    (reference uses one component per block,
+    ``aggregation_edge_weight_component``)."""
+    bsr = m.host if isinstance(m.host, sp.bsr_matrix) else sp.bsr_matrix(
+        m.host, blocksize=(m.block_dim, m.block_dim))
+    bsr.sort_indices()
+    b = m.block_dim
+    n = bsr.shape[0] // b
+    # Frobenius-norm condensation of each block
+    vals = np.sqrt((bsr.data ** 2).sum(axis=(1, 2)))
+    return sp.csr_matrix((vals, bsr.indices, bsr.indptr), shape=(n, n))

@@ -1,0 +1,100 @@
+"""AMG level: one grid in the hierarchy.
+
+Reference: ``base/include/amg_level.h:73-238`` (AMG_Level linked list with
+``createCoarseVertices`` / ``createCoarseMatrices`` / ``restrictResidual`` /
+``prolongateAndApplyCorrection``) and its two concrete flavours:
+
+* aggregation (``core/src/aggregation/aggregation_amg_level.cu:115-196``):
+  R/P are *implicit* piecewise-constant operators over the ``aggregates``
+  array — restriction is a segment-sum, prolongation a gather.
+* classical (``core/src/classical/classical_amg_level.cu``): explicit P from
+  the interpolator, R = Pᵀ, coarse A = R·A·P.
+
+Here a level is a frozen bundle of device arrays + its smoother; the cycle
+functions in :mod:`amgx_tpu.amg.cycles` trace over the level list.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.matrix import DeviceMatrix, Matrix
+from ..ops.spmv import spmv
+
+
+class AMGLevel:
+    def __init__(self, A: Matrix, level_index: int):
+        self.A = A
+        self.Ad = A.device()
+        self.level_index = level_index
+        self.smoother = None
+        self.kind = "?"
+
+    # traced ops --------------------------------------------------------
+    def restrict_residual(self, r: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def prolongate_and_correct(self, x: jax.Array, e: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    @property
+    def n_rows(self):
+        return self.Ad.n_rows
+
+    @property
+    def nnz(self):
+        return self.A.nnz
+
+
+class AggregationLevel(AMGLevel):
+    """Implicit piecewise-constant transfer over ``aggregates``."""
+
+    kind = "aggregation"
+
+    def __init__(self, A: Matrix, level_index: int, aggregates: np.ndarray,
+                 n_coarse: int):
+        super().__init__(A, level_index)
+        self.aggregates = jnp.asarray(aggregates.astype(np.int32))
+        self.n_coarse = int(n_coarse)
+
+    def restrict_residual(self, r):
+        b = self.Ad.block_dim
+        if b == 1:
+            return jax.ops.segment_sum(r, self.aggregates,
+                                       num_segments=self.n_coarse)
+        rb = r.reshape(-1, b)
+        rc = jax.ops.segment_sum(rb, self.aggregates,
+                                 num_segments=self.n_coarse)
+        return rc.reshape(-1)
+
+    def prolongate_and_correct(self, x, e):
+        b = self.Ad.block_dim
+        if b == 1:
+            return x + e[self.aggregates]
+        eb = e.reshape(-1, b)
+        return x + eb[self.aggregates].reshape(-1)
+
+
+class ClassicalLevel(AMGLevel):
+    """Explicit P/R transfer (classical or energymin)."""
+
+    kind = "classical"
+
+    def __init__(self, A: Matrix, level_index: int, P: DeviceMatrix,
+                 R: DeviceMatrix, cf_map: Optional[np.ndarray] = None):
+        super().__init__(A, level_index)
+        self.P = P
+        self.R = R
+        self.n_coarse = P.n_cols
+        if cf_map is not None:
+            # expose the C/F split for CF_JACOBI (cf_jacobi_solver.cu)
+            A.cf_map = cf_map
+
+    def restrict_residual(self, r):
+        return spmv(self.R, r)
+
+    def prolongate_and_correct(self, x, e):
+        return x + spmv(self.P, e)

@@ -6,6 +6,7 @@ from . import jacobi      # BLOCK_JACOBI, JACOBI_L1, CF_JACOBI
 from . import dense_lu    # DENSE_LU_SOLVER, NOSOLVER
 from . import krylov      # CG, PCG, PCGF, BICGSTAB, PBICGSTAB, GMRES, FGMRES
 from . import chebyshev   # CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL, KPZ_POLYNOMIAL
+from . import amg_solver  # AMG
 
 __all__ = ["Solver", "SolverFactory", "SolveResult", "register_solver",
            "check_convergence"]

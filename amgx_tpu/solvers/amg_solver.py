@@ -1,0 +1,47 @@
+"""AMG as a Solver.
+
+Reference: ``base/src/solvers/algebraic_multigrid_solver.cu`` — wraps the
+``AMG`` hierarchy as a ``Solver`` so it can be the main solver, a
+preconditioner, or even a smoother; one 'solve iteration' = one multigrid
+cycle (``amg.cu:1236-1254``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..amg.cycles import build_cycle
+from ..amg.hierarchy import AMGHierarchy
+from ..errors import BadConfigurationError
+from .base import Solver, register_solver
+
+
+@register_solver("AMG")
+class AMGSolver(Solver):
+    is_smoother = True  # usable as a smoother/preconditioner
+
+    def solver_setup(self):
+        if self.A is None:
+            raise BadConfigurationError(
+                "AMG setup requires the host matrix (upload via Matrix)")
+        self.hierarchy = AMGHierarchy(self.cfg, self.scope)
+        self.hierarchy.setup(self.A)
+        self._cycle = build_cycle(self.hierarchy)
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        return self._cycle(b, x), state
+
+    def grid_stats(self):
+        return self.hierarchy.grid_stats()
+
+    def resetup(self, A):
+        """Refresh numeric values after AMGX_matrix_replace_coefficients
+        (reference AMGX_solver_resetup + structure_reuse_levels)."""
+        self.A = A
+        self.Ad = A.device()
+        if self.hierarchy.structure_reuse_levels != 0:
+            self.hierarchy.setup(A)
+            self._cycle = build_cycle(self.hierarchy)
+        else:
+            self.solver_setup()
+        self._solve_fn = None
+        return self

@@ -1,0 +1,24 @@
+"""Test configuration: run everything on an 8-device virtual CPU mesh.
+
+Multi-node behaviour is simulated single-process (the reference does the
+same with in-process partitions, ``generated_matrix_distributed_io.cu`` —
+SURVEY.md §4.4); distributed tests shard over the 8 virtual devices.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax
+
+# The axon TPU plugin ignores JAX_PLATFORMS env; the config knob works.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,88 @@
+"""Config-system tests (reference: base/tests/config_parsing.cu)."""
+import glob
+import json
+
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.config import AMGConfig
+from amgx_tpu.errors import BadConfigurationError
+
+
+def test_string_v2_scopes():
+    cfg = AMGConfig("config_version=2, solver(s1)=PCG, "
+                    "s1:preconditioner(p1)=BLOCK_JACOBI, p1:max_iters=3, "
+                    "s1:max_iters=50")
+    assert cfg.get("solver") == "PCG"
+    assert cfg.get_scoped("solver", "default") == ("PCG", "s1")
+    assert cfg.get("max_iters", "s1") == 50
+    assert cfg.get("max_iters", "p1") == 3
+    # fallback to registry default
+    assert cfg.get("tolerance", "s1") == 1e-12
+
+
+def test_string_v1_conversion():
+    cfg = AMGConfig("max_levels=10; smoother_weight=0.8; min_block_rows=16; "
+                    "smoother=JACOBI")
+    assert cfg.get("max_levels") == 10
+    assert cfg.get("relaxation_factor") == 0.8
+    assert cfg.get("min_coarse_rows") == 16
+    assert cfg.get("smoother") == "BLOCK_JACOBI"
+
+
+def test_v1_rejects_scopes():
+    with pytest.raises(BadConfigurationError):
+        AMGConfig("solver(s1)=PCG")
+
+
+def test_json_nested_scopes():
+    cfg = AMGConfig.from_file(
+        "/root/reference/core/configs/FGMRES_AGGREGATION.json")
+    assert cfg.get_scoped("solver", "default") == ("FGMRES", "main")
+    assert cfg.get("max_iters", "main") == 100
+    assert cfg.get_scoped("preconditioner", "main") == ("AMG", "amg")
+    assert cfg.get("smoother", "amg") == "MULTICOLOR_DILU"
+    assert cfg.get("selector", "amg") == "SIZE_2"
+    assert cfg.get("coarse_solver", "amg") == "DENSE_LU_SOLVER"
+    assert cfg.get("tolerance", "main") == 1e-10
+
+
+@pytest.mark.parametrize("path", sorted(
+    glob.glob("/root/reference/core/configs/*.json")))
+def test_all_reference_configs_parse(path):
+    cfg = AMGConfig.from_file(path)
+    assert cfg.get("solver") is not None
+
+
+def test_type_coercion_and_validation():
+    cfg = AMGConfig()
+    cfg.set("max_iters", "25")
+    assert cfg.get("max_iters") == 25
+    cfg.set("tolerance", "1e-3")
+    assert cfg.get("tolerance") == 1e-3
+    with pytest.raises(BadConfigurationError):
+        cfg.set("cycle", "Q")
+    with pytest.raises(BadConfigurationError):
+        cfg.set("relaxation_factor", 3.5)  # out of range
+
+
+def test_default_scope_only_params():
+    with pytest.raises(BadConfigurationError):
+        AMGConfig("config_version=2, solver(s1)=PCG, s1:determinism_flag=1")
+
+
+def test_new_scope_only_for_solvers():
+    with pytest.raises(BadConfigurationError):
+        AMGConfig("config_version=2, tolerance(t1)=0.1")
+
+
+def test_write_parameters_description():
+    desc = json.loads(AMGConfig().write_parameters_description())
+    assert "max_iters" in desc and desc["max_iters"]["default"] == 100
+    assert "solver" in desc
+
+
+def test_unknown_param_stored():
+    cfg = AMGConfig()
+    cfg.set("my_custom_knob", 5)
+    assert cfg.get("my_custom_knob") == 5

@@ -1,0 +1,69 @@
+"""IO tests (reference: base/src/matrix_io.cu readers/writers,
+core/src/readers.cu)."""
+import numpy as np
+import scipy.sparse as sp
+
+from amgx_tpu.io import (generate_distributed_poisson_7pt, poisson5pt,
+                         poisson7pt, poisson9pt, poisson27pt,
+                         read_matrix_market, write_matrix_market)
+
+
+def test_read_reference_matrix():
+    s = read_matrix_market("/root/reference/examples/matrix.mtx")
+    assert s.A.shape == (12, 12)
+    assert s.A.nnz == 61
+    assert s.A[0, 0] == 1.0
+    assert s.A[11, 11] == 61.0
+    assert s.rhs is None
+
+
+def test_roundtrip_with_rhs_solution(tmp_path, rng):
+    A = sp.csr_matrix(poisson5pt(5, 5))
+    b = rng.standard_normal(25)
+    x = rng.standard_normal(25)
+    p = str(tmp_path / "sys.mtx")
+    write_matrix_market(p, A, rhs=b, solution=x)
+    s = read_matrix_market(p)
+    np.testing.assert_allclose((s.A - A).toarray(), 0, atol=1e-14)
+    np.testing.assert_allclose(s.rhs, b, rtol=1e-14)
+    np.testing.assert_allclose(s.solution, x, rtol=1e-14)
+
+
+def test_symmetric_expansion(tmp_path):
+    p = str(tmp_path / "sym.mtx")
+    with open(p, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate real symmetric\n"
+                "2 2 2\n1 1 2.0\n2 1 -1.0\n")
+    s = read_matrix_market(p)
+    dense = s.A.toarray()
+    np.testing.assert_allclose(dense, [[2, -1], [-1, 0]])
+
+
+def test_poisson_generators():
+    A5 = poisson5pt(4, 4)
+    assert A5.shape == (16, 16)
+    assert (A5.diagonal() == 4).all()
+    A7 = poisson7pt(3, 3, 3)
+    assert A7.shape == (27, 27)
+    assert (A7.diagonal() == 6).all()
+    assert (np.asarray(A7.sum(axis=1)).ravel() >= 0).all()
+    A9 = poisson9pt(4, 4)
+    assert (A9.diagonal() == 8).all()
+    A27 = poisson27pt(3, 3, 3)
+    assert (A27.diagonal() == 26).all()
+    # center row fully interior has 26 neighbours
+    assert A27[13].nnz == 27
+
+
+def test_distributed_poisson_partition():
+    A, part = generate_distributed_poisson_7pt(4, 4, 4, px=2, py=1, pz=1)
+    n = 8 * 4 * 4
+    assert A.shape == (n, n)
+    assert len(part) == n
+    assert (np.bincount(part) == 64).all()
+    # renumbered matrix must be a permutation of the plain global one
+    Ag = poisson7pt(8, 4, 4)
+    assert abs(A.sum() - Ag.sum()) < 1e-9
+    assert A.nnz == Ag.nnz
+    # rank-contiguous rows: rows 0..63 belong to rank 0
+    assert (part[:64] == 0).all() and (part[64:] == 1).all()

@@ -1,0 +1,118 @@
+"""Container + primitive tests (reference: base/tests/matrix_tests.cu,
+vector_tests.cu, norm_tests.cu, generic_spmv.cu)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.core.matrix import Matrix, pack_device
+from amgx_tpu.ops import blas, spmv, spmm
+from amgx_tpu.io import poisson5pt, poisson7pt
+
+
+def random_csr(rng, n, density=0.1):
+    A = sp.random(n, n, density=density, random_state=np.random.RandomState(7),
+                  format="csr")
+    A = A + sp.identity(n) * n
+    return sp.csr_matrix(A)
+
+
+def test_ell_pack_roundtrip(rng):
+    A = random_csr(rng, 50)
+    d = pack_device(A, 1, np.float64)
+    assert d.fmt == "ell"
+    x = rng.standard_normal(50)
+    y = np.asarray(spmv(d, x))
+    np.testing.assert_allclose(y, A @ x, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(d.diag), A.diagonal(), rtol=1e-14)
+
+
+def test_csr_fallback_pack(rng):
+    A = random_csr(rng, 60)
+    d = pack_device(A, 1, np.float64, ell_max_width=2)
+    assert d.fmt == "csr"
+    x = rng.standard_normal(60)
+    np.testing.assert_allclose(np.asarray(spmv(d, x)), A @ x, rtol=1e-12)
+
+
+def test_block_pack_spmv(rng):
+    b = 4
+    n_blocks = 12
+    dense = rng.standard_normal((n_blocks * b, n_blocks * b))
+    mask = rng.random((n_blocks, n_blocks)) < 0.3
+    np.fill_diagonal(mask, True)
+    blk = np.kron(mask, np.ones((b, b)))
+    dense = dense * blk
+    A = sp.bsr_matrix(sp.csr_matrix(dense), blocksize=(b, b))
+    m = Matrix(A, block_dim=b)
+    d = m.device()
+    assert d.block_dim == b
+    x = rng.standard_normal(n_blocks * b)
+    np.testing.assert_allclose(np.asarray(spmv(d, x)), dense @ x, rtol=1e-10)
+    # block diag extraction
+    for i in range(n_blocks):
+        np.testing.assert_allclose(np.asarray(d.diag[i]),
+                                   dense[i*b:(i+1)*b, i*b:(i+1)*b])
+
+
+def test_from_csr_upload_block(rng):
+    # AMGX-style block upload (AMGX_matrix_upload_all)
+    b = 2
+    indptr = np.array([0, 2, 3])
+    indices = np.array([0, 1, 1])
+    data = rng.standard_normal((3, b, b))
+    m = Matrix.from_csr(indptr, indices, data, block_dim=b)
+    assert m.shape == (4, 4)
+    d = m.device()
+    x = rng.standard_normal(4)
+    dense = np.zeros((4, 4))
+    dense[0:2, 0:2] = data[0]
+    dense[0:2, 2:4] = data[1]
+    dense[2:4, 2:4] = data[2]
+    np.testing.assert_allclose(np.asarray(spmv(d, x)), dense @ x, rtol=1e-12)
+
+
+def test_replace_coefficients(rng):
+    A = random_csr(rng, 30)
+    m = Matrix(A)
+    d1 = m.device()
+    newdata = rng.standard_normal(A.nnz)
+    m.replace_coefficients(newdata)
+    d2 = m.device()
+    A2 = sp.csr_matrix((newdata, A.indices, A.indptr), shape=A.shape)
+    x = rng.standard_normal(30)
+    np.testing.assert_allclose(np.asarray(spmv(d2, x)), A2 @ x, rtol=1e-12)
+
+
+def test_spmm(rng):
+    A = random_csr(rng, 40)
+    d = pack_device(A, 1, np.float64)
+    X = rng.standard_normal((40, 5))
+    np.testing.assert_allclose(np.asarray(spmm(d, X)), A @ X, rtol=1e-12)
+
+
+def test_norms_block_and_scalar(rng):
+    v = rng.standard_normal(24)
+    import jax.numpy as jnp
+    jv = jnp.asarray(v)
+    np.testing.assert_allclose(float(blas.norm(jv, "L2")),
+                               np.linalg.norm(v), rtol=1e-12)
+    np.testing.assert_allclose(float(blas.norm(jv, "L1")),
+                               np.abs(v).sum(), rtol=1e-12)
+    np.testing.assert_allclose(float(blas.norm(jv, "LMAX")),
+                               np.abs(v).max(), rtol=1e-12)
+    # block norms: per-component over (n, b) layout
+    bn = np.asarray(blas.norm(jv, "L2", block_dim=4, use_scalar_norm=False))
+    ref = np.linalg.norm(v.reshape(-1, 4), axis=0)
+    np.testing.assert_allclose(bn, ref, rtol=1e-12)
+
+
+def test_zero_diagonal_handling(rng):
+    # reference: base/tests/zero_in_diagonal_handling.cu
+    A = sp.csr_matrix(np.array([[0.0, 1.0], [1.0, 2.0]]))
+    d = pack_device(A, 1, np.float64)
+    assert np.asarray(d.diag)[0] == 0.0
+    from amgx_tpu.solvers.jacobi import _invert_block_diag
+    dinv = np.asarray(_invert_block_diag(d.diag))
+    assert dinv[0] == 0.0  # guarded inversion, no inf/nan
+    assert np.isfinite(dinv).all()

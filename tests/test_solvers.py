@@ -1,0 +1,126 @@
+"""Solver convergence tests on generated Poisson matrices (reference:
+core/tests/fgmres_convergence_poisson.cu and friends — SURVEY §4.3)."""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson5pt, poisson7pt
+
+
+def _solve(config_str, A, b, x0=None):
+    cfg = amgx.AMGConfig(config_str)
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    return slv.solve(b, x0), slv
+
+
+BASE = ("config_version=2, solver(s)=%s, s:max_iters=%d, "
+        "s:monitor_residual=1, s:tolerance=1e-8, s:convergence=RELATIVE_INI")
+
+
+@pytest.mark.parametrize("name,iters", [
+    ("CG", 200), ("PCG", 200), ("PCGF", 200), ("BICGSTAB", 200),
+    ("PBICGSTAB", 200), ("GMRES", 300), ("FGMRES", 300),
+    ("CHEBYSHEV", 500),
+])
+def test_krylov_poisson_convergence(name, iters):
+    A = poisson5pt(16, 16)
+    b = np.ones(A.shape[0])
+    extra = ""
+    if name in ("PCG", "PCGF", "PBICGSTAB", "FGMRES"):
+        extra = ", s:preconditioner(p)=BLOCK_JACOBI, p:max_iters=3"
+    if name == "CHEBYSHEV":
+        # user-supplied spectral interval (mode 2) — interval-based methods
+        # need λmin to actually reach the target (cheb_solver.cu:105-112)
+        extra = (", s:chebyshev_lambda_estimate_mode=2, "
+                 "s:cheby_max_lambda=8.0, s:cheby_min_lambda=0.06")
+    res, _ = _solve(BASE % (name, iters) + extra, A, b)
+    x = np.asarray(res.x)
+    relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert res.status == amgx.SolveStatus.SUCCESS, (name, relres)
+    assert relres < 1e-7, (name, relres)
+
+
+def test_smoothers_reduce_residual():
+    A = poisson5pt(12, 12)
+    b = np.ones(A.shape[0])
+    for name in ("BLOCK_JACOBI", "JACOBI_L1", "CHEBYSHEV_POLY",
+                 "POLYNOMIAL", "KPZ_POLYNOMIAL"):
+        cfg = amgx.AMGConfig(
+            f"config_version=2, solver(s)=%s, s:max_iters=20" % name)
+        slv = amgx.create_solver(cfg)
+        slv.setup(amgx.Matrix(A))
+        res = slv.solve(b)
+        x = np.asarray(res.x)
+        r = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+        assert r < 0.9, (name, r)
+
+
+def test_dense_lu_direct():
+    A = poisson5pt(6, 6)
+    b = np.ones(A.shape[0])
+    res, _ = _solve(BASE % ("DENSE_LU_SOLVER", 1), A, b)
+    x = np.asarray(res.x)
+    assert np.linalg.norm(b - A @ x) < 1e-10
+
+
+def test_nosolver_identity():
+    A = poisson5pt(4, 4)
+    cfg = amgx.AMGConfig("config_version=2, solver(s)=NOSOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    b = np.arange(16.0)
+    res = slv.solve(b)
+    np.testing.assert_allclose(np.asarray(res.x), b)
+
+
+def test_zero_initial_guess_flag():
+    A = poisson5pt(8, 8)
+    b = np.ones(A.shape[0])
+    res, slv = _solve(BASE % ("PCG", 100), A, b)
+    res2 = slv.solve(b, np.full(A.shape[0], 7.0), zero_initial_guess=True)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(res2.x),
+                               rtol=1e-10)
+
+
+def test_nonsymmetric_gmres():
+    # convection-diffusion like: Poisson + upwind shift (nonsymmetric)
+    import scipy.sparse as sp
+    A = poisson5pt(12, 12).tolil()
+    n = A.shape[0]
+    for i in range(n - 1):
+        A[i, i + 1] = A[i, i + 1] - 0.4
+    A = sp.csr_matrix(A)
+    b = np.ones(n)
+    res, _ = _solve(BASE % ("FGMRES", 300) +
+                    ", s:preconditioner(p)=BLOCK_JACOBI, p:max_iters=2, "
+                    "s:gmres_n_restart=25", A, b)
+    x = np.asarray(res.x)
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-7
+
+
+def test_residual_history_and_status():
+    A = poisson5pt(10, 10)
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(BASE % ("PCG", 100) +
+                         ", s:store_res_history=1")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    assert res.residual_history is not None
+    assert len(res.residual_history) == res.iterations + 1
+    # monotone-ish decrease overall
+    assert res.residual_history[-1].max() < res.residual_history[0].max()
+
+
+def test_not_converged_status():
+    A = poisson5pt(16, 16)
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig("config_version=2, solver(s)=CG, s:max_iters=2, "
+                         "s:monitor_residual=1, s:tolerance=1e-14, "
+                         "s:convergence=RELATIVE_INI")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    assert res.status == amgx.SolveStatus.NOT_CONVERGED
+    assert res.iterations == 2
