@@ -66,7 +66,7 @@ class AMGHierarchy:
     def setup(self, A: Matrix):
         t0 = time.perf_counter()
         reuse = (self._structure is not None and
-                 self.structure_reuse_levels != 0)
+                 self.structure_reuse_levels != 0 and A.dist is None)
         if reuse:
             self._setup_reuse(A)
         else:
@@ -131,6 +131,8 @@ class AMGHierarchy:
         if self.algorithm == "AGGREGATION":
             name = str(self.cfg.get("selector", self.scope))
             selector = create_selector(name, self.cfg, self.scope)
+            if cur.dist is not None:
+                return self._coarsen_aggregation_dist(cur, idx, selector)
             Asc = cur.scalar_csr() if cur.block_dim == 1 else \
                 _block_condensed(cur)
             agg = selector.select(Asc)
@@ -176,11 +178,81 @@ class AMGHierarchy:
             Ac_host = sp.csr_matrix(R_host @ Asc @ P_host)
             Ac_host.sum_duplicates()
             Ac_host.sort_indices()
+            if cur.dist is not None:
+                # distributed classical: embed P/R into the padded vector
+                # spaces; transfer matmuls run under GSPMD (correctness
+                # path — the hot per-level SpMV still uses the halo pack)
+                from ..distributed.matrix import embed_padded
+                mesh, axis, _, _ = cur.dist
+                curd = cur.device()
+                f_off = np.asarray(curd.offsets)
+                nc = P_host.shape[1]
+                n_parts = curd.n_parts
+                c_nloc = -(-nc // n_parts)
+                c_off = np.minimum(np.arange(n_parts + 1) * c_nloc, nc)
+                P_pad = embed_padded(P_host, f_off, curd.n_loc, c_off,
+                                     c_nloc)
+                R_pad = sp.csr_matrix(P_pad.T)
+                Ac = Matrix(Ac_host)
+                Ac.set_distribution(mesh, axis, c_off, n_loc=c_nloc)
+                level = ClassicalLevel(cur, idx, Matrix(P_pad).device(),
+                                       Matrix(R_pad).device(), None)
+                return level, Ac, ("classical", (P_host,))
             level = ClassicalLevel(cur, idx, Matrix(P_host).device(),
                                    Matrix(R_host).device(), cf_map)
             return level, Matrix(Ac_host), ("classical", (P_host,))
         raise BadConfigurationError(f"unknown AMG algorithm "
                                     f"{self.algorithm!r}")
+
+    def _coarsen_aggregation_dist(self, cur: Matrix, idx: int, selector):
+        """Distributed aggregation coarsening.
+
+        Each rank aggregates its own diagonal block (the reference also
+        runs selectors per-rank, with halo aggregates resolved afterwards —
+        ``aggregation_amg_level.cu`` distributed path); coarse ids are
+        rank-contiguous so restriction/prolongation stay shard-local.
+        The coarse matrix keeps cross-rank couplings via the global
+        Galerkin product and inherits a distribution over the same mesh.
+        """
+        mesh, axis, offsets, _ = cur.dist
+        curd = cur.device()             # ShardedMatrix of this level
+        offsets = np.asarray(curd.offsets)
+        n_parts = curd.n_parts
+        Asc = cur.scalar_csr()
+        n = Asc.shape[0]
+        agg_real = np.empty(n, dtype=np.int64)
+        counts = []
+        base = 0
+        for p in range(n_parts):
+            lo, hi = offsets[p], offsets[p + 1]
+            if hi == lo:
+                counts.append(0)
+                continue
+            sub = sp.csr_matrix(Asc[lo:hi, lo:hi])
+            agg_p = selector.select(sub)
+            agg_real[lo:hi] = agg_p + base
+            cnt = int(agg_p.max()) + 1 if len(agg_p) else 0
+            counts.append(cnt)
+            base += cnt
+        nc = base
+        if nc == 0 or nc >= n:
+            return None, None, None
+        coarse_offsets = np.concatenate([[0], np.cumsum(counts)])
+        nc_loc = max(counts) + 1        # ≥1 padding slot per rank
+        Ac_host = galerkin_coarse(Asc, agg_real, 1)
+        Ac = Matrix(Ac_host)
+        Ac.set_distribution(mesh, axis, coarse_offsets, n_loc=nc_loc)
+        # aggregates in padded coordinates: fine pad rows → coarse pad slot
+        n_loc_f = curd.n_loc
+        agg_pad = np.empty(n_parts * n_loc_f, dtype=np.int64)
+        for p in range(n_parts):
+            lo, hi = offsets[p], offsets[p + 1]
+            row = np.full(n_loc_f, p * nc_loc + nc_loc - 1, dtype=np.int64)
+            row[:hi - lo] = agg_real[lo:hi] - coarse_offsets[p] + p * nc_loc
+            agg_pad[p * n_loc_f:(p + 1) * n_loc_f] = row
+        level = AggregationLevel(cur, idx, agg_pad,
+                                 n_coarse=n_parts * nc_loc)
+        return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
         for lvl in self.levels:

@@ -91,10 +91,24 @@ class Matrix:
         self.block_dim = int(block_dim)
         self.dtype = np.dtype(dtype)
         self._host: Optional[sp.spmatrix] = None
-        self._device: Optional[DeviceMatrix] = None
+        self._device = None
         self._device_dtype = None
+        #: distribution spec: (mesh, axis, offsets, n_loc) or None
+        self.dist = None
         if a is not None:
             self.set(a, block_dim=block_dim)
+
+    def set_distribution(self, mesh, axis: str = "p", offsets=None,
+                         n_loc=None):
+        """Declare this matrix row-distributed over a device mesh
+        (the AMGX_matrix_upload_distributed analog: the partition comes
+        from explicit offsets or an equal split)."""
+        if self.block_dim != 1:
+            raise BadParametersError(
+                "distributed matrices currently require block_dim=1")
+        self.dist = (mesh, axis, offsets, n_loc)
+        self._device = None
+        return self
 
     # ------------------------------------------------------------------ setup
     def set(self, a, block_dim: int = 1):
@@ -170,12 +184,19 @@ class Matrix:
         return self._host.nnz
 
     # ---------------------------------------------------------------- packing
-    def device(self, dtype=None, ell_max_width: int = 2048) -> DeviceMatrix:
+    def device(self, dtype=None, ell_max_width: int = 2048):
         dtype = np.dtype(dtype or self.dtype)
         if self._device is not None and self._device_dtype == dtype:
             return self._device
-        self._device = pack_device(self._host, self.block_dim, dtype,
-                                   ell_max_width)
+        if self.dist is not None:
+            from ..distributed.matrix import shard_matrix
+            mesh, axis, offsets, n_loc = self.dist
+            self._device = shard_matrix(self.scalar_csr(), mesh, axis=axis,
+                                        dtype=dtype, offsets=offsets,
+                                        n_loc=n_loc)
+        else:
+            self._device = pack_device(self._host, self.block_dim, dtype,
+                                       ell_max_width)
         self._device_dtype = dtype
         return self._device
 

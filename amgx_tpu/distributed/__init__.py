@@ -1,0 +1,8 @@
+from .matrix import (ShardedMatrix, shard_matrix, dist_spmv, shard_vector,
+                     unshard_vector, make_mesh, embed_padded, pad_map)
+from .partition import (Partition, build_partition,
+                        partition_offsets_from_vector)
+
+__all__ = ["ShardedMatrix", "shard_matrix", "dist_spmv", "shard_vector",
+           "unshard_vector", "make_mesh", "embed_padded", "pad_map",
+           "Partition", "build_partition", "partition_offsets_from_vector"]

@@ -1,0 +1,152 @@
+"""Row partitioning and halo-map construction.
+
+TPU-native re-design of the reference distributed layer (SURVEY §2.8):
+
+* ``DistributedManager`` (``base/src/distributed/distributed_manager.cu``)
+  keeps per-matrix partition state: neighbour lists, B2L (boundary→local)
+  send maps, L2H maps, halo offsets/ranges, interior-first renumbering.
+* ``DistributedArranger`` (``distributed_arranger.cu:85-140`` create_B2L)
+  builds that state from global column indices + a partition vector.
+
+Here the equivalent state is built on host by :func:`build_partition`:
+rows are partitioned into P equal contiguous shards (padded with identity
+rows), each shard's matrix is packed in ELL form with column indices into
+``[0, n_loc + H)`` where slots ``n_loc..n_loc+H`` hold received halo values;
+``send_idx`` (the B2L map) gathers boundary values into a fixed-size send
+buffer, and ``halo_src`` addresses the all-gathered send buffers.  At solve
+time the exchange is ``all_gather`` over the mesh axis (general graphs) —
+the ``lax.ppermute`` neighbour schedule lives in
+:mod:`amgx_tpu.distributed.spmv` for ring partitions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..errors import BadParametersError
+
+
+@dataclasses.dataclass
+class Partition:
+    """Host-side partition descriptor (the DistributedManager analog)."""
+
+    n_global: int               # unpadded global rows
+    n_parts: int
+    n_loc: int                  # padded rows per shard
+    offsets: np.ndarray         # (P+1,) original row offsets per rank
+    # per-rank halo structure (lists of arrays, rank-major)
+    send_idx: np.ndarray        # (P, B) local row ids to send (B2L map)
+    send_count: np.ndarray      # (P,)
+    halo_src: np.ndarray        # (P, H) index into flattened (P*B) gathered buf
+    halo_count: np.ndarray      # (P,)
+    halo_global: List[np.ndarray]   # per-rank global col ids of halo slots
+    neighbors: List[np.ndarray]     # per-rank neighbour rank lists
+    ring_neighbors_only: bool = False  # every neighbour is rank±1
+
+    @property
+    def B(self):
+        return self.send_idx.shape[1]
+
+    @property
+    def H(self):
+        return self.halo_src.shape[1]
+
+
+def partition_offsets_from_vector(partition_vector: np.ndarray,
+                                  n_parts: int) -> np.ndarray:
+    """Partition vector (rank id per row, rank-contiguous) → offsets.
+
+    Reference: partition vectors in ``AMGX_matrix_upload_distributed``;
+    rows must already be rank-contiguous (the renumbered layout)."""
+    pv = np.asarray(partition_vector)
+    counts = np.bincount(pv, minlength=n_parts)
+    # verify contiguity
+    expect = np.repeat(np.arange(n_parts), counts)
+    if not np.array_equal(np.sort(pv), pv) or not np.array_equal(pv, expect):
+        raise BadParametersError(
+            "partition vector must be rank-contiguous (renumber rows "
+            "first, as AMGX_matrix_upload_distributed requires)")
+    return np.concatenate([[0], np.cumsum(counts)])
+
+
+def build_partition(A: sp.csr_matrix, n_parts: int,
+                    offsets: Optional[np.ndarray] = None) -> Partition:
+    """Analyse the global matrix and build all halo maps.
+
+    Equivalent of ``DistributedArranger::create_B2L`` + interior-first
+    renumbering (here rows keep their order; padding replaces renumbering
+    because SPMD shards must be equal-sized).
+    """
+    A = sp.csr_matrix(A)
+    n = A.shape[0]
+    if offsets is None:
+        n_loc = -(-n // n_parts)
+        offsets = np.minimum(np.arange(n_parts + 1) * n_loc, n)
+    else:
+        offsets = np.asarray(offsets)
+    n_loc = int(np.max(np.diff(offsets)))
+
+    # which rank owns each global row
+    owner = np.zeros(n, dtype=np.int32)
+    for p in range(n_parts):
+        owner[offsets[p]:offsets[p + 1]] = p
+
+    halo_global: List[np.ndarray] = []
+    neighbors: List[np.ndarray] = []
+    # send_sets[q][p] = global rows of q needed by p
+    need = [[None] * n_parts for _ in range(n_parts)]
+    for p in range(n_parts):
+        lo, hi = offsets[p], offsets[p + 1]
+        sub = A[lo:hi]
+        cols = np.unique(sub.indices)
+        ext = cols[(cols < lo) | (cols >= hi)]
+        halo_global.append(ext)
+        nb = np.unique(owner[ext])
+        neighbors.append(nb)
+        for q in nb:
+            need[q][p] = ext[owner[ext] == q]
+
+    # per-rank send lists (B2L): union of what every neighbour needs,
+    # sorted — deterministic layout both sides can compute
+    send_lists: List[np.ndarray] = []
+    for q in range(n_parts):
+        allneed = [need[q][p] for p in range(n_parts)
+                   if need[q][p] is not None]
+        s = (np.unique(np.concatenate(allneed)) if allneed
+             else np.zeros(0, dtype=np.int64))
+        send_lists.append(s)
+
+    B = max((len(s) for s in send_lists), default=0)
+    B = max(B, 1)
+    H = max((len(h) for h in halo_global), default=0)
+    H = max(H, 1)
+
+    send_idx = np.zeros((n_parts, B), dtype=np.int32)
+    send_count = np.zeros(n_parts, dtype=np.int32)
+    for q, s in enumerate(send_lists):
+        send_idx[q, :len(s)] = s - offsets[q]  # local row ids
+        send_count[q] = len(s)
+
+    halo_src = np.zeros((n_parts, H), dtype=np.int32)
+    halo_count = np.zeros(n_parts, dtype=np.int32)
+    for p, ext in enumerate(halo_global):
+        own = owner[ext]
+        pos = np.empty(len(ext), dtype=np.int64)
+        for q in np.unique(own):
+            mask = own == q
+            pos[mask] = np.searchsorted(send_lists[q], ext[mask])
+        halo_src[p, :len(ext)] = own.astype(np.int64) * B + pos
+        halo_count[p] = len(ext)
+
+    ring = all((len(nb) == 0 or
+                np.all((nb == p - 1) | (nb == p + 1)))
+               for p, nb in enumerate(neighbors))
+    return Partition(
+        n_global=n, n_parts=n_parts, n_loc=n_loc,
+        offsets=offsets, send_idx=send_idx, send_count=send_count,
+        halo_src=halo_src, halo_count=halo_count,
+        halo_global=halo_global, neighbors=neighbors,
+        ring_neighbors_only=bool(ring))

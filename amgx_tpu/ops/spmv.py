@@ -17,8 +17,15 @@ import jax.numpy as jnp
 from ..core.matrix import DeviceMatrix
 
 
-def spmv(A: DeviceMatrix, x: jax.Array) -> jax.Array:
-    """y = A @ x.  ``x`` is a flat (n_cols * block_dim,) vector."""
+def spmv(A, x: jax.Array) -> jax.Array:
+    """y = A @ x.  ``x`` is a flat (n_cols * block_dim,) vector.
+
+    Dispatches on the matrix pack: DeviceMatrix (single device) or
+    ShardedMatrix (mesh-distributed with halo exchange).
+    """
+    if A.fmt == "sharded-ell":
+        from ..distributed.matrix import dist_spmv
+        return dist_spmv(A, x)
     b = A.block_dim
     if A.fmt == "ell":
         if b == 1:

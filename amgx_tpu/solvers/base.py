@@ -206,10 +206,17 @@ class Solver:
         if self.Ad is None:
             raise BadConfigurationError("solve() before setup()")
         dtype = self.Ad.dtype
-        b = jnp.asarray(b, dtype=dtype)
+        dist = self.Ad.fmt == "sharded-ell"
+        if dist:
+            from ..distributed.matrix import shard_vector
+            b = shard_vector(self.Ad, b)
+            if x0 is not None and not zero_initial_guess:
+                x0 = shard_vector(self.Ad, x0)
+        else:
+            b = jnp.asarray(b, dtype=dtype)
         if x0 is None or zero_initial_guess:
             x0 = jnp.zeros_like(b)
-        else:
+        elif not dist:
             x0 = jnp.asarray(x0, dtype=dtype)
 
         if self._solve_fn is None:
@@ -218,6 +225,9 @@ class Solver:
         x, iters, nrm, nrm_ini, history = self._solve_fn(b, x0)
         x.block_until_ready()
         solve_time = time.perf_counter() - t0
+        if dist:
+            from ..distributed.matrix import unshard_vector
+            x = unshard_vector(self.Ad, x)
 
         iters = int(iters)
         nrm = np.asarray(nrm)

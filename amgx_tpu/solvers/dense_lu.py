@@ -25,6 +25,15 @@ class DenseLUSolver(Solver):
             dense = np.asarray(self.A.host.todense(), dtype=self.Ad.dtype)
         else:
             dense = _densify_device(self.Ad)
+        if self.Ad.fmt == "sharded-ell":
+            # consolidation analog (reference "glue", distributed/glue.h):
+            # the tiny coarsest system is replicated on every device and
+            # solved redundantly; padded slots get identity rows
+            from ..distributed.matrix import pad_map
+            pm = pad_map(np.asarray(self.Ad.offsets), self.Ad.n_loc)
+            big = np.eye(self.Ad.n, dtype=dense.dtype)
+            big[np.ix_(pm, pm)] = dense
+            dense = big
         self._lu, self._piv = jax.scipy.linalg.lu_factor(jnp.asarray(dense))
 
     def solve_iteration(self, b, x, state, iter_idx):

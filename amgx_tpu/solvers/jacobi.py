@@ -74,11 +74,15 @@ class JacobiL1Solver(Solver):
     def solver_setup(self):
         if self.A is not None:
             csr = self.A.scalar_csr()
-            absrow = np.abs(csr).sum(axis=1).A.ravel()
+            absrow = np.asarray(np.abs(csr).sum(axis=1)).ravel()
             diag = csr.diagonal()
             d = np.abs(diag) + (absrow - np.abs(diag))
             d[d == 0] = 1.0
-            self.dinv = jnp.asarray(1.0 / d, dtype=self.Ad.dtype)
+            if self.Ad.fmt == "sharded-ell":
+                from ..distributed.matrix import shard_vector
+                self.dinv = shard_vector(self.Ad, 1.0 / d)
+            else:
+                self.dinv = jnp.asarray(1.0 / d, dtype=self.Ad.dtype)
         else:
             # device-only fallback: |diag| scaled row sums from the pack
             vals = self.Ad.vals
