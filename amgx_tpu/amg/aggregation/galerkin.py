@@ -20,7 +20,8 @@ def galerkin_coarse_scalar(A: sp.csr_matrix, agg: np.ndarray
     """Ac = Sᵀ A S for scalar matrices."""
     n = A.shape[0]
     nc = int(agg.max()) + 1 if len(agg) else 0
-    S = sp.csr_matrix((np.ones(n), (np.arange(n), agg)), shape=(n, nc))
+    S = sp.csr_matrix((np.ones(n, dtype=A.dtype), (np.arange(n), agg)),
+                      shape=(n, nc))
     Ac = sp.csr_matrix(S.T @ A @ S)
     Ac.sum_duplicates()
     Ac.sort_indices()

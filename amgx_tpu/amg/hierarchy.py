@@ -173,9 +173,9 @@ class AMGHierarchy:
             if nc == 0 or nc >= Asc.shape[0]:
                 return None, None, None
             interp = create_interpolator(interp_name, self.cfg, self.scope)
-            P_host = interp.compute(Asc, S, cf_map)
+            P_host = interp.compute(Asc, S, cf_map).astype(Asc.dtype)
             R_host = sp.csr_matrix(P_host.T)
-            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host)
+            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host).astype(Asc.dtype)
             Ac_host.sum_duplicates()
             Ac_host.sort_indices()
             if cur.dist is not None:

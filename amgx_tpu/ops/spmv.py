@@ -26,6 +26,20 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
         return dist_spmv(A, x)
+    if A.fmt == "dia":
+        # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
+        # padded copy of x — no gathers (reference SpMV kernel dispatch
+        # multiply.cu:94-110; this is the TPU-optimal stencil path)
+        n = A.n_rows
+        offs = A.dia_offsets
+        maxo = max(max(abs(o) for o in offs), 1)
+        xp = jnp.pad(x, (maxo, maxo))
+        acc = A.vals[0] * jax.lax.slice(xp, (maxo + offs[0],),
+                                        (maxo + offs[0] + n,))
+        for k in range(1, len(offs)):
+            acc = acc + A.vals[k] * jax.lax.slice(
+                xp, (maxo + offs[k],), (maxo + offs[k] + n,))
+        return acc
     b = A.block_dim
     if A.fmt == "ell":
         if b == 1:

@@ -46,12 +46,17 @@ class DenseLUSolver(Solver):
 
 def _densify_device(Ad) -> np.ndarray:
     """Densify a DeviceMatrix on host (coarse levels are tiny)."""
-    cols = np.asarray(Ad.cols)
     vals = np.asarray(Ad.vals)
     b = Ad.block_dim
     n = Ad.n_rows * b
     m = Ad.n_cols * b
     out = np.zeros((n, m), dtype=vals.dtype)
+    if Ad.fmt == "dia":
+        for k, o in enumerate(Ad.dia_offsets):
+            rows = np.arange(max(0, -o), min(n, n - o))
+            out[rows, rows + o] = vals[k, rows]
+        return out
+    cols = np.asarray(Ad.cols)
     if Ad.fmt == "ell":
         for i in range(Ad.n_rows):
             for k in range(cols.shape[1]):

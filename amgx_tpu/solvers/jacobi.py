@@ -87,7 +87,9 @@ class JacobiL1Solver(Solver):
             # device-only fallback: |diag| scaled row sums from the pack
             vals = self.Ad.vals
             if self.Ad.block_dim == 1:
-                if self.Ad.fmt == "ell":
+                if self.Ad.fmt == "dia":
+                    absrow = jnp.sum(jnp.abs(vals), axis=0)
+                elif self.Ad.fmt == "ell":
                     absrow = jnp.sum(jnp.abs(vals), axis=1)
                 else:
                     absrow = jax.ops.segment_sum(

@@ -116,3 +116,23 @@ def test_zero_diagonal_handling(rng):
     dinv = np.asarray(_invert_block_diag(d.diag))
     assert dinv[0] == 0.0  # guarded inversion, no inf/nan
     assert np.isfinite(dinv).all()
+
+
+def test_dia_pack_selected_for_stencils(rng):
+    A = sp.csr_matrix(poisson7pt(6, 6, 6))
+    d = pack_device(A, 1, np.float64)
+    assert d.fmt == "dia"
+    assert len(d.dia_offsets) == 7
+    x = rng.standard_normal(216)
+    np.testing.assert_allclose(np.asarray(spmv(d, x)), A @ x, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(d.diag), A.diagonal(), rtol=1e-14)
+
+
+def test_dia_not_selected_for_scattered(rng):
+    A = sp.random(300, 300, density=0.25,
+                  random_state=np.random.RandomState(11), format="csr")
+    A = sp.csr_matrix(A + sp.identity(300))
+    d = pack_device(A, 1, np.float64)
+    assert d.fmt != "dia"  # too many distinct offsets
+    x = rng.standard_normal(300)
+    np.testing.assert_allclose(np.asarray(spmv(d, x)), A @ x, rtol=1e-11)
