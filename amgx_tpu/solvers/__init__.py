@@ -7,6 +7,10 @@ from . import dense_lu    # DENSE_LU_SOLVER, NOSOLVER
 from . import krylov      # CG, PCG, PCGF, BICGSTAB, PBICGSTAB, GMRES, FGMRES
 from . import chebyshev   # CHEBYSHEV, CHEBYSHEV_POLY, POLYNOMIAL, KPZ_POLYNOMIAL
 from . import amg_solver  # AMG
+from . import gs          # GS, MULTICOLOR_GS, FIXCOLOR_GS, KACZMARZ
+from . import dilu        # MULTICOLOR_DILU
+from . import ilu         # MULTICOLOR_ILU
+from . import scalers     # BINORMALIZATION, NBINORMALIZATION, DIAGONAL_SYMMETRIC
 
 __all__ = ["Solver", "SolverFactory", "SolveResult", "register_solver",
            "check_convergence"]

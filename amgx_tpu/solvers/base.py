@@ -139,14 +139,26 @@ class Solver:
         self.relaxation_factor = float(g("relaxation_factor"))
         self.A: Optional[Matrix] = None
         self.Ad: Optional[DeviceMatrix] = None
+        self.scaler = None
         self._solve_fn = None
         self.setup_time = 0.0
 
     # ------------------------------------------------------------ lifecycle
     def setup(self, A: "Matrix | DeviceMatrix"):
-        """Host-side setup (reference ``Solver::setup``, solver.cu:380-556)."""
+        """Host-side setup (reference ``Solver::setup``, solver.cu:380-556):
+        optional scaling → solver-specific setup."""
         t0 = time.perf_counter()
+        self.scaler = None
+        scaling = str(self.cfg.get("scaling", self.scope))
         if isinstance(A, Matrix):
+            if scaling != "NONE" and A.dist is None and A.block_dim == 1:
+                # scale a copy (reference scales in place then "unscales";
+                # solver.cu:441-475 documents that workaround — a copy is
+                # cleaner and setup-phase only)
+                from .scalers import create_scaler
+                self.scaler = create_scaler(scaling, self.cfg, self.scope)
+                self.scaler.setup(A.scalar_csr())
+                A = Matrix(self.scaler.scale_matrix(A.scalar_csr()))
             self.A = A
             self.Ad = A.device()
         else:
@@ -206,6 +218,11 @@ class Solver:
         if self.Ad is None:
             raise BadConfigurationError("solve() before setup()")
         dtype = self.Ad.dtype
+        if self.scaler is not None:
+            b = self.scaler.scale_rhs(np.asarray(b, dtype=dtype))
+            if x0 is not None and not zero_initial_guess:
+                x0 = self.scaler.scale_initial_guess(
+                    np.asarray(x0, dtype=dtype))
         dist = self.Ad.fmt == "sharded-ell"
         if dist:
             from ..distributed.matrix import shard_vector
@@ -228,6 +245,8 @@ class Solver:
         if dist:
             from ..distributed.matrix import unshard_vector
             x = unshard_vector(self.Ad, x)
+        if self.scaler is not None:
+            x = self.scaler.unscale_solution(np.asarray(x))
 
         iters = int(iters)
         nrm = np.asarray(nrm)
