@@ -1,0 +1,7 @@
+"""Eigensolvers (reference plugin ``eigensolvers/``, SURVEY §2.7)."""
+from .base import (EigenSolver, EigenSolverFactory, EigenResult,
+                   register_eigensolver)
+from . import algorithms  # registers all algorithms
+
+__all__ = ["EigenSolver", "EigenSolverFactory", "EigenResult",
+           "register_eigensolver"]

@@ -1,0 +1,384 @@
+"""Eigensolver algorithms.
+
+Reference: ``core/src/eigensolvers/`` (2389 LoC) — POWER_ITERATION /
+INVERSE_ITERATION / PAGERANK (all via ``single_iteration_eigensolver.cu``),
+SUBSPACE_ITERATION, LANCZOS, ARNOLDI, LOBPCG, JACOBI_DAVIDSON; QR and
+multivector helpers (``qr.cu``).
+
+TPU notes: LOBPCG and subspace iteration are dominated by tall-skinny dense
+algebra (blocked SpMV + small Gram matrices + QR) — exactly the shape the
+MXU likes, as anticipated in SURVEY §7 M7.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse as sp
+
+from ..errors import SolveStatus
+from ..ops.spmv import spmm, spmv
+from ..solvers.base import SolverFactory
+from .base import EigenResult, EigenSolver, register_eigensolver
+
+
+def _nrm(x):
+    return jnp.sqrt(jnp.real(jnp.vdot(x, x)))
+
+
+@register_eigensolver("POWER_ITERATION")
+class PowerIterationSolver(EigenSolver):
+    """Largest-|λ| eigenpair by power iteration
+    (``single_iteration_eigensolver.cu`` with the plain multiply op)."""
+
+    def _iterate_op(self, x):
+        return self._op(x)
+
+    def _solve_impl(self, x0):
+        tol = self.tolerance
+        max_iters = self.max_iters
+
+        def cond(carry):
+            x, lam, it, done = carry
+            return (~done) & (it < max_iters)
+
+        def body(carry):
+            x, lam, it, _ = carry
+            y = self._iterate_op(x)
+            nrm = _nrm(y)
+            lam_new = jnp.vdot(x, y)
+            y = y / jnp.maximum(nrm, 1e-300)
+            done = jnp.abs(lam_new - lam) <= tol * jnp.abs(lam_new)
+            return y, lam_new, it + 1, done
+
+        x = x0 / jnp.maximum(_nrm(x0), 1e-300)
+        lam0 = jnp.asarray(0.0, x.dtype)
+        x, lam, it, done = jax.lax.while_loop(
+            cond, body, (x, lam0, jnp.asarray(0), jnp.asarray(False)))
+        lam_np = np.asarray(lam) + self.shift
+        status = SolveStatus.SUCCESS if bool(done) else \
+            SolveStatus.NOT_CONVERGED
+        return EigenResult(eigenvalues=np.atleast_1d(lam_np),
+                           eigenvectors=np.asarray(x)[:, None],
+                           iterations=int(it), status=status)
+
+
+@register_eigensolver("INVERSE_ITERATION")
+class InverseIterationSolver(PowerIterationSolver):
+    """Smallest-|λ−σ| eigenpair: power iteration on (A−σI)⁻¹ with a nested
+    linear solver from config (reference inverse path of the
+    single-iteration driver)."""
+
+    def solver_setup(self):
+        self.inner = SolverFactory.allocate(self.cfg, self.scope, "solver")
+        a = self.A if self.A is not None else self.Ad
+        self.inner.setup(a)
+
+    def _iterate_op(self, x):
+        return self.inner.apply(x)
+
+    def _solve_impl(self, x0):
+        res = super()._solve_impl(x0)
+        # λ(A) = 1/λ((A−σI)⁻¹) + σ
+        lam_inv = res.eigenvalues - self.shift
+        lam = np.where(lam_inv != 0, 1.0 / lam_inv, np.inf) + self.shift
+        res.eigenvalues = lam
+        return res
+
+
+@register_eigensolver("PAGERANK")
+class PageRankSolver(EigenSolver):
+    """PageRank via damped power iteration on the Google matrix
+    (reference ``PagerankOperator`` + pagerank_setup,
+    ``amgx_eig_c.h:42``): x ← d·Pᵀx + (1−d)/n, with P the row-stochastic
+    link matrix of A's pattern."""
+
+    def pagerank_setup(self, ranks=None):
+        # build column-normalised Pᵀ on host
+        csr = self.A.scalar_csr().astype(np.float64)
+        out_deg = np.asarray(np.abs(csr).sum(axis=1)).ravel()
+        out_deg[out_deg == 0] = 1.0
+        P = sp.csr_matrix(sp.diags(1.0 / out_deg) @ abs(csr))
+        from ..core.matrix import Matrix as _M
+        self.PT = _M(sp.csr_matrix(P.T).astype(
+            np.asarray(self.Ad.diag).dtype)).device()
+        self.dangling = jnp.asarray(
+            (np.asarray(np.abs(csr).sum(axis=1)).ravel() == 0
+             ).astype(np.float64))
+        return self
+
+    def solver_setup(self):
+        self.pagerank_setup()
+
+    def _solve_impl(self, x0):
+        n = self.Ad.n
+        d = self.damping
+        x = jnp.abs(x0)
+        x = x / jnp.sum(x)
+        tol = self.tolerance
+
+        def cond(carry):
+            x, it, delta = carry
+            return (delta > tol) & (it < self.max_iters)
+
+        def body(carry):
+            x, it, _ = carry
+            y = d * spmv(self.PT, x) + (1.0 - d) / n
+            # dangling mass redistribution
+            y = y + d * jnp.sum(x * self.dangling) / n
+            y = y / jnp.sum(y)
+            delta = jnp.sum(jnp.abs(y - x))
+            return y, it + 1, delta
+
+        x, it, delta = jax.lax.while_loop(
+            cond, body, (x, jnp.asarray(0), jnp.asarray(jnp.inf, x.dtype)))
+        status = SolveStatus.SUCCESS if float(delta) <= self.tolerance \
+            else SolveStatus.NOT_CONVERGED
+        return EigenResult(eigenvalues=np.array([1.0]),
+                           eigenvectors=np.asarray(x)[:, None],
+                           iterations=int(it), status=status)
+
+
+@register_eigensolver("SUBSPACE_ITERATION")
+class SubspaceIterationSolver(EigenSolver):
+    """Block power iteration + Rayleigh-Ritz (``subspace_iteration.cu``)."""
+
+    def _solve_impl(self, x0):
+        k = max(self.wanted_count, 1)
+        m = min(2 * k + 2, self.Ad.n)
+        n = self.Ad.n
+        rng = np.random.default_rng(1)
+        X = jnp.asarray(rng.standard_normal((n, m)), dtype=x0.dtype)
+        X, _ = jnp.linalg.qr(X)
+        lam_old = jnp.zeros((m,), X.dtype)
+        it_done = 0
+        for it in range(self.max_iters):
+            Y = spmm(self.Ad, X)
+            if self.shift:
+                Y = Y - self.shift * X
+            Q, _ = jnp.linalg.qr(Y)
+            H = Q.T @ spmm(self.Ad, Q)
+            w, V = jnp.linalg.eigh((H + H.T) / 2)
+            order = jnp.argsort(-jnp.abs(w))
+            X = Q @ V[:, order]
+            lam = w[order]
+            it_done = it + 1
+            if bool(jnp.max(jnp.abs(lam[:k] - lam_old[:k])) <=
+                    self.tolerance * jnp.maximum(jnp.max(jnp.abs(lam[:k])),
+                                                 1e-300)):
+                lam_old = lam
+                break
+            lam_old = lam
+        lam_np = np.asarray(lam_old)[:k] + self.shift
+        return EigenResult(eigenvalues=lam_np,
+                           eigenvectors=np.asarray(X)[:, :k],
+                           iterations=it_done, status=SolveStatus.SUCCESS)
+
+
+@register_eigensolver("LANCZOS")
+class LanczosSolver(EigenSolver):
+    """Symmetric Lanczos tridiagonalisation (``lanczos.cu``): m Krylov
+    steps with full reorthogonalisation, then eigh of the tridiagonal."""
+
+    def _solve_impl(self, x0):
+        n = self.Ad.n
+        m = min(self.max_iters, max(2 * self.wanted_count + 10, 20), n)
+        V = np.zeros((m + 1, n))
+        alpha = np.zeros(m)
+        beta = np.zeros(m + 1)
+        v = np.array(x0, dtype=np.float64)
+        v /= np.linalg.norm(v)
+        V[0] = v
+        mv = jax.jit(lambda x: self._op(x))
+        k_done = m
+        for k in range(m):
+            w = np.asarray(mv(jnp.asarray(V[k], dtype=self.Ad.dtype)),
+                           dtype=np.float64)
+            alpha[k] = V[k] @ w
+            w = w - alpha[k] * V[k] - (beta[k] * V[k - 1] if k > 0 else 0)
+            # full reorthogonalisation (the reference reorthogonalises too)
+            w = w - V[:k + 1].T @ (V[:k + 1] @ w)
+            beta[k + 1] = np.linalg.norm(w)
+            if beta[k + 1] < 1e-12:
+                k_done = k + 1
+                break
+            V[k + 1] = w / beta[k + 1]
+        T = np.diag(alpha[:k_done]) + np.diag(beta[1:k_done], 1) + \
+            np.diag(beta[1:k_done], -1)
+        w_all, S = np.linalg.eigh(T)
+        if self.which == "smallest":
+            order = np.argsort(w_all)
+        else:
+            order = np.argsort(-np.abs(w_all))
+        k = max(self.wanted_count, 1)
+        lam = w_all[order[:k]] + self.shift
+        vecs = V[:k_done].T @ S[:, order[:k]]
+        return EigenResult(eigenvalues=lam, eigenvectors=vecs,
+                           iterations=k_done, status=SolveStatus.SUCCESS)
+
+
+@register_eigensolver("ARNOLDI")
+class ArnoldiSolver(EigenSolver):
+    """Arnoldi Hessenberg factorisation for nonsymmetric spectra
+    (``arnoldi.cu``)."""
+
+    def _solve_impl(self, x0):
+        n = self.Ad.n
+        m = min(self.max_iters, max(2 * self.wanted_count + 10, 20), n)
+        V = np.zeros((m + 1, n))
+        H = np.zeros((m + 1, m))
+        v = np.array(x0, dtype=np.float64)
+        v /= np.linalg.norm(v)
+        V[0] = v
+        mv = jax.jit(lambda x: self._op(x))
+        k_done = m
+        for k in range(m):
+            w = np.asarray(mv(jnp.asarray(V[k], dtype=self.Ad.dtype)),
+                           dtype=np.float64)
+            h = V[:k + 1] @ w
+            w = w - V[:k + 1].T @ h
+            # CGS2
+            h2 = V[:k + 1] @ w
+            w = w - V[:k + 1].T @ h2
+            H[:k + 1, k] = h + h2
+            H[k + 1, k] = np.linalg.norm(w)
+            if H[k + 1, k] < 1e-12:
+                k_done = k + 1
+                break
+            V[k + 1] = w / H[k + 1, k]
+        w_all, S = np.linalg.eig(H[:k_done, :k_done])
+        if self.which == "smallest":
+            order = np.argsort(np.abs(w_all))
+        else:
+            order = np.argsort(-np.abs(w_all))
+        k = max(self.wanted_count, 1)
+        lam = w_all[order[:k]] + self.shift
+        vecs = V[:k_done].T @ np.real(S[:, order[:k]])
+        return EigenResult(eigenvalues=lam, eigenvectors=vecs,
+                           iterations=k_done, status=SolveStatus.SUCCESS)
+
+
+@register_eigensolver("LOBPCG")
+class LOBPCGSolver(EigenSolver):
+    """Locally optimal block preconditioned CG (``lobpcg_eigensolver.cu``):
+    blocked SpMV + nested preconditioner from config + Rayleigh-Ritz on
+    [X R P] — tall-skinny dense algebra, MXU-friendly."""
+
+    def solver_setup(self):
+        self.precond = None
+        if self.cfg.has("preconditioner", self.scope) or \
+                self.cfg.has("solver", self.scope):
+            try:
+                self.precond = SolverFactory.allocate(self.cfg, self.scope,
+                                                      "preconditioner")
+                a = self.A if self.A is not None else self.Ad
+                self.precond.setup(a)
+            except Exception:
+                self.precond = None
+
+    def _solve_impl(self, x0):
+        n = self.Ad.n
+        k = max(self.wanted_count, 1)
+        smallest = self.which != "largest"
+        rng = np.random.default_rng(3)
+        X = np.asarray(rng.standard_normal((n, k)))
+        X, _ = np.linalg.qr(X)
+        X = jnp.asarray(X, dtype=self.Ad.dtype)
+        P = None
+        lam = None
+        it_done = 0
+        for it in range(self.max_iters):
+            AX = spmm(self.Ad, X)
+            G = X.T @ AX
+            lam_mat, U = jnp.linalg.eigh((G + G.T) / 2)
+            X = X @ U
+            AX = AX @ U
+            lam = lam_mat
+            R = AX - X * lam[None, :]
+            rnorm = jnp.linalg.norm(R, axis=0)
+            it_done = it + 1
+            if bool(jnp.max(rnorm) <= self.tolerance *
+                    jnp.maximum(jnp.max(jnp.abs(lam)), 1e-300)):
+                break
+            W = R
+            if self.precond is not None:
+                W = jax.vmap(lambda r: self.precond.apply(r),
+                             in_axes=1, out_axes=1)(R)
+            basis = [X, W] + ([P] if P is not None else [])
+            S = jnp.concatenate(basis, axis=1)
+            # orthonormalise the trial space
+            Q, _ = jnp.linalg.qr(S)
+            AQ = spmm(self.Ad, Q)
+            G = Q.T @ AQ
+            w_all, V = jnp.linalg.eigh((G + G.T) / 2)
+            if smallest:
+                idx = jnp.argsort(w_all)[:k]
+            else:
+                idx = jnp.argsort(-w_all)[:k]
+            X_new = Q @ V[:, idx]
+            P = X_new - X @ (X.T @ X_new)
+            X = X_new
+        order = np.argsort(np.asarray(lam)) if smallest else \
+            np.argsort(-np.asarray(lam))
+        lam_np = np.asarray(lam)[order] + self.shift
+        vecs = np.asarray(X)[:, order]
+        status = SolveStatus.SUCCESS if it_done < self.max_iters else \
+            SolveStatus.NOT_CONVERGED
+        return EigenResult(eigenvalues=lam_np, eigenvectors=vecs,
+                           iterations=it_done, status=status)
+
+
+@register_eigensolver("JACOBI_DAVIDSON")
+class JacobiDavidsonSolver(EigenSolver):
+    """Davidson method with diagonal (Jacobi) correction preconditioner
+    (``jacobi_davidson.cu`` behavioural parity)."""
+
+    def _solve_impl(self, x0):
+        n = self.Ad.n
+        m_max = min(max(20, 2 * self.wanted_count + 10), n)
+        diag = np.asarray(self.Ad.diag, dtype=np.float64).reshape(-1)
+        if diag.ndim > 1:
+            diag = np.ones(n)
+        mv = jax.jit(lambda x: self._op(x))
+        V = np.zeros((m_max, n))
+        v = np.array(x0, dtype=np.float64)
+        v /= np.linalg.norm(v)
+        V[0] = v
+        m = 1
+        theta = 0.0
+        u = v
+        it_done = 0
+        for it in range(self.max_iters):
+            W = np.stack([np.asarray(mv(jnp.asarray(V[i],
+                                                    dtype=self.Ad.dtype)),
+                                     dtype=np.float64)
+                          for i in range(m)])
+            H = V[:m] @ W.T
+            w_all, S = np.linalg.eigh((H + H.T) / 2)
+            pick = -1 if self.which == "largest" else 0
+            theta = w_all[pick]
+            u = V[:m].T @ S[:, pick]
+            r = np.asarray(mv(jnp.asarray(u, dtype=self.Ad.dtype)),
+                           dtype=np.float64) - theta * u
+            it_done = it + 1
+            if np.linalg.norm(r) <= self.tolerance * max(abs(theta), 1e-300):
+                break
+            # Davidson correction with diagonal preconditioner
+            denom = diag - theta
+            denom[np.abs(denom) < 1e-12] = 1e-12
+            t = -r / denom
+            # orthogonalise against V
+            t = t - V[:m].T @ (V[:m] @ t)
+            nt = np.linalg.norm(t)
+            if nt < 1e-14 or m >= m_max:
+                # restart with current best
+                V[0] = u / np.linalg.norm(u)
+                m = 1
+                continue
+            V[m] = t / nt
+            m += 1
+        status = SolveStatus.SUCCESS if it_done < self.max_iters else \
+            SolveStatus.NOT_CONVERGED
+        return EigenResult(eigenvalues=np.array([theta + self.shift]),
+                           eigenvectors=u[:, None],
+                           iterations=it_done, status=status)

@@ -1,0 +1,106 @@
+"""Eigensolver tests (reference: core/tests/eigensolver_test.cu)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.config import AMGConfig
+from amgx_tpu.eigen import EigenSolverFactory
+from amgx_tpu.io import poisson5pt
+
+
+def _ref_extreme_eigs(A, k=4):
+    import numpy.linalg as la
+    w = la.eigvalsh(A.toarray())
+    return w
+
+
+@pytest.fixture(scope="module")
+def system():
+    A = poisson5pt(12, 12)
+    w = _ref_extreme_eigs(A)
+    return A, w
+
+
+def _run(name, A, extra=""):
+    cfg = AMGConfig(f"config_version=2, eig_solver(e)={name}, "
+                    f"e:eig_max_iters=300, e:eig_tolerance=1e-9{extra}")
+    es = EigenSolverFactory.allocate(cfg)
+    es.setup(amgx.Matrix(A))
+    return es.solve()
+
+
+def test_power_iteration(system):
+    A, w = system
+    res = _run("POWER_ITERATION", A)
+    assert abs(res.eigenvalues[0] - w[-1]) < 1e-5 * abs(w[-1])
+
+
+def test_inverse_iteration(system):
+    A, w = system
+    res = _run("INVERSE_ITERATION", A,
+               ", e:solver(il)=PCG, il:max_iters=50, il:monitor_residual=0")
+    assert abs(res.eigenvalues[0] - w[0]) < 1e-4 * abs(w[-1])
+
+
+def test_subspace_iteration(system):
+    A, w = system
+    res = _run("SUBSPACE_ITERATION", A, ", e:eig_wanted_count=3")
+    got = np.sort(np.abs(res.eigenvalues))[::-1]
+    ref = np.sort(np.abs(w))[::-1][:3]
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_lanczos():
+    # non-square grid: no degenerate eigenvalues (single-vector Lanczos
+    # cannot see eigenvalue multiplicities)
+    A = poisson5pt(12, 11)
+    w = _ref_extreme_eigs(A)
+    res = _run("LANCZOS", A, ", e:eig_wanted_count=3")
+    got = np.sort(np.abs(res.eigenvalues))[::-1]
+    ref = np.sort(np.abs(w))[::-1][:3]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_arnoldi_nonsymmetric():
+    A = poisson5pt(10, 10).tolil()
+    for i in range(99):
+        A[i, i + 1] -= 0.3
+    A = sp.csr_matrix(A)
+    wref = np.linalg.eigvals(A.toarray())
+    res = _run("ARNOLDI", A, ", e:eig_wanted_count=1")
+    top = wref[np.argmax(np.abs(wref))]
+    assert abs(abs(res.eigenvalues[0]) - abs(top)) < 1e-4 * abs(top)
+
+
+def test_lobpcg_smallest(system):
+    A, w = system
+    res = _run("LOBPCG", A, ", e:eig_wanted_count=2, e:eig_which=smallest")
+    np.testing.assert_allclose(np.sort(res.eigenvalues), w[:2], rtol=1e-4)
+
+
+def test_jacobi_davidson(system):
+    A, w = system
+    res = _run("JACOBI_DAVIDSON", A)
+    assert abs(res.eigenvalues[0] - w[-1]) < 1e-5 * abs(w[-1])
+
+
+def test_pagerank():
+    # small web graph
+    rng = np.random.default_rng(2)
+    n = 60
+    A = sp.random(n, n, density=0.1, random_state=np.random.RandomState(4),
+                  format="csr")
+    A.setdiag(1.0)
+    A = sp.csr_matrix(A)
+    res = _run("PAGERANK", A, ", e:eig_damping_factor=0.85")
+    x = res.eigenvectors[:, 0]
+    assert abs(x.sum() - 1.0) < 1e-8
+    assert (x >= 0).all()
+    # stationarity check
+    csr = sp.csr_matrix(abs(A))
+    deg = np.asarray(csr.sum(axis=1)).ravel()
+    deg[deg == 0] = 1.0
+    P = sp.csr_matrix(sp.diags(1.0/deg) @ csr)
+    y = 0.85 * (P.T @ x) + 0.85*np.sum(x[np.asarray(csr.sum(axis=1)).ravel()==0])/n + 0.15 / n
+    np.testing.assert_allclose(y / y.sum(), x, atol=1e-6)
