@@ -182,7 +182,7 @@ class LanczosSolver(EigenSolver):
 
     def _solve_impl(self, x0):
         n = self.Ad.n
-        m = min(self.max_iters, max(2 * self.wanted_count + 10, 20), n)
+        m = min(self.max_iters, n)
         V = np.zeros((m + 1, n))
         alpha = np.zeros(m)
         beta = np.zeros(m + 1)
@@ -224,7 +224,7 @@ class ArnoldiSolver(EigenSolver):
 
     def _solve_impl(self, x0):
         n = self.Ad.n
-        m = min(self.max_iters, max(2 * self.wanted_count + 10, 20), n)
+        m = min(self.max_iters, n)
         V = np.zeros((m + 1, n))
         H = np.zeros((m + 1, m))
         v = np.array(x0, dtype=np.float64)
