@@ -1,0 +1,673 @@
+"""AMGX-compatible C API surface.
+
+Python realisation of the full ``base/include/amgx_c.h`` +
+``amgx_eig_c.h`` contract (SURVEY §2.9): opaque handles + ``AMGX_*``
+functions returning :class:`~amgx_tpu.errors.RC` codes, with exceptions
+caught at the boundary exactly like the reference's ``AMGX_CATCHES``
+(``amgx_c.cu:89-91``).  The native shared library (``native/``) exports
+the same symbols as real C functions by embedding this module, so
+``amgx_capi.c``-shaped drivers link and run unchanged.
+
+Conventions: functions that have C out-params return ``(rc, value…)``
+tuples; all others return the RC alone.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import numpy as np
+
+from . import io as _io
+from .config import AMGConfig
+from .core.matrix import Matrix
+from .eigen import EigenSolverFactory
+from .errors import AMGXError, RC, SolveStatus
+from .modes import parse_mode
+from .solvers import SolverFactory
+from .utils import register_print_callback as _register_cb
+
+__all__ = [n for n in dir() if n.startswith("AMGX_")]  # populated below
+
+
+def _catches(n_outputs: int = 0):
+    """Translate exceptions into RC codes (AMGX_CATCHES analog)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            try:
+                out = fn(*args, **kwargs)
+            except AMGXError as e:
+                return (e.rc,) + (None,) * n_outputs if n_outputs else e.rc
+            except Exception:
+                return ((RC.UNKNOWN,) + (None,) * n_outputs
+                        if n_outputs else RC.UNKNOWN)
+            if n_outputs == 0:
+                return RC.OK if out is None else out
+            if not isinstance(out, tuple):
+                out = (out,)
+            return (RC.OK,) + out
+        return wrapper
+    return deco
+
+
+# ------------------------------------------------------------------ handles
+class ConfigHandle:
+    def __init__(self, cfg: AMGConfig):
+        self.cfg = cfg
+
+
+class ResourcesHandle:
+    """Reference ``Resources`` (resources.h:44-82): devices + config."""
+
+    def __init__(self, cfg: ConfigHandle, comm=None, device_num=0,
+                 devices=None):
+        self.cfg = cfg
+        self.comm = comm
+        self.devices = devices or [device_num]
+
+
+class MatrixHandle:
+    def __init__(self, rsrc: ResourcesHandle, mode):
+        self.rsrc = rsrc
+        self.mode = parse_mode(mode)
+        self.matrix: Optional[Matrix] = None
+        self.bound_vectors = []
+
+
+class VectorHandle:
+    def __init__(self, rsrc: ResourcesHandle, mode):
+        self.rsrc = rsrc
+        self.mode = parse_mode(mode)
+        self.data: Optional[np.ndarray] = None
+        self.block_dim = 1
+        self.bound_matrix: Optional[MatrixHandle] = None
+
+
+class SolverHandle:
+    def __init__(self, rsrc: ResourcesHandle, mode, cfg: ConfigHandle):
+        self.rsrc = rsrc
+        self.mode = parse_mode(mode)
+        self.cfg = cfg.cfg
+        self.solver = SolverFactory.allocate(self.cfg, "default", "solver")
+        self.last_result = None
+
+
+class EigenSolverHandle:
+    def __init__(self, rsrc: ResourcesHandle, mode, cfg: ConfigHandle):
+        self.rsrc = rsrc
+        self.mode = parse_mode(mode)
+        self.cfg = cfg.cfg
+        self.solver = EigenSolverFactory.allocate(self.cfg)
+        self.last_result = None
+
+
+# ---------------------------------------------------------------- lifecycle
+@_catches()
+def AMGX_initialize():
+    from . import initialize
+    initialize()
+
+
+@_catches()
+def AMGX_initialize_plugins():
+    pass  # eigensolvers are built in
+
+
+@_catches()
+def AMGX_finalize():
+    from . import finalize
+    finalize()
+
+
+@_catches()
+def AMGX_finalize_plugins():
+    pass
+
+
+@_catches(2)
+def AMGX_get_api_version():
+    return 2, 0
+
+
+@_catches(3)
+def AMGX_get_build_info_strings():
+    from . import __reference_version__, __version__
+    return (f"amgx_tpu {__version__}", f"API {__reference_version__}",
+            "tpu/jax backend")
+
+
+@_catches()
+def AMGX_register_print_callback(fn):
+    _register_cb(fn)
+
+
+@_catches()
+def AMGX_install_signal_handler():
+    from .utils.signals import install_signal_handlers
+    install_signal_handlers()
+
+
+@_catches()
+def AMGX_reset_signal_handler():
+    from .utils.signals import reset_signal_handlers
+    reset_signal_handlers()
+
+
+@_catches()
+def AMGX_pin_memory(arr):
+    pass  # host memory is always accessible to XLA transfers
+
+
+@_catches()
+def AMGX_unpin_memory(arr):
+    pass
+
+
+# ------------------------------------------------------------------- config
+@_catches(1)
+def AMGX_config_create(options: str):
+    return ConfigHandle(AMGConfig(options if options else
+                                  "config_version=2"))
+
+
+@_catches(1)
+def AMGX_config_create_from_file(path: str):
+    return ConfigHandle(AMGConfig.from_file(path))
+
+
+@_catches(1)
+def AMGX_config_create_from_file_and_string(path: str, options: str):
+    cfg = AMGConfig.from_file(path)
+    if options:
+        cfg.parse(options)
+    return ConfigHandle(cfg)
+
+
+@_catches()
+def AMGX_config_add_parameters(cfg: ConfigHandle, options: str):
+    cfg.cfg.parse(options)
+
+
+@_catches(1)
+def AMGX_config_get_default_number_of_rings(cfg: ConfigHandle):
+    # reference: 1 ring unless aggregation-style solvers require 2
+    # (amgx_c.cu default ring logic)
+    solver = str(cfg.cfg.get("solver"))
+    algo = str(cfg.cfg.get("algorithm"))
+    return 2 if (solver == "AMG" and algo == "AGGREGATION") else 1
+
+
+@_catches()
+def AMGX_config_destroy(cfg: ConfigHandle):
+    pass
+
+
+@_catches(1)
+def AMGX_write_parameters_description(path_or_none=None):
+    text = AMGConfig().write_parameters_description()
+    if path_or_none:
+        with open(path_or_none, "w") as f:
+            f.write(text)
+    return text
+
+
+# ---------------------------------------------------------------- resources
+@_catches(1)
+def AMGX_resources_create(cfg: ConfigHandle, comm=None, device_num=0,
+                          devices=None):
+    return ResourcesHandle(cfg, comm, device_num, devices)
+
+
+@_catches(1)
+def AMGX_resources_create_simple(cfg: ConfigHandle):
+    return ResourcesHandle(cfg)
+
+
+@_catches()
+def AMGX_resources_destroy(rsrc: ResourcesHandle):
+    pass
+
+
+# ------------------------------------------------------------------- matrix
+def _apply_mode_policy(mtx: MatrixHandle):
+    """Pin the pack to the mode's device and apply the precision policy
+    (host modes → CPU fp64; device modes → accelerator, fp64→fp32 on
+    TPU — see Mode.effective_mat_dtype)."""
+    m = mtx.matrix
+    if m is None:
+        return
+    m.placement = mtx.mode.placement_device()
+    eff = mtx.mode.effective_mat_dtype()
+    if np.dtype(m.dtype) != eff:
+        m.set(m.host.astype(eff), block_dim=m.block_dim)
+
+
+@_catches(1)
+def AMGX_matrix_create(rsrc: ResourcesHandle, mode):
+    return MatrixHandle(rsrc, mode)
+
+
+@_catches()
+def AMGX_matrix_destroy(mtx: MatrixHandle):
+    mtx.matrix = None
+
+
+@_catches()
+def AMGX_matrix_upload_all(mtx: MatrixHandle, n, nnz, block_dimx,
+                           block_dimy, row_ptrs, col_indices, data,
+                           diag_data=None):
+    """``amgx_c.h:288-296``: block-CSR upload with optional external
+    diagonal."""
+    if block_dimx != block_dimy:
+        raise AMGXError("non-square blocks are not supported",
+                        RC.NOT_SUPPORTED_BLOCKSIZE)
+    data = np.asarray(data, dtype=mtx.mode.mat_dtype)
+    m = Matrix.from_csr(np.asarray(row_ptrs), np.asarray(col_indices),
+                        data, block_dim=int(block_dimx))
+    if diag_data is not None:
+        # external diagonal (DIAG property): add to the assembled matrix
+        import scipy.sparse as sp
+        b = int(block_dimx)
+        dd = np.asarray(diag_data, dtype=mtx.mode.mat_dtype)
+        if b == 1:
+            D = sp.diags(dd.ravel())
+        else:
+            D = sp.block_diag([blk for blk in dd.reshape(-1, b, b)],
+                              format="csr")
+        m.set(sp.csr_matrix(m.host + D), block_dim=b)
+    mtx.matrix = m
+    _apply_mode_policy(mtx)
+
+
+@_catches()
+def AMGX_matrix_replace_coefficients(mtx: MatrixHandle, n, nnz, data,
+                                     diag_data=None):
+    mtx.matrix.replace_coefficients(
+        np.asarray(data, dtype=mtx.mode.mat_dtype))
+
+
+@_catches(3)
+def AMGX_matrix_get_size(mtx: MatrixHandle):
+    m = mtx.matrix
+    return m.n_block_rows, m.block_dim, m.block_dim
+
+
+@_catches(1)
+def AMGX_matrix_get_nnz(mtx: MatrixHandle):
+    return mtx.matrix.nnz // (mtx.matrix.block_dim ** 2)
+
+
+@_catches(3)
+def AMGX_matrix_download_all(mtx: MatrixHandle):
+    import scipy.sparse as sp
+    b = mtx.matrix.block_dim
+    if b == 1:
+        csr = mtx.matrix.scalar_csr()
+        return csr.indptr.copy(), csr.indices.copy(), csr.data.copy()
+    bsr = mtx.matrix.host if isinstance(mtx.matrix.host, sp.bsr_matrix) \
+        else sp.bsr_matrix(mtx.matrix.host, blocksize=(b, b))
+    return bsr.indptr.copy(), bsr.indices.copy(), bsr.data.copy()
+
+
+@_catches()
+def AMGX_matrix_vector_multiply(mtx: MatrixHandle, x: "VectorHandle",
+                                y: "VectorHandle"):
+    from .ops.spmv import spmv
+    d = mtx.matrix.device(dtype=mtx.mode.mat_dtype)
+    y.data = np.asarray(spmv(d, np.asarray(x.data, dtype=d.dtype)))
+
+
+@_catches()
+def AMGX_matrix_set_boundary_separation(mtx: MatrixHandle, flag: int):
+    mtx.boundary_separation = int(flag)
+
+
+@_catches()
+def AMGX_matrix_attach_coloring(mtx: MatrixHandle, row_coloring,
+                                num_rows, num_colors):
+    from .coloring import MatrixColoring
+    mtx.matrix.coloring = MatrixColoring(
+        colors=np.asarray(row_coloring, dtype=np.int32),
+        num_colors=int(num_colors))
+
+
+@_catches()
+def AMGX_matrix_attach_geometry(mtx: MatrixHandle, geox, geoy, geoz=None):
+    mtx.matrix.geometry = tuple(np.asarray(g) for g in
+                                (geox, geoy, geoz) if g is not None)
+
+
+# ------------------------------------------------------------------- vector
+@_catches(1)
+def AMGX_vector_create(rsrc: ResourcesHandle, mode):
+    return VectorHandle(rsrc, mode)
+
+
+@_catches()
+def AMGX_vector_destroy(vec: VectorHandle):
+    vec.data = None
+
+
+@_catches()
+def AMGX_vector_upload(vec: VectorHandle, n, block_dim, data):
+    vec.block_dim = int(block_dim)
+    vec.data = np.asarray(data, dtype=vec.mode.vec_dtype).reshape(-1).copy()
+
+
+@_catches()
+def AMGX_vector_set_zero(vec: VectorHandle, n, block_dim):
+    vec.block_dim = int(block_dim)
+    vec.data = np.zeros(int(n) * int(block_dim), dtype=vec.mode.vec_dtype)
+
+
+@_catches()
+def AMGX_vector_set_random(vec: VectorHandle, n):
+    vec.data = np.random.default_rng().standard_normal(int(n)).astype(
+        vec.mode.vec_dtype)
+
+
+@_catches(1)
+def AMGX_vector_download(vec: VectorHandle):
+    return vec.data.copy()
+
+
+@_catches(2)
+def AMGX_vector_get_size(vec: VectorHandle):
+    if vec.data is None:
+        return 0, vec.block_dim
+    return len(vec.data) // vec.block_dim, vec.block_dim
+
+
+@_catches()
+def AMGX_vector_bind(vec: VectorHandle, mtx: MatrixHandle):
+    """Attach the vector to the matrix's distribution
+    (``amgx_c.h:391-393``) so uploads are reordered/haloed identically."""
+    vec.bound_matrix = mtx
+    mtx.bound_vectors.append(vec)
+
+
+# ------------------------------------------------------------------- solver
+@_catches(1)
+def AMGX_solver_create(rsrc: ResourcesHandle, mode, cfg: ConfigHandle):
+    return SolverHandle(rsrc, mode, cfg)
+
+
+@_catches()
+def AMGX_solver_destroy(slv: SolverHandle):
+    slv.solver = None
+
+
+@_catches()
+def AMGX_solver_setup(slv: SolverHandle, mtx: MatrixHandle):
+    slv.solver.setup(mtx.matrix)
+    slv.matrix = mtx
+
+
+@_catches()
+def AMGX_solver_resetup(slv: SolverHandle, mtx: MatrixHandle):
+    if hasattr(slv.solver, "resetup"):
+        slv.solver.resetup(mtx.matrix)
+    else:
+        slv.solver.setup(mtx.matrix)
+    slv.matrix = mtx
+
+
+@_catches()
+def AMGX_solver_solve(slv: SolverHandle, rhs: VectorHandle,
+                      sol: VectorHandle):
+    res = slv.solver.solve(rhs.data, x0=sol.data)
+    slv.last_result = res
+    sol.data = np.asarray(res.x)
+
+
+@_catches()
+def AMGX_solver_solve_with_0_initial_guess(slv: SolverHandle,
+                                           rhs: VectorHandle,
+                                           sol: VectorHandle):
+    res = slv.solver.solve(rhs.data, zero_initial_guess=True)
+    slv.last_result = res
+    sol.data = np.asarray(res.x)
+
+
+@_catches(1)
+def AMGX_solver_get_iterations_number(slv: SolverHandle):
+    return 0 if slv.last_result is None else slv.last_result.iterations
+
+
+@_catches(1)
+def AMGX_solver_get_iteration_residual(slv: SolverHandle, iteration,
+                                       idx=0):
+    h = slv.last_result.residual_history
+    if h is None:
+        raise AMGXError("residual history not stored "
+                        "(set store_res_history=1)", RC.BAD_PARAMETERS)
+    return float(np.atleast_2d(h)[iteration + 1].ravel()[idx])
+
+
+@_catches(1)
+def AMGX_solver_get_status(slv: SolverHandle):
+    return (SolveStatus.SUCCESS if slv.last_result is None
+            else slv.last_result.status)
+
+
+@_catches(1)
+def AMGX_solver_calculate_residual_norm(slv: SolverHandle,
+                                        mtx: MatrixHandle,
+                                        rhs: VectorHandle,
+                                        sol: VectorHandle):
+    from .ops.spmv import spmv
+    d = mtx.matrix.device()
+    r = rhs.data - np.asarray(spmv(d, np.asarray(sol.data,
+                                                 dtype=d.dtype)))
+    return float(np.linalg.norm(r))
+
+
+# ----------------------------------------------------------------------- io
+def _resolve_rhs(sysdata, mtx: MatrixHandle):
+    if sysdata.rhs is not None:
+        return sysdata.rhs
+    cfg = mtx.rsrc.cfg.cfg
+    if int(cfg.get("rhs_from_a")):
+        e = np.ones(sysdata.A.shape[0])
+        return np.asarray(sysdata.A @ e).ravel()
+    return np.ones(sysdata.A.shape[0])
+
+
+@_catches()
+def AMGX_read_system(mtx: MatrixHandle, rhs: VectorHandle,
+                     sol: VectorHandle, path: str):
+    """``amgx_c.h:441-449``: read A (+rhs/solution when present)."""
+    sysdata = _io.read_matrix_market(path)
+    mtx.matrix = Matrix(sysdata.A.astype(mtx.mode.mat_dtype),
+                        block_dim=sysdata.block_dimx)
+    _apply_mode_policy(mtx)
+    if rhs is not None:
+        rhs.data = np.asarray(_resolve_rhs(sysdata, mtx),
+                              dtype=rhs.mode.vec_dtype)
+        rhs.block_dim = sysdata.block_dimx
+    if sol is not None:
+        n = sysdata.A.shape[0]
+        sol.data = (np.asarray(sysdata.solution, dtype=sol.mode.vec_dtype)
+                    if sysdata.solution is not None
+                    else np.zeros(n, dtype=sol.mode.vec_dtype))
+        sol.block_dim = sysdata.block_dimx
+
+
+@_catches()
+def AMGX_write_system(mtx: MatrixHandle, rhs: VectorHandle,
+                      sol: VectorHandle, path: str):
+    _io.write_matrix_market(
+        path, mtx.matrix.host,
+        rhs=None if rhs is None else rhs.data,
+        solution=None if sol is None else sol.data,
+        block_dim=mtx.matrix.block_dim)
+
+
+@_catches()
+def AMGX_read_system_global(mtx: MatrixHandle, rhs: VectorHandle,
+                            sol: VectorHandle, path: str,
+                            n_parts: int = None, part_offsets=None):
+    """Distributed read (``read_system_global``): every rank gets the
+    global system; here we read once and attach a distribution."""
+    AMGX_read_system.__wrapped__(mtx, rhs, sol, path)
+    if n_parts:
+        _maybe_distribute(mtx.matrix, n_parts, part_offsets)
+
+
+@_catches()
+def AMGX_read_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
+                                 sol: VectorHandle, path: str,
+                                 allocated_halo_depth=1, num_partitions=1,
+                                 partition_sizes=None,
+                                 partition_vector=None):
+    """``amgx_c.h:464``: partition-vector-driven read."""
+    sysdata = _io.read_matrix_market(path)
+    mtx.matrix = Matrix(sysdata.A.astype(mtx.mode.mat_dtype))
+    _apply_mode_policy(mtx)
+    if num_partitions > 1:
+        offsets = None
+        if partition_vector is not None:
+            from .distributed import partition_offsets_from_vector
+            offsets = partition_offsets_from_vector(
+                np.asarray(partition_vector), num_partitions)
+        _maybe_distribute(mtx.matrix, num_partitions, offsets)
+    if rhs is not None:
+        rhs.data = np.asarray(_resolve_rhs(sysdata, mtx),
+                              dtype=rhs.mode.vec_dtype)
+    if sol is not None:
+        n = sysdata.A.shape[0]
+        sol.data = (np.asarray(sysdata.solution, dtype=sol.mode.vec_dtype)
+                    if sysdata.solution is not None
+                    else np.zeros(n, dtype=sol.mode.vec_dtype))
+
+
+@_catches()
+def AMGX_write_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
+                                  sol: VectorHandle, path: str):
+    AMGX_write_system.__wrapped__(mtx, rhs, sol, path)
+
+
+# -------------------------------------------------------------- distributed
+
+def _maybe_distribute(matrix, n_parts, offsets=None):
+    """Attach a mesh distribution when enough devices exist; otherwise run
+    replicated on the available device(s) (the 1-rank MPI case)."""
+    import jax
+    if n_parts <= 1:
+        return
+    if len(jax.devices()) < n_parts:
+        return  # single-chip session: solve globally (mpirun -n 1 analog)
+    from .distributed import make_mesh
+    matrix.set_distribution(make_mesh(n_parts), offsets=offsets)
+
+@_catches()
+def AMGX_matrix_upload_all_global(mtx: MatrixHandle, n_global, n, nnz,
+                                  block_dimx, block_dimy, row_ptrs,
+                                  col_indices_global, data, diag_data=None,
+                                  allocated_halo_depth=1, num_import_rings=1,
+                                  partition_vector=None):
+    """``amgx_c.h:568-590``: global-index upload + partition vector.
+
+    The reference renumbers and builds B2L maps here
+    (``loadDistributedMatrix``); our shard pack does the same at
+    ``Matrix.device()`` time.
+    """
+    AMGX_matrix_upload_all.__wrapped__(
+        mtx, n, nnz, block_dimx, block_dimy, row_ptrs, col_indices_global,
+        data, diag_data)
+    if partition_vector is not None:
+        from .distributed import partition_offsets_from_vector
+        pv = np.asarray(partition_vector)
+        n_parts = int(pv.max()) + 1
+        offsets = partition_offsets_from_vector(pv, n_parts)
+        _maybe_distribute(mtx.matrix, n_parts, offsets)
+
+
+@_catches()
+def AMGX_matrix_upload_distributed(mtx: MatrixHandle, n_global, n, nnz,
+                                   block_dimx, block_dimy, row_ptrs,
+                                   col_indices_global, data, diag_data,
+                                   distribution):
+    """``amgx_c.h:592-609`` with an AMGX_distribution handle."""
+    AMGX_matrix_upload_all.__wrapped__(
+        mtx, n, nnz, block_dimx, block_dimy, row_ptrs, col_indices_global,
+        data, diag_data)
+    if distribution is not None:
+        offsets = distribution.get("partition_offsets")
+        n_parts = (len(offsets) - 1 if offsets is not None
+                   else distribution.get("num_partitions", 1))
+        _maybe_distribute(mtx.matrix, n_parts, offsets)
+
+
+@_catches(1)
+def AMGX_distribution_create(cfg: ConfigHandle = None):
+    return {"partition_offsets": None, "num_partitions": 1}
+
+
+@_catches()
+def AMGX_distribution_set_partition_data(dist, kind, data):
+    dist["partition_offsets"] = np.asarray(data)
+    dist["num_partitions"] = len(data) - 1
+
+
+@_catches()
+def AMGX_distribution_destroy(dist):
+    pass
+
+
+@_catches(2)
+def AMGX_generate_distributed_poisson_7pt(mtx: MatrixHandle,
+                                          rhs: VectorHandle,
+                                          sol: VectorHandle,
+                                          nx, ny, nz, px=1, py=1, pz=1):
+    """``amgx_c.h:515-526`` — built-in distributed Poisson assembly."""
+    A, pv = _io.generate_distributed_poisson_7pt(nx, ny, nz, px, py, pz)
+    mtx.matrix = Matrix(A.astype(mtx.mode.mat_dtype))
+    _apply_mode_policy(mtx)
+    n_parts = px * py * pz
+    if n_parts > 1:
+        from .distributed import partition_offsets_from_vector
+        offsets = partition_offsets_from_vector(pv, n_parts)
+        _maybe_distribute(mtx.matrix, n_parts, offsets)
+    n = A.shape[0]
+    if rhs is not None:
+        rhs.data = np.ones(n, dtype=rhs.mode.vec_dtype)
+    if sol is not None:
+        sol.data = np.zeros(n, dtype=sol.mode.vec_dtype)
+    return A, pv
+
+
+# -------------------------------------------------------------- eigensolver
+@_catches(1)
+def AMGX_eigensolver_create(rsrc: ResourcesHandle, mode,
+                            cfg: ConfigHandle):
+    return EigenSolverHandle(rsrc, mode, cfg)
+
+
+@_catches()
+def AMGX_eigensolver_setup(es: EigenSolverHandle, mtx: MatrixHandle):
+    es.solver.setup(mtx.matrix)
+
+
+@_catches()
+def AMGX_eigensolver_pagerank_setup(es: EigenSolverHandle,
+                                    vec: VectorHandle = None):
+    es.solver.pagerank_setup(None if vec is None else vec.data)
+
+
+@_catches()
+def AMGX_eigensolver_solve(es: EigenSolverHandle, x: VectorHandle):
+    res = es.solver.solve(x.data if x is not None and x.data is not None
+                          else None)
+    es.last_result = res
+    if x is not None and res.eigenvectors is not None:
+        x.data = np.asarray(res.eigenvectors[:, 0])
+
+
+@_catches()
+def AMGX_eigensolver_destroy(es: EigenSolverHandle):
+    es.solver = None
+
+
+__all__ = [n for n in dict(globals()) if n.startswith("AMGX_")]

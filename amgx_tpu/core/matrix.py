@@ -102,6 +102,8 @@ class Matrix:
         self._device_dtype = None
         #: distribution spec: (mesh, axis, offsets, n_loc) or None
         self.dist = None
+        #: optional jax.Device to pin the pack to (host modes → CPU)
+        self.placement = None
         if a is not None:
             self.set(a, block_dim=block_dim)
 
@@ -142,12 +144,15 @@ class Matrix:
         m = cls()
         m.block_dim = b
         m.dtype = np.dtype(data.dtype)
+        # copy: upload semantics (the caller keeps ownership of its arrays,
+        # AMGX_matrix_upload_all copies to the library, amgx_c.h:288-296)
         if b == 1:
-            m._host = sp.csr_matrix((data.ravel(), indices, indptr),
-                                    shape=(n_rows, n_cols))
+            m._host = sp.csr_matrix(
+                (data.ravel().copy(), indices.copy(), indptr.copy()),
+                shape=(n_rows, n_cols))
         else:
-            blocks = data.reshape(-1, b, b)
-            m._host = sp.bsr_matrix((blocks, indices, indptr),
+            blocks = data.reshape(-1, b, b).copy()
+            m._host = sp.bsr_matrix((blocks, indices.copy(), indptr.copy()),
                                     shape=(n_rows * b, n_cols * b))
         m._host.sort_indices()
         return m
@@ -204,6 +209,11 @@ class Matrix:
         else:
             self._device = pack_device(self._host, self.block_dim, dtype,
                                        ell_max_width)
+            if self.placement is not None:
+                import jax
+                dev = self.placement
+                self._device = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, dev), self._device)
         self._device_dtype = dtype
         return self._device
 

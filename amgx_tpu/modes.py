@@ -67,6 +67,27 @@ class Mode:
 
         return jax.default_backend()
 
+    def placement_device(self):
+        """The jax.Device data should live on: CPU for host modes, the
+        default accelerator for device modes."""
+        import jax
+
+        if self.mem_space == "host":
+            return jax.local_devices(backend="cpu")[0]
+        return jax.devices()[0]
+
+    def effective_mat_dtype(self):
+        """Device-mode fp64 falls back to fp32 on TPU (fp64 is
+        emulated/unsupported there; mirrors the honest-precision note of
+        SURVEY §7 hard-part 6 — hDDI keeps true fp64 on the host)."""
+        import jax
+
+        if (self.mem_space == "device"
+                and jax.default_backend() not in ("cpu",)
+                and self.mat_dtype == np.dtype(np.float64)):
+            return np.dtype(np.float32)
+        return self.mat_dtype
+
 
 def parse_mode(mode: "str | int | Mode") -> Mode:
     """Parse a mode string like ``dDDI`` (or AMGX_Mode integer) into a Mode."""

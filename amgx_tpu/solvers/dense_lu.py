@@ -34,7 +34,15 @@ class DenseLUSolver(Solver):
             big = np.eye(self.Ad.n, dtype=dense.dtype)
             big[np.ix_(pm, pm)] = dense
             dense = big
-        self._lu, self._piv = jax.scipy.linalg.lu_factor(jnp.asarray(dense))
+        # factorise on the same device the pack lives on (host modes pin
+        # to CPU — fp64 LU must not run on the TPU)
+        dense_dev = jnp.asarray(dense)
+        try:
+            dense_dev = jax.device_put(dense, list(
+                self.Ad.vals.devices())[0])
+        except Exception:
+            pass
+        self._lu, self._piv = jax.scipy.linalg.lu_factor(dense_dev)
 
     def solve_iteration(self, b, x, state, iter_idx):
         x = jax.scipy.linalg.lu_solve((self._lu, self._piv), b)

@@ -1,0 +1,172 @@
+"""C-API surface tests (reference: base/tests/capi_graceful_failure.cu +
+the example flows of SURVEY §2.10)."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from amgx_tpu import capi as amgx
+from amgx_tpu.errors import RC, SolveStatus
+from amgx_tpu.io import poisson5pt, write_matrix_market
+
+
+CONFIG = ("config_version=2, solver(s)=PCG, s:preconditioner(p)=BLOCK_JACOBI,"
+          " p:max_iters=3, s:max_iters=200, s:monitor_residual=1, "
+          "s:tolerance=1e-9, s:convergence=RELATIVE_INI, "
+          "s:store_res_history=1")
+
+
+def _setup_handles(config=CONFIG, mode="dDDI"):
+    rc, cfg = amgx.AMGX_config_create(config)
+    assert rc == RC.OK
+    rc, rsrc = amgx.AMGX_resources_create_simple(cfg)
+    rc, A = amgx.AMGX_matrix_create(rsrc, mode)
+    rc, b = amgx.AMGX_vector_create(rsrc, mode)
+    rc, x = amgx.AMGX_vector_create(rsrc, mode)
+    return cfg, rsrc, A, b, x
+
+
+def test_full_capi_flow():
+    assert amgx.AMGX_initialize() == RC.OK
+    cfg, rsrc, A, b, x = _setup_handles()
+    M = poisson5pt(10, 10)
+    csr = sp.csr_matrix(M)
+    rc = amgx.AMGX_matrix_upload_all(A, 100, csr.nnz, 1, 1, csr.indptr,
+                                     csr.indices, csr.data)
+    assert rc == RC.OK
+    rc, n, bx, by = amgx.AMGX_matrix_get_size(A)
+    assert (n, bx, by) == (100, 1, 1)
+    rc, nnz = amgx.AMGX_matrix_get_nnz(A)
+    assert nnz == csr.nnz
+    rc = amgx.AMGX_vector_upload(b, 100, 1, np.ones(100))
+    assert rc == RC.OK
+    rc = amgx.AMGX_vector_set_zero(x, 100, 1)
+    rc, solver = amgx.AMGX_solver_create(rsrc, "dDDI", cfg)
+    assert rc == RC.OK
+    assert amgx.AMGX_solver_setup(solver, A) == RC.OK
+    assert amgx.AMGX_solver_solve(solver, b, x) == RC.OK
+    rc, status = amgx.AMGX_solver_get_status(solver)
+    assert status == SolveStatus.SUCCESS
+    rc, iters = amgx.AMGX_solver_get_iterations_number(solver)
+    assert iters > 0
+    rc, r0 = amgx.AMGX_solver_get_iteration_residual(solver, 0)
+    assert r0 > 0
+    rc, xs = amgx.AMGX_vector_download(x)
+    resid = np.linalg.norm(np.ones(100) - M @ xs)
+    assert resid < 1e-7
+    rc, nrm = amgx.AMGX_solver_calculate_residual_norm(solver, A, b, x)
+    assert abs(nrm - resid) < 1e-10
+
+
+def test_matrix_vector_multiply_and_download(rng):
+    cfg, rsrc, A, b, x = _setup_handles()
+    M = sp.csr_matrix(poisson5pt(6, 6))
+    amgx.AMGX_matrix_upload_all(A, 36, M.nnz, 1, 1, M.indptr, M.indices,
+                                M.data)
+    v = rng.standard_normal(36)
+    amgx.AMGX_vector_upload(b, 36, 1, v)
+    amgx.AMGX_matrix_vector_multiply(A, b, x)
+    np.testing.assert_allclose(x.data, M @ v, rtol=1e-12)
+    rc, indptr, indices, data = amgx.AMGX_matrix_download_all(A)
+    np.testing.assert_array_equal(indptr, M.indptr)
+    np.testing.assert_allclose(data, M.data)
+
+
+def test_replace_coefficients_and_resetup():
+    cfg, rsrc, A, b, x = _setup_handles()
+    M = sp.csr_matrix(poisson5pt(8, 8))
+    amgx.AMGX_matrix_upload_all(A, 64, M.nnz, 1, 1, M.indptr, M.indices,
+                                M.data)
+    rc, solver = amgx.AMGX_solver_create(rsrc, "dDDI", cfg)
+    amgx.AMGX_solver_setup(solver, A)
+    amgx.AMGX_matrix_replace_coefficients(A, 64, M.nnz, M.data * 2.0)
+    assert amgx.AMGX_solver_resetup(solver, A) == RC.OK
+    amgx.AMGX_vector_upload(b, 64, 1, np.ones(64))
+    amgx.AMGX_vector_set_zero(x, 64, 1)
+    amgx.AMGX_solver_solve(solver, b, x)
+    resid = np.linalg.norm(np.ones(64) - 2 * M @ x.data)
+    assert resid < 1e-7
+
+
+def test_read_write_system(tmp_path, rng):
+    path = str(tmp_path / "sys.mtx")
+    M = sp.csr_matrix(poisson5pt(5, 5))
+    bb = rng.standard_normal(25)
+    write_matrix_market(path, M, rhs=bb)
+    cfg, rsrc, A, b, x = _setup_handles()
+    assert amgx.AMGX_read_system(A, b, x, path) == RC.OK
+    np.testing.assert_allclose(b.data, bb)
+    out = str(tmp_path / "out.mtx")
+    assert amgx.AMGX_write_system(A, b, x, out) == RC.OK
+    cfg2, rsrc2, A2, b2, x2 = _setup_handles()
+    amgx.AMGX_read_system(A2, b2, x2, out)
+    np.testing.assert_allclose((A2.matrix.host - M).toarray(), 0,
+                               atol=1e-14)
+
+
+def test_graceful_failures():
+    # reference: capi_graceful_failure.cu — errors become RC codes
+    rc, cfg = amgx.AMGX_config_create("config_version=2, cycle=Q")
+    assert rc == RC.BAD_CONFIGURATION
+    rc, cfg = amgx.AMGX_config_create(CONFIG)
+    rc, rsrc = amgx.AMGX_resources_create_simple(cfg)
+    rc, A = amgx.AMGX_matrix_create(rsrc, "dQQQ")
+    assert rc == RC.BAD_MODE
+    rc, A = amgx.AMGX_matrix_create(rsrc, "dDDI")
+    rc, bad = amgx.AMGX_matrix_create(None, "dDDI")  # works: rsrc unused
+    rc = amgx.AMGX_read_system(A, None, None, "/nonexistent/file.mtx")
+    assert rc != RC.OK
+
+
+def test_build_info_and_params_description(tmp_path):
+    rc, v1, v2, v3 = amgx.AMGX_get_build_info_strings()
+    assert "amgx_tpu" in v1
+    rc, major_minor = amgx.AMGX_get_api_version()[:2], None
+    p = str(tmp_path / "params.json")
+    rc, text = amgx.AMGX_write_parameters_description(p)
+    assert rc == RC.OK
+    import json
+    desc = json.loads(open(p).read())
+    assert "tolerance" in desc
+
+
+def test_generate_poisson_and_distributed_solve():
+    cfg_str = ("config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+               "out:monitor_residual=1, out:tolerance=1e-8, "
+               "out:convergence=RELATIVE_INI, "
+               "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+               "amg:selector=SIZE_2, amg:max_iters=1, "
+               "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+               "amg:presweeps=1, amg:postsweeps=2, amg:min_coarse_rows=16, "
+               "amg:coarse_solver=DENSE_LU_SOLVER")
+    cfg, rsrc, A, b, x = _setup_handles(cfg_str)
+    rc, Am, pv = amgx.AMGX_generate_distributed_poisson_7pt(
+        A, b, x, 4, 4, 4, 2, 2, 2)
+    assert rc == RC.OK
+    amgx.AMGX_vector_bind(b, A)
+    amgx.AMGX_vector_bind(x, A)
+    rc, solver = amgx.AMGX_solver_create(rsrc, "dDDI", cfg)
+    assert amgx.AMGX_solver_setup(solver, A) == RC.OK
+    assert amgx.AMGX_solver_solve_with_0_initial_guess(solver, b, x) == RC.OK
+    rc, nrm = amgx.AMGX_solver_calculate_residual_norm(solver, A, b, x)
+    assert nrm < 1e-5
+
+
+def test_eigensolver_capi():
+    cfg_str = ("config_version=2, eig_solver(e)=LANCZOS, "
+               "e:eig_max_iters=100, e:eig_tolerance=1e-8, "
+               "e:eig_wanted_count=1")
+    rc, cfg = amgx.AMGX_config_create(cfg_str)
+    assert rc == RC.OK
+    rc, rsrc = amgx.AMGX_resources_create_simple(cfg)
+    rc, A = amgx.AMGX_matrix_create(rsrc, "dDDI")
+    M = sp.csr_matrix(poisson5pt(8, 7))
+    amgx.AMGX_matrix_upload_all(A, 56, M.nnz, 1, 1, M.indptr, M.indices,
+                                M.data)
+    rc, es = amgx.AMGX_eigensolver_create(rsrc, "dDDI", cfg)
+    assert rc == RC.OK
+    assert amgx.AMGX_eigensolver_setup(es, A) == RC.OK
+    rc, xv = amgx.AMGX_vector_create(rsrc, "dDDI")
+    assert amgx.AMGX_eigensolver_solve(es, xv) == RC.OK
+    lam = es.last_result.eigenvalues[0]
+    wref = np.linalg.eigvalsh(M.toarray()).max()
+    assert abs(lam - wref) < 1e-5 * wref
