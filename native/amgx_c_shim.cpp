@@ -1,0 +1,662 @@
+/* amgx_c_shim.cpp — native implementation of the AMGX C ABI.
+ *
+ * Exports real C symbols (AMGX_initialize, AMGX_solver_solve, ...) from a
+ * shared library by embedding the CPython interpreter and delegating to
+ * amgx_tpu.capi (which drives the JAX/XLA TPU runtime).  Existing C
+ * drivers written against the reference (examples/amgx_capi.c style) link
+ * against libamgx_tpu_c.so and run unchanged.
+ *
+ * Array arguments cross the boundary zero-copy via numpy views of the
+ * caller's buffers (the Python side copies on upload, preserving AMGX's
+ * caller-owns-memory contract).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "amgx_tpu_c.h"
+
+namespace {
+
+std::mutex g_mutex;
+PyObject *g_capi = nullptr;        /* amgx_tpu.capi module */
+PyObject *g_print_cb_obj = nullptr;
+AMGX_print_callback g_print_cb = nullptr;
+
+struct Handle {
+    PyObject *obj;
+};
+
+const char *mode_name(AMGX_Mode m) {
+    static const char *names[] = {"hDDI", "hDFI", "hFFI", "dDDI", "dDFI",
+                                  "dFFI", "hZZI", "hZCI", "hCCI", "dZZI",
+                                  "dZCI", "dCCI"};
+    if (m < 0 || m > 11) return "dDDI";
+    return names[m];
+}
+
+/* data dtype per mode's matrix precision */
+int mode_mat_typenum(AMGX_Mode m) {
+    switch (m) {
+        case AMGX_mode_hDDI: case AMGX_mode_dDDI: return NPY_FLOAT64;
+        case AMGX_mode_hDFI: case AMGX_mode_dDFI: return NPY_FLOAT32;
+        case AMGX_mode_hFFI: case AMGX_mode_dFFI: return NPY_FLOAT32;
+        case AMGX_mode_hZZI: case AMGX_mode_dZZI: return NPY_COMPLEX128;
+        case AMGX_mode_hZCI: case AMGX_mode_dZCI: return NPY_COMPLEX64;
+        case AMGX_mode_hCCI: case AMGX_mode_dCCI: return NPY_COMPLEX64;
+        default: return NPY_FLOAT64;
+    }
+}
+
+int mode_vec_typenum(AMGX_Mode m) {
+    switch (m) {
+        case AMGX_mode_hFFI: case AMGX_mode_dFFI: return NPY_FLOAT32;
+        case AMGX_mode_hZZI: case AMGX_mode_dZZI:
+        case AMGX_mode_hZCI: case AMGX_mode_dZCI: return NPY_COMPLEX128;
+        case AMGX_mode_hCCI: case AMGX_mode_dCCI: return NPY_COMPLEX64;
+        default: return NPY_FLOAT64;
+    }
+}
+
+AMGX_RC ensure_init() {
+    if (g_capi) return AMGX_RC_OK;
+    if (!Py_IsInitialized()) {
+        Py_InitializeEx(0);
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    if (_import_array() < 0) {
+        PyErr_Clear();
+        PyGILState_Release(st);
+        return AMGX_RC_INTERNAL;
+    }
+    PyObject *mod = PyImport_ImportModule("amgx_tpu.capi");
+    if (!mod) {
+        PyErr_Print();
+        PyGILState_Release(st);
+        return AMGX_RC_PLUGIN;
+    }
+    g_capi = mod;
+    PyGILState_Release(st);
+    return AMGX_RC_OK;
+}
+
+AMGX_RC rc_from_long(long v) { return (AMGX_RC)v; }
+
+/* call capi.<name>(args); returns new ref or nullptr */
+PyObject *call(const char *name, PyObject *args) {
+    PyObject *fn = PyObject_GetAttrString(g_capi, name);
+    if (!fn) { Py_XDECREF(args); return nullptr; }
+    PyObject *out = PyObject_CallObject(fn, args);
+    Py_DECREF(fn);
+    Py_XDECREF(args);
+    if (!out) PyErr_Print();
+    return out;
+}
+
+/* result is either an int RC, or a tuple (rc, out0, out1, ...) */
+AMGX_RC unpack_rc(PyObject *out, std::vector<PyObject *> *outputs = nullptr) {
+    if (!out) return AMGX_RC_UNKNOWN;
+    AMGX_RC rc = AMGX_RC_UNKNOWN;
+    if (PyTuple_Check(out)) {
+        rc = rc_from_long(PyLong_AsLong(PyTuple_GetItem(out, 0)));
+        if (outputs) {
+            for (Py_ssize_t i = 1; i < PyTuple_Size(out); ++i) {
+                PyObject *o = PyTuple_GetItem(out, i);
+                Py_INCREF(o);
+                outputs->push_back(o);
+            }
+        }
+    } else if (PyLong_Check(out)) {
+        rc = rc_from_long(PyLong_AsLong(out));
+    }
+    Py_DECREF(out);
+    return rc;
+}
+
+Handle *wrap(PyObject *obj) {
+    Handle *h = new Handle{obj};
+    return h;
+}
+
+PyObject *obj(void *handle) {
+    if (!handle) Py_RETURN_NONE;
+    PyObject *o = static_cast<Handle *>(handle)->obj;
+    Py_INCREF(o);
+    return o;
+}
+
+void drop(void *handle) {
+    if (!handle) return;
+    Handle *h = static_cast<Handle *>(handle);
+    PyGILState_STATE st = PyGILState_Ensure();
+    Py_XDECREF(h->obj);
+    PyGILState_Release(st);
+    delete h;
+}
+
+PyObject *np_view(const void *data, npy_intp n, int typenum) {
+    if (!data) Py_RETURN_NONE;
+    return PyArray_SimpleNewFromData(1, &n, typenum,
+                                     const_cast<void *>(data));
+}
+
+struct Gil {
+    PyGILState_STATE st;
+    Gil() { st = PyGILState_Ensure(); }
+    ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+AMGX_RC AMGX_initialize(void) {
+    std::lock_guard<std::mutex> lk(g_mutex);
+    AMGX_RC rc = ensure_init();
+    if (rc != AMGX_RC_OK) return rc;
+    Gil gil;
+    return unpack_rc(call("AMGX_initialize", PyTuple_New(0)));
+}
+
+AMGX_RC AMGX_initialize_plugins(void) { return AMGX_RC_OK; }
+AMGX_RC AMGX_finalize_plugins(void) { return AMGX_RC_OK; }
+
+AMGX_RC AMGX_finalize(void) {
+    if (!g_capi) return AMGX_RC_OK;
+    Gil gil;
+    return unpack_rc(call("AMGX_finalize", PyTuple_New(0)));
+}
+
+AMGX_RC AMGX_get_api_version(int *major, int *minor) {
+    if (major) *major = 2;
+    if (minor) *minor = 0;
+    return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_pin_memory(void *, unsigned int) { return AMGX_RC_OK; }
+AMGX_RC AMGX_unpin_memory(void *) { return AMGX_RC_OK; }
+
+AMGX_RC AMGX_install_signal_handler(void) {
+    if (ensure_init() != AMGX_RC_OK) return AMGX_RC_INTERNAL;
+    Gil gil;
+    return unpack_rc(call("AMGX_install_signal_handler", PyTuple_New(0)));
+}
+
+AMGX_RC AMGX_reset_signal_handler(void) {
+    if (!g_capi) return AMGX_RC_OK;
+    Gil gil;
+    return unpack_rc(call("AMGX_reset_signal_handler", PyTuple_New(0)));
+}
+
+AMGX_RC AMGX_register_print_callback(AMGX_print_callback callback) {
+    g_print_cb = callback;
+    return AMGX_RC_OK; /* messages route through python stdout otherwise */
+}
+
+/* ------------------------------------------------------------- config */
+AMGX_RC AMGX_config_create(AMGX_config_handle *cfg, const char *options) {
+    if (ensure_init() != AMGX_RC_OK) return AMGX_RC_INTERNAL;
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_config_create", Py_BuildValue("(s)", options)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *cfg = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_config_create_from_file(AMGX_config_handle *cfg,
+                                     const char *param_file) {
+    if (ensure_init() != AMGX_RC_OK) return AMGX_RC_INTERNAL;
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(call("AMGX_config_create_from_file",
+                                Py_BuildValue("(s)", param_file)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *cfg = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_config_create_from_file_and_string(AMGX_config_handle *cfg,
+                                                const char *param_file,
+                                                const char *options) {
+    if (ensure_init() != AMGX_RC_OK) return AMGX_RC_INTERNAL;
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_config_create_from_file_and_string",
+             Py_BuildValue("(ss)", param_file, options)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *cfg = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_config_add_parameters(AMGX_config_handle *cfg,
+                                   const char *options) {
+    Gil gil;
+    PyObject *args = PyTuple_Pack(2, static_cast<Handle *>(*cfg)->obj,
+                                  PyUnicode_FromString(options));
+    return unpack_rc(call("AMGX_config_add_parameters", args));
+}
+
+AMGX_RC AMGX_config_get_default_number_of_rings(AMGX_config_handle cfg,
+                                                int *num_rings) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(call("AMGX_config_get_default_number_of_rings",
+                                PyTuple_Pack(1, obj(cfg))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *num_rings = (int)PyLong_AsLong(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_config_destroy(AMGX_config_handle cfg) {
+    drop(cfg);
+    return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_write_parameters_description(char *filename) {
+    Gil gil;
+    return unpack_rc(call("AMGX_write_parameters_description",
+                          Py_BuildValue("(s)", filename)));
+}
+
+/* ---------------------------------------------------------- resources */
+AMGX_RC AMGX_resources_create(AMGX_resources_handle *rsc,
+                              AMGX_config_handle cfg, void *,
+                              int device_num, const int *) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    PyObject *args = PyTuple_Pack(3, static_cast<Handle *>(cfg)->obj,
+                                  Py_None, PyLong_FromLong(device_num));
+    Py_INCREF(static_cast<Handle *>(cfg)->obj);
+    Py_INCREF(Py_None);
+    AMGX_RC rc = unpack_rc(call("AMGX_resources_create", args), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *rsc = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_resources_create_simple(AMGX_resources_handle *rsc,
+                                     AMGX_config_handle cfg) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(call("AMGX_resources_create_simple",
+                                PyTuple_Pack(1, obj(cfg))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *rsc = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_resources_destroy(AMGX_resources_handle rsc) {
+    drop(rsc);
+    return AMGX_RC_OK;
+}
+
+/* ------------------------------------------------------------- matrix */
+AMGX_RC AMGX_matrix_create(AMGX_matrix_handle *mtx,
+                           AMGX_resources_handle rsc, AMGX_Mode mode) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_matrix_create",
+             Py_BuildValue("(Os)", static_cast<Handle *>(rsc)->obj,
+                           mode_name(mode))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *mtx = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_matrix_destroy(AMGX_matrix_handle mtx) {
+    drop(mtx);
+    return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_matrix_upload_all(AMGX_matrix_handle mtx, int n, int nnz,
+                               int block_dimx, int block_dimy,
+                               const int *row_ptrs, const int *col_indices,
+                               const void *data, const void *diag_data) {
+    Gil gil;
+    Handle *h = static_cast<Handle *>(mtx);
+    AMGX_Mode m = AMGX_mode_dDDI;
+    /* mode from the python handle */
+    PyObject *mode_obj = PyObject_GetAttrString(h->obj, "mode");
+    PyObject *name_obj =
+        mode_obj ? PyObject_GetAttrString(mode_obj, "name") : nullptr;
+    std::string mname = name_obj ? PyUnicode_AsUTF8(name_obj) : "dDDI";
+    Py_XDECREF(name_obj);
+    Py_XDECREF(mode_obj);
+    int tn = NPY_FLOAT64;
+    if (mname.size() == 4) {
+        char c = mname[2];
+        tn = (c == 'F') ? NPY_FLOAT32
+                        : (c == 'C') ? NPY_COMPLEX64
+                                     : (c == 'Z') ? NPY_COMPLEX128
+                                                  : NPY_FLOAT64;
+    }
+    npy_intp nvals = (npy_intp)nnz * block_dimx * block_dimy;
+    PyObject *rp = np_view(row_ptrs, n + 1, NPY_INT32);
+    PyObject *ci = np_view(col_indices, nnz, NPY_INT32);
+    PyObject *dv = np_view(data, nvals, tn);
+    PyObject *dd = diag_data
+                       ? np_view(diag_data,
+                                 (npy_intp)n * block_dimx * block_dimy, tn)
+                       : (Py_INCREF(Py_None), Py_None);
+    PyObject *args = Py_BuildValue("(OiiiiOOOO)", h->obj, n, nnz,
+                                   block_dimx, block_dimy, rp, ci, dv, dd);
+    Py_DECREF(rp);
+    Py_DECREF(ci);
+    Py_DECREF(dv);
+    Py_DECREF(dd);
+    return unpack_rc(call("AMGX_matrix_upload_all", args));
+}
+
+AMGX_RC AMGX_matrix_replace_coefficients(AMGX_matrix_handle mtx, int n,
+                                         int nnz, const void *data,
+                                         const void *diag_data) {
+    Gil gil;
+    Handle *h = static_cast<Handle *>(mtx);
+    PyObject *bd = PyObject_GetAttrString(h->obj, "matrix");
+    PyObject *bdim =
+        bd ? PyObject_GetAttrString(bd, "block_dim") : nullptr;
+    long b = bdim ? PyLong_AsLong(bdim) : 1;
+    Py_XDECREF(bdim);
+    Py_XDECREF(bd);
+    PyObject *dv = np_view(data, (npy_intp)nnz * b * b, NPY_FLOAT64);
+    PyObject *args = Py_BuildValue("(OiiO)", h->obj, n, nnz, dv);
+    Py_DECREF(dv);
+    return unpack_rc(call("AMGX_matrix_replace_coefficients", args));
+}
+
+AMGX_RC AMGX_matrix_get_size(AMGX_matrix_handle mtx, int *n,
+                             int *block_dimx, int *block_dimy) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_matrix_get_size", PyTuple_Pack(1, obj(mtx))), &outs);
+    if (rc == AMGX_RC_OK && outs.size() >= 3) {
+        if (n) *n = (int)PyLong_AsLong(outs[0]);
+        if (block_dimx) *block_dimx = (int)PyLong_AsLong(outs[1]);
+        if (block_dimy) *block_dimy = (int)PyLong_AsLong(outs[2]);
+    }
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_matrix_get_nnz(AMGX_matrix_handle mtx, int *nnz) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_matrix_get_nnz", PyTuple_Pack(1, obj(mtx))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *nnz = (int)PyLong_AsLong(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_matrix_download_all(AMGX_matrix_handle mtx, int *row_ptrs,
+                                 int *col_indices, void *data, void **) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_matrix_download_all", PyTuple_Pack(1, obj(mtx))), &outs);
+    if (rc == AMGX_RC_OK && outs.size() >= 3) {
+        PyArrayObject *rp = (PyArrayObject *)PyArray_FROM_OTF(
+            outs[0], NPY_INT32, NPY_ARRAY_C_CONTIGUOUS);
+        PyArrayObject *ci = (PyArrayObject *)PyArray_FROM_OTF(
+            outs[1], NPY_INT32, NPY_ARRAY_C_CONTIGUOUS);
+        PyArrayObject *dv = (PyArrayObject *)PyArray_FROM_OTF(
+            outs[2], NPY_FLOAT64, NPY_ARRAY_C_CONTIGUOUS);
+        if (rp && row_ptrs)
+            memcpy(row_ptrs, PyArray_DATA(rp),
+                   PyArray_NBYTES(rp));
+        if (ci && col_indices)
+            memcpy(col_indices, PyArray_DATA(ci), PyArray_NBYTES(ci));
+        if (dv && data) memcpy(data, PyArray_DATA(dv), PyArray_NBYTES(dv));
+        Py_XDECREF(rp);
+        Py_XDECREF(ci);
+        Py_XDECREF(dv);
+    }
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_matrix_vector_multiply(AMGX_matrix_handle mtx,
+                                    AMGX_vector_handle x,
+                                    AMGX_vector_handle y) {
+    Gil gil;
+    return unpack_rc(call("AMGX_matrix_vector_multiply",
+                          PyTuple_Pack(3, obj(mtx), obj(x), obj(y))));
+}
+
+/* ------------------------------------------------------------- vector */
+AMGX_RC AMGX_vector_create(AMGX_vector_handle *vec,
+                           AMGX_resources_handle rsc, AMGX_Mode mode) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_vector_create",
+             Py_BuildValue("(Os)", static_cast<Handle *>(rsc)->obj,
+                           mode_name(mode))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *vec = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_vector_destroy(AMGX_vector_handle vec) {
+    drop(vec);
+    return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_vector_upload(AMGX_vector_handle vec, int n, int block_dim,
+                           const void *data) {
+    Gil gil;
+    Handle *h = static_cast<Handle *>(vec);
+    PyObject *mode_obj = PyObject_GetAttrString(h->obj, "mode");
+    PyObject *vd =
+        mode_obj ? PyObject_GetAttrString(mode_obj, "vec_dtype") : nullptr;
+    int tn = NPY_FLOAT64;
+    if (vd) {
+        PyArray_Descr *descr = nullptr;
+        if (PyArray_DescrConverter(vd, &descr) && descr) {
+            tn = descr->type_num;
+            Py_DECREF(descr);
+        }
+        Py_DECREF(vd);
+    }
+    Py_XDECREF(mode_obj);
+    PyObject *arr = np_view(data, (npy_intp)n * block_dim, tn);
+    PyObject *args = Py_BuildValue("(OiiO)", h->obj, n, block_dim, arr);
+    Py_DECREF(arr);
+    return unpack_rc(call("AMGX_vector_upload", args));
+}
+
+AMGX_RC AMGX_vector_set_zero(AMGX_vector_handle vec, int n,
+                             int block_dim) {
+    Gil gil;
+    return unpack_rc(call("AMGX_vector_set_zero",
+                          Py_BuildValue("(Oii)",
+                                        static_cast<Handle *>(vec)->obj, n,
+                                        block_dim)));
+}
+
+AMGX_RC AMGX_vector_download(AMGX_vector_handle vec, void *data) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_vector_download", PyTuple_Pack(1, obj(vec))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty() && data) {
+        PyArrayObject *arr = (PyArrayObject *)PyArray_FROM_OTF(
+            outs[0], NPY_NOTYPE, NPY_ARRAY_C_CONTIGUOUS);
+        if (arr) {
+            memcpy(data, PyArray_DATA(arr), PyArray_NBYTES(arr));
+            Py_DECREF(arr);
+        }
+    }
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_vector_get_size(AMGX_vector_handle vec, int *n,
+                             int *block_dim) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_vector_get_size", PyTuple_Pack(1, obj(vec))), &outs);
+    if (rc == AMGX_RC_OK && outs.size() >= 2) {
+        if (n) *n = (int)PyLong_AsLong(outs[0]);
+        if (block_dim) *block_dim = (int)PyLong_AsLong(outs[1]);
+    }
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_vector_bind(AMGX_vector_handle vec, AMGX_matrix_handle mtx) {
+    Gil gil;
+    return unpack_rc(
+        call("AMGX_vector_bind", PyTuple_Pack(2, obj(vec), obj(mtx))));
+}
+
+/* ------------------------------------------------------------- solver */
+AMGX_RC AMGX_solver_create(AMGX_solver_handle *slv,
+                           AMGX_resources_handle rsc, AMGX_Mode mode,
+                           AMGX_config_handle cfg) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_solver_create",
+             Py_BuildValue("(OsO)", static_cast<Handle *>(rsc)->obj,
+                           mode_name(mode),
+                           static_cast<Handle *>(cfg)->obj)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *slv = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_solver_destroy(AMGX_solver_handle slv) {
+    drop(slv);
+    return AMGX_RC_OK;
+}
+
+AMGX_RC AMGX_solver_setup(AMGX_solver_handle slv, AMGX_matrix_handle mtx) {
+    Gil gil;
+    return unpack_rc(
+        call("AMGX_solver_setup", PyTuple_Pack(2, obj(slv), obj(mtx))));
+}
+
+AMGX_RC AMGX_solver_resetup(AMGX_solver_handle slv,
+                            AMGX_matrix_handle mtx) {
+    Gil gil;
+    return unpack_rc(
+        call("AMGX_solver_resetup", PyTuple_Pack(2, obj(slv), obj(mtx))));
+}
+
+AMGX_RC AMGX_solver_solve(AMGX_solver_handle slv, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol) {
+    Gil gil;
+    return unpack_rc(call(
+        "AMGX_solver_solve", PyTuple_Pack(3, obj(slv), obj(rhs), obj(sol))));
+}
+
+AMGX_RC AMGX_solver_solve_with_0_initial_guess(AMGX_solver_handle slv,
+                                               AMGX_vector_handle rhs,
+                                               AMGX_vector_handle sol) {
+    Gil gil;
+    return unpack_rc(call("AMGX_solver_solve_with_0_initial_guess",
+                          PyTuple_Pack(3, obj(slv), obj(rhs), obj(sol))));
+}
+
+AMGX_RC AMGX_solver_get_iterations_number(AMGX_solver_handle slv, int *n) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(call("AMGX_solver_get_iterations_number",
+                                PyTuple_Pack(1, obj(slv))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *n = (int)PyLong_AsLong(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_solver_get_iteration_residual(AMGX_solver_handle slv, int it,
+                                           int idx, double *res) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_solver_get_iteration_residual",
+             Py_BuildValue("(Oii)", static_cast<Handle *>(slv)->obj, it,
+                           idx)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *res = PyFloat_AsDouble(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_solver_get_status(AMGX_solver_handle slv,
+                               AMGX_SOLVE_STATUS *st) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_solver_get_status", PyTuple_Pack(1, obj(slv))), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *st = (AMGX_SOLVE_STATUS)PyLong_AsLong(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+/* ----------------------------------------------------------------- io */
+AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                         AMGX_vector_handle sol, const char *filename) {
+    Gil gil;
+    PyObject *args =
+        Py_BuildValue("(OOOs)", static_cast<Handle *>(mtx)->obj,
+                      rhs ? static_cast<Handle *>(rhs)->obj : Py_None,
+                      sol ? static_cast<Handle *>(sol)->obj : Py_None,
+                      filename);
+    return unpack_rc(call("AMGX_read_system", args));
+}
+
+AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol, const char *filename) {
+    Gil gil;
+    PyObject *args =
+        Py_BuildValue("(OOOs)", static_cast<Handle *>(mtx)->obj,
+                      rhs ? static_cast<Handle *>(rhs)->obj : Py_None,
+                      sol ? static_cast<Handle *>(sol)->obj : Py_None,
+                      filename);
+    return unpack_rc(call("AMGX_write_system", args));
+}
+
+/* -------------------------------------------------------- eigensolver */
+AMGX_RC AMGX_eigensolver_create(AMGX_eigensolver_handle *es,
+                                AMGX_resources_handle rsc, AMGX_Mode mode,
+                                AMGX_config_handle cfg) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_eigensolver_create",
+             Py_BuildValue("(OsO)", static_cast<Handle *>(rsc)->obj,
+                           mode_name(mode),
+                           static_cast<Handle *>(cfg)->obj)), &outs);
+    if (rc == AMGX_RC_OK && !outs.empty()) *es = wrap(outs[0]);
+    return rc;
+}
+
+AMGX_RC AMGX_eigensolver_setup(AMGX_eigensolver_handle es,
+                               AMGX_matrix_handle mtx) {
+    Gil gil;
+    return unpack_rc(call("AMGX_eigensolver_setup",
+                          PyTuple_Pack(2, obj(es), obj(mtx))));
+}
+
+AMGX_RC AMGX_eigensolver_solve(AMGX_eigensolver_handle es,
+                               AMGX_vector_handle x) {
+    Gil gil;
+    return unpack_rc(
+        call("AMGX_eigensolver_solve", PyTuple_Pack(2, obj(es), obj(x))));
+}
+
+AMGX_RC AMGX_eigensolver_destroy(AMGX_eigensolver_handle es) {
+    drop(es);
+    return AMGX_RC_OK;
+}
+
+}  /* extern "C" */

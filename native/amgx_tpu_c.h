@@ -1,0 +1,164 @@
+/* amgx_tpu_c.h — C ABI of the TPU-native AmgX-capable solver library.
+ *
+ * Freshly authored declaration of the AMGX C contract (function names and
+ * signatures follow the public API documented in the reference's
+ * base/include/amgx_c.h so existing drivers compile unchanged; no code is
+ * copied — this is the ABI, implemented by embedding the amgx_tpu Python
+ * runtime, see amgx_c_shim.cpp).
+ */
+#ifndef AMGX_TPU_C_H
+#define AMGX_TPU_C_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* return codes (values match the reference AMGX_RC enum) */
+typedef enum {
+    AMGX_RC_OK = 0,
+    AMGX_RC_BAD_PARAMETERS = 1,
+    AMGX_RC_UNKNOWN = 2,
+    AMGX_RC_NOT_SUPPORTED_TARGET = 3,
+    AMGX_RC_NOT_SUPPORTED_BLOCKSIZE = 4,
+    AMGX_RC_CUDA_FAILURE = 5,
+    AMGX_RC_THRUST_FAILURE = 6,
+    AMGX_RC_NO_MEMORY = 7,
+    AMGX_RC_IO_ERROR = 8,
+    AMGX_RC_BAD_MODE = 9,
+    AMGX_RC_CORE = 10,
+    AMGX_RC_PLUGIN = 11,
+    AMGX_RC_BAD_CONFIGURATION = 12,
+    AMGX_RC_NOT_IMPLEMENTED = 13,
+    AMGX_RC_LICENSE_NOT_FOUND = 14,
+    AMGX_RC_INTERNAL = 15
+} AMGX_RC;
+
+typedef enum {
+    AMGX_SOLVE_SUCCESS = 0,
+    AMGX_SOLVE_FAILED = 1,
+    AMGX_SOLVE_DIVERGED = 2
+} AMGX_SOLVE_STATUS;
+
+/* modes: packed like the reference AMGX_Mode enum ordering */
+typedef enum {
+    AMGX_mode_hDDI = 0, AMGX_mode_hDFI = 1, AMGX_mode_hFFI = 2,
+    AMGX_mode_dDDI = 3, AMGX_mode_dDFI = 4, AMGX_mode_dFFI = 5,
+    AMGX_mode_hZZI = 6, AMGX_mode_hZCI = 7, AMGX_mode_hCCI = 8,
+    AMGX_mode_dZZI = 9, AMGX_mode_dZCI = 10, AMGX_mode_dCCI = 11
+} AMGX_Mode;
+
+/* opaque handles */
+typedef void *AMGX_config_handle;
+typedef void *AMGX_resources_handle;
+typedef void *AMGX_matrix_handle;
+typedef void *AMGX_vector_handle;
+typedef void *AMGX_solver_handle;
+typedef void *AMGX_eigensolver_handle;
+
+typedef void (*AMGX_print_callback)(const char *msg, int length);
+
+/* lifecycle */
+AMGX_RC AMGX_initialize(void);
+AMGX_RC AMGX_initialize_plugins(void);
+AMGX_RC AMGX_finalize(void);
+AMGX_RC AMGX_finalize_plugins(void);
+AMGX_RC AMGX_get_api_version(int *major, int *minor);
+AMGX_RC AMGX_register_print_callback(AMGX_print_callback callback);
+AMGX_RC AMGX_install_signal_handler(void);
+AMGX_RC AMGX_reset_signal_handler(void);
+AMGX_RC AMGX_pin_memory(void *ptr, unsigned int bytes);
+AMGX_RC AMGX_unpin_memory(void *ptr);
+
+/* config */
+AMGX_RC AMGX_config_create(AMGX_config_handle *cfg, const char *options);
+AMGX_RC AMGX_config_create_from_file(AMGX_config_handle *cfg,
+                                     const char *param_file);
+AMGX_RC AMGX_config_create_from_file_and_string(AMGX_config_handle *cfg,
+                                                const char *param_file,
+                                                const char *options);
+AMGX_RC AMGX_config_add_parameters(AMGX_config_handle *cfg,
+                                   const char *options);
+AMGX_RC AMGX_config_get_default_number_of_rings(AMGX_config_handle cfg,
+                                                int *num_rings);
+AMGX_RC AMGX_config_destroy(AMGX_config_handle cfg);
+AMGX_RC AMGX_write_parameters_description(char *filename);
+
+/* resources */
+AMGX_RC AMGX_resources_create(AMGX_resources_handle *rsc,
+                              AMGX_config_handle cfg, void *comm,
+                              int device_num, const int *devices);
+AMGX_RC AMGX_resources_create_simple(AMGX_resources_handle *rsc,
+                                     AMGX_config_handle cfg);
+AMGX_RC AMGX_resources_destroy(AMGX_resources_handle rsc);
+
+/* matrix */
+AMGX_RC AMGX_matrix_create(AMGX_matrix_handle *mtx,
+                           AMGX_resources_handle rsc, AMGX_Mode mode);
+AMGX_RC AMGX_matrix_destroy(AMGX_matrix_handle mtx);
+AMGX_RC AMGX_matrix_upload_all(AMGX_matrix_handle mtx, int n, int nnz,
+                               int block_dimx, int block_dimy,
+                               const int *row_ptrs, const int *col_indices,
+                               const void *data, const void *diag_data);
+AMGX_RC AMGX_matrix_replace_coefficients(AMGX_matrix_handle mtx, int n,
+                                         int nnz, const void *data,
+                                         const void *diag_data);
+AMGX_RC AMGX_matrix_get_size(AMGX_matrix_handle mtx, int *n,
+                             int *block_dimx, int *block_dimy);
+AMGX_RC AMGX_matrix_get_nnz(AMGX_matrix_handle mtx, int *nnz);
+AMGX_RC AMGX_matrix_download_all(AMGX_matrix_handle mtx, int *row_ptrs,
+                                 int *col_indices, void *data,
+                                 void **diag_data);
+AMGX_RC AMGX_matrix_vector_multiply(AMGX_matrix_handle mtx,
+                                    AMGX_vector_handle x,
+                                    AMGX_vector_handle y);
+
+/* vector */
+AMGX_RC AMGX_vector_create(AMGX_vector_handle *vec,
+                           AMGX_resources_handle rsc, AMGX_Mode mode);
+AMGX_RC AMGX_vector_destroy(AMGX_vector_handle vec);
+AMGX_RC AMGX_vector_upload(AMGX_vector_handle vec, int n, int block_dim,
+                           const void *data);
+AMGX_RC AMGX_vector_set_zero(AMGX_vector_handle vec, int n, int block_dim);
+AMGX_RC AMGX_vector_download(AMGX_vector_handle vec, void *data);
+AMGX_RC AMGX_vector_get_size(AMGX_vector_handle vec, int *n,
+                             int *block_dim);
+AMGX_RC AMGX_vector_bind(AMGX_vector_handle vec, AMGX_matrix_handle mtx);
+
+/* solver */
+AMGX_RC AMGX_solver_create(AMGX_solver_handle *slv,
+                           AMGX_resources_handle rsc, AMGX_Mode mode,
+                           AMGX_config_handle cfg);
+AMGX_RC AMGX_solver_destroy(AMGX_solver_handle slv);
+AMGX_RC AMGX_solver_setup(AMGX_solver_handle slv, AMGX_matrix_handle mtx);
+AMGX_RC AMGX_solver_resetup(AMGX_solver_handle slv, AMGX_matrix_handle mtx);
+AMGX_RC AMGX_solver_solve(AMGX_solver_handle slv, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol);
+AMGX_RC AMGX_solver_solve_with_0_initial_guess(AMGX_solver_handle slv,
+                                               AMGX_vector_handle rhs,
+                                               AMGX_vector_handle sol);
+AMGX_RC AMGX_solver_get_iterations_number(AMGX_solver_handle slv, int *n);
+AMGX_RC AMGX_solver_get_iteration_residual(AMGX_solver_handle slv, int it,
+                                           int idx, double *res);
+AMGX_RC AMGX_solver_get_status(AMGX_solver_handle slv,
+                               AMGX_SOLVE_STATUS *st);
+
+/* io */
+AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                         AMGX_vector_handle sol, const char *filename);
+AMGX_RC AMGX_write_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
+                          AMGX_vector_handle sol, const char *filename);
+
+/* eigensolver */
+AMGX_RC AMGX_eigensolver_create(AMGX_eigensolver_handle *es,
+                                AMGX_resources_handle rsc, AMGX_Mode mode,
+                                AMGX_config_handle cfg);
+AMGX_RC AMGX_eigensolver_setup(AMGX_eigensolver_handle es,
+                               AMGX_matrix_handle mtx);
+AMGX_RC AMGX_eigensolver_solve(AMGX_eigensolver_handle es,
+                               AMGX_vector_handle x);
+AMGX_RC AMGX_eigensolver_destroy(AMGX_eigensolver_handle es);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* AMGX_TPU_C_H */

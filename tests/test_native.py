@@ -1,0 +1,26 @@
+"""Native C ABI shim: build libamgx_tpu_c.so and run the C driver."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("cmake") is None or
+                    shutil.which("ninja") is None,
+                    reason="cmake/ninja unavailable")
+def test_native_capi_builds_and_runs():
+    build = os.path.join(ROOT, "native", "build")
+    subprocess.run(["cmake", "-S", os.path.join(ROOT, "native"),
+                    "-B", build, "-G", "Ninja"], check=True,
+                   capture_output=True)
+    subprocess.run(["cmake", "--build", build], check=True,
+                   capture_output=True)
+    env = dict(os.environ, PYTHONPATH=ROOT)
+    # the embedded interpreter must not inherit the pytest CPU pinning
+    env.pop("JAX_PLATFORMS", None)
+    out = subprocess.run([os.path.join(build, "amgx_capi_c")], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert "NATIVE CAPI TEST PASSED" in out.stdout, (out.stdout, out.stderr)
