@@ -1,6 +1,7 @@
 from .hierarchy import AMGHierarchy
 from .cycles import build_cycle
 from .level import AMGLevel, AggregationLevel, ClassicalLevel
+from .energymin import interpolator as _em  # registers EM
 
 __all__ = ["AMGHierarchy", "build_cycle", "AMGLevel", "AggregationLevel",
            "ClassicalLevel"]

@@ -1,0 +1,68 @@
+"""Energy-minimisation interpolation (EM).
+
+Reference: ``core/src/energymin/`` (1755 LoC, experimental) —
+``Energymin_AMG_Level_Base`` builds interpolation by minimising the energy
+‖P‖_A subject to sparsity and constant-preservation constraints, with the
+CR (compatible relaxation) selector.
+
+Implementation: start from direct (D1) interpolation and apply energy-
+decreasing constrained Jacobi iterations on P:
+
+    P ← P − ω·D⁻¹·A·P     (restricted to the allowed sparsity pattern)
+
+followed by row-sum renormalisation to preserve constants — a standard
+energy-minimisation scheme (each unconstrained step decreases the A-energy
+of every column; the pattern filter + rescale enforce the constraints).
+"""
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..classical.interpolators import (D1Interpolator,
+                                       register_interpolator,
+                                       truncate_and_scale)
+
+
+@register_interpolator("EM")
+class EnergyMinInterpolator(D1Interpolator):
+    n_energy_iters = 4
+    omega = 0.6
+
+    def compute(self, A, S, cf_map):
+        A = sp.csr_matrix(A).astype(np.float64)
+        P = super().compute(A, S, cf_map)
+        # allowed pattern: distance-2 neighbourhood of the D1 pattern
+        pattern = sp.csr_matrix(
+            (np.ones(len(P.data)), P.indices.copy(), P.indptr.copy()),
+            shape=P.shape)
+        Apat = sp.csr_matrix(
+            (np.ones(len(A.data)), A.indices.copy(), A.indptr.copy()),
+            shape=A.shape)
+        pattern = sp.csr_matrix(Apat @ pattern)
+        pattern.data[:] = 1.0
+        d = A.diagonal()
+        dinv = 1.0 / np.where(d == 0, 1.0, d)
+        Dinv = sp.diags(dinv)
+        c_rows = np.flatnonzero(cf_map > 0)
+        for _ in range(self.n_energy_iters):
+            upd = sp.csr_matrix(Dinv @ (A @ P))
+            P = sp.csr_matrix(P - self.omega * upd)
+            # filter to the allowed pattern
+            P = P.multiply(pattern).tocsr()
+            # re-impose injection on C rows
+            P = sp.lil_matrix(P)
+            cnum = np.cumsum(cf_map) - 1
+            for i in c_rows:
+                P.rows[i] = [int(cnum[i])]
+                P.data[i] = [1.0]
+            P = sp.csr_matrix(P)
+            # preserve constants: rescale rows to their D1 row sums
+            rs = np.asarray(P.sum(axis=1)).ravel()
+            scale = np.where(np.abs(rs) > 1e-14, 1.0 / np.where(
+                rs == 0, 1.0, rs), 1.0)
+            # only F rows with nonzero target need rescaling to 1
+            f_mask = cf_map == 0
+            scale = np.where(f_mask, scale, 1.0)
+            P = sp.csr_matrix(sp.diags(scale) @ P)
+        return truncate_and_scale(P, self.trunc_factor, self.max_elements)

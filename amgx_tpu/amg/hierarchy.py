@@ -154,9 +154,11 @@ class AMGHierarchy:
                 self.scope)
             S = strength.compute(Asc)
             sel_name = str(self.cfg.get("selector", self.scope))
+            interp_name = str(self.cfg.get("interpolator", self.scope))
             if self.algorithm == "ENERGYMIN":
                 sel_name = str(self.cfg.get("energymin_selector", self.scope))
-            interp_name = str(self.cfg.get("interpolator", self.scope))
+                interp_name = str(self.cfg.get("energymin_interpolator",
+                                               self.scope))
             # aggressive coarsening on the first `aggressive_levels` levels
             # switches selector/interpolator (classical_amg_level.cu:155-201)
             if idx < self.aggressive_levels:
@@ -240,6 +242,21 @@ class AMGHierarchy:
         coarse_offsets = np.concatenate([[0], np.cumsum(counts)])
         nc_loc = max(counts) + 1        # ≥1 padding slot per rank
         Ac_host = galerkin_coarse(Asc, agg_real, 1)
+        # consolidation ("glue", distributed/glue.h + amg.cu:328-390):
+        # when the coarse grid is too small per rank, migrate it off the
+        # mesh — subsequent levels run replicated
+        lower = int(self.cfg.get("matrix_consolidation_lower_threshold"))
+        if lower > 0 and nc // n_parts < lower:
+            Ac = Matrix(Ac_host)
+            n_loc_f = curd.n_loc
+            agg_pad = np.full(n_parts * n_loc_f, nc, dtype=np.int64)
+            for p in range(n_parts):
+                lo, hi = offsets[p], offsets[p + 1]
+                agg_pad[p * n_loc_f:p * n_loc_f + (hi - lo)] = \
+                    agg_real[lo:hi]
+            level = AggregationLevel(cur, idx, agg_pad, n_coarse=nc,
+                                     trash_segment=True)
+            return level, Ac, ("aggregation-consolidated", (agg_real, nc))
         Ac = Matrix(Ac_host)
         Ac.set_distribution(mesh, axis, coarse_offsets, n_loc=nc_loc)
         # aggregates in padded coordinates: fine pad rows → coarse pad slot

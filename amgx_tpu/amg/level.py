@@ -55,23 +55,36 @@ class AggregationLevel(AMGLevel):
     kind = "aggregation"
 
     def __init__(self, A: Matrix, level_index: int, aggregates: np.ndarray,
-                 n_coarse: int):
+                 n_coarse: int, trash_segment: bool = False):
+        """``trash_segment``: padded fine rows map to an extra segment
+        ``n_coarse`` that is dropped after restriction — used when the
+        coarse level is *consolidated* off the mesh (distributed fine
+        level, replicated coarse level; the reference "glue" path,
+        distributed/glue.h:73-263)."""
         super().__init__(A, level_index)
         self.aggregates = jnp.asarray(aggregates.astype(np.int32))
         self.n_coarse = int(n_coarse)
+        self.trash_segment = bool(trash_segment)
 
     def restrict_residual(self, r):
         b = self.Ad.block_dim
+        nseg = self.n_coarse + (1 if self.trash_segment else 0)
         if b == 1:
-            return jax.ops.segment_sum(r, self.aggregates,
-                                       num_segments=self.n_coarse)
-        rb = r.reshape(-1, b)
-        rc = jax.ops.segment_sum(rb, self.aggregates,
-                                 num_segments=self.n_coarse)
-        return rc.reshape(-1)
+            rc = jax.ops.segment_sum(r, self.aggregates, num_segments=nseg)
+        else:
+            rb = r.reshape(-1, b)
+            rc = jax.ops.segment_sum(rb, self.aggregates,
+                                     num_segments=nseg).reshape(-1)
+        if self.trash_segment:
+            rc = rc[:self.n_coarse * b]
+        return rc
 
     def prolongate_and_correct(self, x, e):
         b = self.Ad.block_dim
+        if self.trash_segment:
+            pad = jnp.zeros((b,), e.dtype) if b > 1 else \
+                jnp.zeros((1,), e.dtype)
+            e = jnp.concatenate([e, pad])
         if b == 1:
             return x + e[self.aggregates]
         eb = e.reshape(-1, b)

@@ -478,7 +478,7 @@ def _resolve_rhs(sysdata, mtx: MatrixHandle):
 def AMGX_read_system(mtx: MatrixHandle, rhs: VectorHandle,
                      sol: VectorHandle, path: str):
     """``amgx_c.h:441-449``: read A (+rhs/solution when present)."""
-    sysdata = _io.read_matrix_market(path)
+    sysdata = _io.read_system_auto(path)
     mtx.matrix = Matrix(sysdata.A.astype(mtx.mode.mat_dtype),
                         block_dim=sysdata.block_dimx)
     _apply_mode_policy(mtx)
@@ -497,11 +497,13 @@ def AMGX_read_system(mtx: MatrixHandle, rhs: VectorHandle,
 @_catches()
 def AMGX_write_system(mtx: MatrixHandle, rhs: VectorHandle,
                       sol: VectorHandle, path: str):
-    _io.write_matrix_market(
-        path, mtx.matrix.host,
-        rhs=None if rhs is None else rhs.data,
-        solution=None if sol is None else sol.data,
-        block_dim=mtx.matrix.block_dim)
+    writer = str(mtx.rsrc.cfg.cfg.get("matrix_writer"))
+    write = (_io.write_binary if writer == "binary"
+             else _io.write_matrix_market)
+    write(path, mtx.matrix.host,
+          rhs=None if rhs is None else rhs.data,
+          solution=None if sol is None else sol.data,
+          block_dim=mtx.matrix.block_dim)
 
 
 @_catches()
@@ -522,7 +524,7 @@ def AMGX_read_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
                                  partition_sizes=None,
                                  partition_vector=None):
     """``amgx_c.h:464``: partition-vector-driven read."""
-    sysdata = _io.read_matrix_market(path)
+    sysdata = _io.read_system_auto(path)
     mtx.matrix = Matrix(sysdata.A.astype(mtx.mode.mat_dtype))
     _apply_mode_policy(mtx)
     if num_partitions > 1:

@@ -11,6 +11,7 @@ from . import gs          # GS, MULTICOLOR_GS, FIXCOLOR_GS, KACZMARZ
 from . import dilu        # MULTICOLOR_DILU
 from . import ilu         # MULTICOLOR_ILU
 from . import scalers     # BINORMALIZATION, NBINORMALIZATION, DIAGONAL_SYMMETRIC
+from . import idr         # IDR, IDRMSYNC
 
 __all__ = ["Solver", "SolverFactory", "SolveResult", "register_solver",
            "check_convergence"]

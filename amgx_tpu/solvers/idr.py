@@ -1,0 +1,106 @@
+"""IDR(s) solvers.
+
+Reference: ``core/src/solvers/idr_solver.cu`` and ``idrmsync_solver.cu``
+(induced dimension reduction; ``subspace_dim_s`` param core.cu:416; shipped
+configs IDR_DILU.json / IDRMSYNC_DILU.json).
+
+Implementation: IDR(s) with biorthogonalisation (van Gijzen & Sonneveld),
+right-preconditioned.  IDRMSYNC (the reference's reduced-synchronisation
+variant) shares the algorithm here — on TPU the whole iteration is one
+fused XLA computation, so there are no separate synchronisation points to
+minimise.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import blas
+from ..ops.spmv import spmv
+from .base import Solver, register_solver
+from .krylov import _PrecondMixin
+
+
+class _IDRState(NamedTuple):
+    r: jax.Array
+    G: jax.Array       # (s, n) direction matrix
+    U: jax.Array       # (s, n)
+    M: jax.Array       # (s, s) P·Gᵀ
+    om: jax.Array
+
+
+@register_solver("IDR")
+class IDRSolver(_PrecondMixin, Solver):
+    def __init__(self, cfg, scope="default"):
+        super().__init__(cfg, scope)
+        self.s = int(cfg.get("subspace_dim_s", scope))
+
+    def solver_setup(self):
+        self._setup_preconditioner(True)
+        s, n = self.s, self.Ad.n
+        # fixed shadow space P (random orthonormal rows)
+        rng = np.random.default_rng(11)
+        P = rng.standard_normal((s, n))
+        P, _ = np.linalg.qr(P.T)
+        self.P = jnp.asarray(P.T[:s], dtype=self.Ad.dtype)  # (s, n)
+
+    def solve_init(self, b, x):
+        s, n = self.s, b.shape[0]
+        r = b - spmv(self.Ad, x)
+        return _IDRState(
+            r=r, G=jnp.zeros((s, n), b.dtype), U=jnp.zeros((s, n), b.dtype),
+            M=jnp.eye(s, dtype=b.dtype), om=jnp.asarray(1.0, b.dtype))
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        """One IDR(s) cycle: s intermediate steps + the (s+1)-th step.
+
+        The whole cycle is unrolled (s is small, default 8) — the
+        reference performs the same s+1 SpMVs per outer iteration.
+        """
+        s = self.s
+        r, G, U, M, om = state
+        f = self.P @ r                      # (s,)
+        for k in range(s):
+            # solve lower-triangular M[k:, k:] c = f[k:] — take first col
+            c = jnp.linalg.solve(
+                M + jnp.eye(s, dtype=M.dtype) * 1e-30, f)
+            v = r - (c[:, None] * G).sum(0)
+            v = self._apply_M(v)
+            u_new = om * v + (c[:, None] * U).sum(0)
+            g_new = spmv(self.Ad, u_new)
+            # biorthogonalise g_new against P rows < k
+            pg = self.P @ g_new             # (s,)
+            for j in range(k):
+                alpha = pg[j] / jnp.where(M[j, j] == 0, 1.0, M[j, j])
+                g_new = g_new - alpha * G[j]
+                u_new = u_new - alpha * U[j]
+                pg = self.P @ g_new
+            G = G.at[k].set(g_new)
+            U = U.at[k].set(u_new)
+            M = M.at[:, k].set(self.P @ g_new)
+            beta = f[k] / jnp.where(M[k, k] == 0, 1.0, M[k, k])
+            r = r - beta * g_new
+            x = x + beta * u_new
+            f = self.P @ r
+        # (s+1)-th step: minimise in the full space
+        v = self._apply_M(r)
+        t = spmv(self.Ad, v)
+        tt = blas.dot(t, t)
+        om = jnp.where(tt != 0, blas.dot(t, r) / jnp.where(tt == 0, 1.0, tt),
+                       0.0)
+        x = x + om * v
+        r = r - om * t
+        return x, _IDRState(r=r, G=G, U=U, M=M, om=om)
+
+    def residual_norm_estimate(self, b, x, state):
+        return blas.norm(state.r, self.norm_type, self.Ad.block_dim,
+                         self.use_scalar_norm)
+
+
+@register_solver("IDRMSYNC")
+class IDRMSyncSolver(IDRSolver):
+    """Minimal-synchronisation IDR(s) (``idrmsync_solver.cu``) — same
+    algorithm; all reductions already fuse into one XLA computation."""

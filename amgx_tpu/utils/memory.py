@@ -1,0 +1,52 @@
+"""Memory observability.
+
+Reference: ``base/include/memory_info.h`` — ``MemoryInfo`` max-usage
+tracking reported in the grid-stats table (used at ``amg.cu:1138``).
+Here: live device-buffer accounting via ``jax.live_arrays`` plus
+backend memory stats where the platform exposes them.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+
+class MemoryInfo:
+    def __init__(self):
+        self.max_bytes = 0
+
+    def current_device_bytes(self) -> int:
+        import jax
+
+        total = 0
+        for a in jax.live_arrays():
+            try:
+                total += a.nbytes
+            except Exception:
+                pass
+        return total
+
+    def update_max_memory_usage(self) -> int:
+        """Reference ``MemoryInfo::updateMaxMemoryUsage``."""
+        cur = self.current_device_bytes()
+        self.max_bytes = max(self.max_bytes, cur)
+        return self.max_bytes
+
+    def backend_stats(self) -> Dict:
+        import jax
+
+        try:
+            return dict(jax.devices()[0].memory_stats() or {})
+        except Exception:
+            return {}
+
+    def report(self) -> str:
+        self.update_max_memory_usage()
+        gb = self.max_bytes / (1 << 30)
+        return f"Maximum Memory Usage: {gb:8.3g} GB"
+
+
+_info = MemoryInfo()
+
+
+def memory_info() -> MemoryInfo:
+    return _info

@@ -1,0 +1,116 @@
+"""CPU profiler tree + phase timers.
+
+Reference: ``base/include/amgx_timer.h`` — ``Profiler_tree`` /
+``Profiler_entry`` aggregating RAII ``AMGX_CPU_PROFILER`` markers
+(``amgx_timer.h:150-274``), per-level phase timers (``levelProfile``), and
+the ``TimerMap``.  Here: nested context-manager markers aggregated in a
+tree, plus optional forwarding to ``jax.profiler.TraceAnnotation`` so
+markers show up in XLA profiles.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+_forward_to_jax = False
+
+
+def enable_jax_trace_annotations(enable: bool = True):
+    global _forward_to_jax
+    _forward_to_jax = enable
+
+
+class ProfilerEntry:
+    __slots__ = ("name", "total", "count", "children", "_start")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self.children: Dict[str, "ProfilerEntry"] = {}
+        self._start = 0.0
+
+    def child(self, name):
+        if name not in self.children:
+            self.children[name] = ProfilerEntry(name)
+        return self.children[name]
+
+
+class ProfilerTree:
+    """Singleton-ish profiler tree (reference Profiler_tree)."""
+
+    def __init__(self):
+        self.root = ProfilerEntry("root")
+        self._stack = [self.root]
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        entry = self._stack[-1].child(name)
+        self._stack.append(entry)
+        t0 = time.perf_counter()
+        ann = None
+        if _forward_to_jax:
+            import jax
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        try:
+            yield entry
+        finally:
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            entry.total += time.perf_counter() - t0
+            entry.count += 1
+            self._stack.pop()
+
+    def report(self) -> str:
+        lines = []
+
+        def rec(entry, depth):
+            if depth > 0:
+                lines.append(f"{'  ' * depth}{entry.name:<40s} "
+                             f"{entry.total:10.6f}s  x{entry.count}")
+            for c in entry.children.values():
+                rec(c, depth + 1)
+
+        rec(self.root, 0)
+        return "\n".join(lines)
+
+    def reset(self):
+        self.root = ProfilerEntry("root")
+        self._stack = [self.root]
+
+
+_tree = ProfilerTree()
+
+
+def profiler_tree() -> ProfilerTree:
+    return _tree
+
+
+def cpu_profiler(name: str):
+    """RAII marker (reference AMGX_CPU_PROFILER, amgx_timer.h:269)."""
+    return _tree.scope(name)
+
+
+class TimerMap:
+    """Named wall-clock timers (reference TimerMap, amgx_timer.h:435)."""
+
+    def __init__(self):
+        self._timers: Dict[str, float] = {}
+        self._starts: Dict[str, float] = {}
+
+    def tic(self, name):
+        self._starts[name] = time.perf_counter()
+
+    def toc(self, name) -> float:
+        dt = time.perf_counter() - self._starts.pop(name, time.perf_counter())
+        self._timers[name] = self._timers.get(name, 0.0) + dt
+        return dt
+
+    def get(self, name) -> float:
+        return self._timers.get(name, 0.0)
+
+    def report(self) -> str:
+        return "\n".join(f"{k:<30s} {v:10.6f}s"
+                         for k, v in sorted(self._timers.items()))

@@ -1,0 +1,107 @@
+"""Auxiliary subsystem tests: IO binary, profiler, determinism checker,
+memory info, matrix analysis, signal handlers (SURVEY §5)."""
+import numpy as np
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.io import (poisson5pt, read_binary, read_system_auto,
+                         write_binary, write_matrix_market)
+from amgx_tpu.utils import (analyze_matrix, checksum, cpu_profiler,
+                            determinism_checker, estimate_spectral_bounds,
+                            memory_info, profiler_tree, TimerMap)
+
+
+def test_binary_roundtrip(tmp_path, rng):
+    A = sp.csr_matrix(poisson5pt(6, 6))
+    b = rng.standard_normal(36)
+    x = rng.standard_normal(36)
+    p = str(tmp_path / "sys.bin")
+    write_binary(p, A, rhs=b, solution=x)
+    s = read_binary(p)
+    np.testing.assert_allclose((s.A - A).toarray(), 0, atol=1e-15)
+    np.testing.assert_allclose(s.rhs, b)
+    np.testing.assert_allclose(s.solution, x)
+
+
+def test_binary_block_roundtrip(tmp_path, rng):
+    bd = 2
+    dense = np.kron(poisson5pt(3, 3).toarray() != 0,
+                    np.ones((bd, bd))) * rng.standard_normal((18, 18))
+    A = sp.bsr_matrix(sp.csr_matrix(dense), blocksize=(bd, bd))
+    p = str(tmp_path / "blk.bin")
+    write_binary(p, A, block_dim=bd)
+    s = read_binary(p)
+    assert s.block_dimx == bd
+    np.testing.assert_allclose(s.A.toarray(), dense, atol=1e-15)
+
+
+def test_read_system_auto(tmp_path):
+    A = sp.csr_matrix(poisson5pt(4, 4))
+    pm = str(tmp_path / "a.mtx")
+    pb = str(tmp_path / "a.bin")
+    write_matrix_market(pm, A)
+    write_binary(pb, A)
+    s1, s2 = read_system_auto(pm), read_system_auto(pb)
+    np.testing.assert_allclose((s1.A - s2.A).toarray(), 0, atol=1e-14)
+
+
+def test_profiler_tree():
+    t = profiler_tree()
+    t.reset()
+    with cpu_profiler("setup"):
+        with cpu_profiler("coloring"):
+            pass
+        with cpu_profiler("coloring"):
+            pass
+    rep = t.report()
+    assert "setup" in rep and "coloring" in rep
+    assert t.root.children["setup"].children["coloring"].count == 2
+
+
+def test_timer_map():
+    tm = TimerMap()
+    tm.tic("solve")
+    dt = tm.toc("solve")
+    assert dt >= 0 and tm.get("solve") == dt
+    assert "solve" in tm.report()
+
+
+def test_determinism_checker():
+    d1 = determinism_checker()
+    d1.reset()
+    a = np.arange(10.0)
+    c1 = d1.checkpoint("buf", a)
+    assert c1 == checksum(a)
+    from amgx_tpu.utils.determinism import DeterminismChecker
+    d2 = DeterminismChecker()
+    d2.checkpoint("buf", a)
+    assert d1.compare(d2) == []
+    d3 = DeterminismChecker()
+    d3.checkpoint("buf", a + 1)
+    assert d1.compare(d3) == ["buf"]
+
+
+def test_memory_info():
+    mi = memory_info()
+    assert mi.update_max_memory_usage() >= 0
+    assert "Memory Usage" in mi.report()
+
+
+def test_matrix_analysis():
+    A = poisson5pt(8, 8)
+    info = analyze_matrix(A)
+    assert info["n_rows"] == 64
+    assert info["structurally_symmetric"]
+    assert info["zero_diagonal_entries"] == 0
+    assert info["max_nnz_per_row"] == 5
+    assert info["bandwidth"] == 8
+    sb = estimate_spectral_bounds(A)
+    assert 6.0 < sb["lambda_max_estimate"] <= 8.0
+    assert sb["gershgorin_upper"] == 8.0
+
+
+def test_signal_handlers_install_reset():
+    from amgx_tpu.utils.signals import (install_signal_handlers,
+                                        reset_signal_handlers)
+    install_signal_handlers()
+    reset_signal_handlers()

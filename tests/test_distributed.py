@@ -174,3 +174,29 @@ def test_distributed_classical_amg(mesh):
     x = np.asarray(res.x)
     relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
     assert relres < 1e-7, (relres, res.iterations)
+
+
+def test_consolidation_threshold(mesh):
+    # glue analog: small coarse grids migrate off the mesh
+    A = poisson7pt(8, 8, 8)
+    b = np.ones(A.shape[0])
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    cfg = amgx.AMGConfig(
+        "config_version=2, matrix_consolidation_lower_threshold=16, "
+        "solver(out)=PCG, out:max_iters=60, out:monitor_residual=1, "
+        "out:tolerance=1e-8, out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=2, "
+        "amg:postsweeps=2, amg:min_coarse_rows=8, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    levels = slv.preconditioner.hierarchy.levels
+    fmts = [lvl.Ad.fmt for lvl in levels]
+    assert fmts[0] == "sharded-ell"
+    assert any(f != "sharded-ell" for f in fmts[1:]), fmts  # consolidated
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-7

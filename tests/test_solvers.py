@@ -124,3 +124,33 @@ def test_not_converged_status():
     res = slv.solve(b)
     assert res.status == amgx.SolveStatus.NOT_CONVERGED
     assert res.iterations == 2
+
+
+@pytest.mark.parametrize("name", ["IDR", "IDRMSYNC"])
+def test_idr_solvers(name):
+    A = poisson5pt(14, 14)
+    b = np.ones(A.shape[0])
+    res, _ = _solve(BASE % (name, 60) +
+                    ", s:preconditioner(p)=BLOCK_JACOBI, p:max_iters=2, "
+                    "s:subspace_dim_s=4", A, b)
+    x = np.asarray(res.x)
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-7
+
+
+def test_energymin_amg():
+    A = poisson5pt(16, 16)
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=ENERGYMIN, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=2, "
+        "amg:postsweeps=2, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-7
+    assert res.iterations < 30
