@@ -67,10 +67,16 @@ class AMGHierarchy:
         t0 = time.perf_counter()
         reuse = (self._structure is not None and
                  self.structure_reuse_levels != 0 and A.dist is None)
-        if reuse:
-            self._setup_reuse(A)
-        else:
-            self._setup_fresh(A)
+        try:
+            if reuse:
+                self._setup_reuse(A)
+            else:
+                self._setup_fresh(A)
+        except BaseException:
+            # a partial structure must never feed a later reuse pass
+            self._structure = None
+            self.levels = []
+            raise
         self.setup_time = time.perf_counter() - t0
         if self.print_grid_stats:
             amgx_output(self.grid_stats())
@@ -78,8 +84,14 @@ class AMGHierarchy:
 
     def _setup_fresh(self, A: Matrix):
         self.levels = []
-        structure = []
-        cur = A
+        self._structure = []
+        cur = self._build_levels(A)
+        self._setup_smoothers_and_coarse(cur)
+
+    def _build_levels(self, cur: Matrix) -> Matrix:
+        """Run the fresh coarsening loop from ``cur``, appending to
+        ``self.levels`` / ``self._structure``; returns the coarsest matrix
+        (reference hot setup loop, ``amg.cu:177-450``)."""
         while True:
             n = cur.n_block_rows
             if len(self.levels) + 1 >= self.max_levels:
@@ -95,21 +107,21 @@ class AMGHierarchy:
             if nc >= self.coarsen_threshold * n or nc >= n or nc == 0:
                 break
             self.levels.append(level)
-            structure.append(struct)
+            self._structure.append(struct)
             cur = Ac
-        self._structure = structure
-        self._setup_smoothers_and_coarse(cur)
+        return cur
 
     def _setup_reuse(self, A: Matrix):
         """Keep coarsening structure; refresh numeric values
-        (``structure_reuse_levels``: N levels reuse structure)."""
+        (``structure_reuse_levels``: N levels reuse structure; the rest of
+        the hierarchy is re-coarsened fresh from the last reused level,
+        reference ``amg.cu:260-290``)."""
         cur = A
-        new_levels = []
-        for i, (level, struct) in enumerate(zip(self.levels,
-                                                self._structure)):
-            if i >= self.structure_reuse_levels and \
-                    self.structure_reuse_levels > 0:
-                # rebuild the rest fresh
+        old = list(zip(self.levels, self._structure))
+        self.levels = []
+        self._structure = []
+        for i, (level, struct) in enumerate(old):
+            if 0 < self.structure_reuse_levels <= i:
                 break
             kind, data = struct
             if kind == "aggregation":
@@ -122,9 +134,11 @@ class AMGHierarchy:
                 Ac_host = sp.csr_matrix(R_host @ cur.scalar_csr() @ P_host)
                 lvl = ClassicalLevel(cur, i, Matrix(P_host).device(),
                                      Matrix(R_host).device())
-            new_levels.append(lvl)
+            self.levels.append(lvl)
+            self._structure.append(struct)
             cur = Matrix(Ac_host, block_dim=cur.block_dim)
-        self.levels = new_levels
+        # rebuild any remaining levels fresh from the reused prefix
+        cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
 
     def _coarsen_once(self, cur: Matrix, idx: int):

@@ -14,6 +14,7 @@ tuples; all others return the RC alone.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Optional
 
 import numpy as np
@@ -40,6 +41,9 @@ def _catches(n_outputs: int = 0):
             except AMGXError as e:
                 return (e.rc,) + (None,) * n_outputs if n_outputs else e.rc
             except Exception:
+                if os.environ.get("AMGX_TPU_DEBUG"):
+                    import traceback
+                    traceback.print_exc()
                 return ((RC.UNKNOWN,) + (None,) * n_outputs
                         if n_outputs else RC.UNKNOWN)
             if n_outputs == 0:
@@ -442,7 +446,9 @@ def AMGX_solver_get_iteration_residual(slv: SolverHandle, iteration,
     if h is None:
         raise AMGXError("residual history not stored "
                         "(set store_res_history=1)", RC.BAD_PARAMETERS)
-    return float(np.atleast_2d(h)[iteration + 1].ravel()[idx])
+    # reference Solver::get_residual(it) indexes m_res_history[it] directly
+    # (index 0 = initial residual, i+1 = after iteration i)
+    return float(np.atleast_2d(h)[iteration].ravel()[idx])
 
 
 @_catches(1)

@@ -40,6 +40,23 @@ PUBLIC_MODES = (
 #: Numeric mode ids matching AMGX_Mode enum ordering (amgx_config.h:125-147).
 MODE_IDS = {name: i for i, name in enumerate(PUBLIC_MODES)}
 
+_fp64_warned: set = set()
+
+
+def _warn_fp64_downgrade(mode_name: str):
+    """One-time visible notice that a device-mode fp64 matrix runs in fp32
+    on this accelerator, so tolerance below ~1e-7 cannot converge and the
+    user knows why (C-API callers otherwise get no diagnostic)."""
+    if mode_name in _fp64_warned:
+        return
+    _fp64_warned.add(mode_name)
+    from .utils.logging import amgx_output
+
+    amgx_output(
+        f"WARNING: mode {mode_name}: fp64 matrix data runs as fp32 on this "
+        "accelerator (TPU fp64 is emulated/unsupported); tolerances below "
+        "~1e-7 are unreachable. Use a host mode (h***) for true fp64.\n")
+
 
 @dataclasses.dataclass(frozen=True)
 class Mode:
@@ -85,6 +102,7 @@ class Mode:
         if (self.mem_space == "device"
                 and jax.default_backend() not in ("cpu",)
                 and self.mat_dtype == np.dtype(np.float64)):
+            _warn_fp64_downgrade(self.name)
             return np.dtype(np.float32)
         return self.mat_dtype
 

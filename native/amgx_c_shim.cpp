@@ -313,28 +313,38 @@ AMGX_RC AMGX_matrix_destroy(AMGX_matrix_handle mtx) {
     return AMGX_RC_OK;
 }
 
+/* numpy typenum of a dtype attribute on the handle's mode object: the
+ * byte width of caller buffers depends on it, so every memcpy across the
+ * ABI must use this, not a hardcoded float64. */
+static int handle_mode_typenum(Handle *h, const char *dtype_attr) {
+    PyObject *mode_obj = PyObject_GetAttrString(h->obj, "mode");
+    PyObject *vd =
+        mode_obj ? PyObject_GetAttrString(mode_obj, dtype_attr) : nullptr;
+    int tn = NPY_FLOAT64;
+    if (vd) {
+        PyArray_Descr *descr = nullptr;
+        if (PyArray_DescrConverter(vd, &descr) && descr) {
+            tn = descr->type_num;
+            Py_DECREF(descr);
+        }
+        Py_DECREF(vd);
+    }
+    Py_XDECREF(mode_obj);
+    PyErr_Clear();
+    return tn;
+}
+
+static int mode_mat_typenum(Handle *h) {
+    return handle_mode_typenum(h, "mat_dtype");
+}
+
 AMGX_RC AMGX_matrix_upload_all(AMGX_matrix_handle mtx, int n, int nnz,
                                int block_dimx, int block_dimy,
                                const int *row_ptrs, const int *col_indices,
                                const void *data, const void *diag_data) {
     Gil gil;
     Handle *h = static_cast<Handle *>(mtx);
-    AMGX_Mode m = AMGX_mode_dDDI;
-    /* mode from the python handle */
-    PyObject *mode_obj = PyObject_GetAttrString(h->obj, "mode");
-    PyObject *name_obj =
-        mode_obj ? PyObject_GetAttrString(mode_obj, "name") : nullptr;
-    std::string mname = name_obj ? PyUnicode_AsUTF8(name_obj) : "dDDI";
-    Py_XDECREF(name_obj);
-    Py_XDECREF(mode_obj);
-    int tn = NPY_FLOAT64;
-    if (mname.size() == 4) {
-        char c = mname[2];
-        tn = (c == 'F') ? NPY_FLOAT32
-                        : (c == 'C') ? NPY_COMPLEX64
-                                     : (c == 'Z') ? NPY_COMPLEX128
-                                                  : NPY_FLOAT64;
-    }
+    int tn = mode_mat_typenum(h);
     npy_intp nvals = (npy_intp)nnz * block_dimx * block_dimy;
     PyObject *rp = np_view(row_ptrs, n + 1, NPY_INT32);
     PyObject *ci = np_view(col_indices, nnz, NPY_INT32);
@@ -363,7 +373,8 @@ AMGX_RC AMGX_matrix_replace_coefficients(AMGX_matrix_handle mtx, int n,
     long b = bdim ? PyLong_AsLong(bdim) : 1;
     Py_XDECREF(bdim);
     Py_XDECREF(bd);
-    PyObject *dv = np_view(data, (npy_intp)nnz * b * b, NPY_FLOAT64);
+    PyObject *dv =
+        np_view(data, (npy_intp)nnz * b * b, mode_mat_typenum(h));
     PyObject *args = Py_BuildValue("(OiiO)", h->obj, n, nnz, dv);
     Py_DECREF(dv);
     return unpack_rc(call("AMGX_matrix_replace_coefficients", args));
@@ -407,7 +418,8 @@ AMGX_RC AMGX_matrix_download_all(AMGX_matrix_handle mtx, int *row_ptrs,
         PyArrayObject *ci = (PyArrayObject *)PyArray_FROM_OTF(
             outs[1], NPY_INT32, NPY_ARRAY_C_CONTIGUOUS);
         PyArrayObject *dv = (PyArrayObject *)PyArray_FROM_OTF(
-            outs[2], NPY_FLOAT64, NPY_ARRAY_C_CONTIGUOUS);
+            outs[2], mode_mat_typenum(static_cast<Handle *>(mtx)),
+            NPY_ARRAY_C_CONTIGUOUS);
         if (rp && row_ptrs)
             memcpy(row_ptrs, PyArray_DATA(rp),
                    PyArray_NBYTES(rp));
@@ -448,23 +460,15 @@ AMGX_RC AMGX_vector_destroy(AMGX_vector_handle vec) {
     return AMGX_RC_OK;
 }
 
+static int handle_vec_typenum(Handle *h) {
+    return handle_mode_typenum(h, "vec_dtype");
+}
+
 AMGX_RC AMGX_vector_upload(AMGX_vector_handle vec, int n, int block_dim,
                            const void *data) {
     Gil gil;
     Handle *h = static_cast<Handle *>(vec);
-    PyObject *mode_obj = PyObject_GetAttrString(h->obj, "mode");
-    PyObject *vd =
-        mode_obj ? PyObject_GetAttrString(mode_obj, "vec_dtype") : nullptr;
-    int tn = NPY_FLOAT64;
-    if (vd) {
-        PyArray_Descr *descr = nullptr;
-        if (PyArray_DescrConverter(vd, &descr) && descr) {
-            tn = descr->type_num;
-            Py_DECREF(descr);
-        }
-        Py_DECREF(vd);
-    }
-    Py_XDECREF(mode_obj);
+    int tn = handle_vec_typenum(h);
     PyObject *arr = np_view(data, (npy_intp)n * block_dim, tn);
     PyObject *args = Py_BuildValue("(OiiO)", h->obj, n, block_dim, arr);
     Py_DECREF(arr);
@@ -487,7 +491,8 @@ AMGX_RC AMGX_vector_download(AMGX_vector_handle vec, void *data) {
         call("AMGX_vector_download", PyTuple_Pack(1, obj(vec))), &outs);
     if (rc == AMGX_RC_OK && !outs.empty() && data) {
         PyArrayObject *arr = (PyArrayObject *)PyArray_FROM_OTF(
-            outs[0], NPY_NOTYPE, NPY_ARRAY_C_CONTIGUOUS);
+            outs[0], handle_vec_typenum(static_cast<Handle *>(vec)),
+            NPY_ARRAY_C_CONTIGUOUS);
         if (arr) {
             memcpy(data, PyArray_DATA(arr), PyArray_NBYTES(arr));
             Py_DECREF(arr);
