@@ -33,7 +33,23 @@ from .aggregation.selectors import create_selector
 from .classical.interpolators import create_interpolator
 from .classical.selectors import create_cf_selector
 from .classical.strength import create_strength
-from .level import AggregationLevel, AMGLevel, ClassicalLevel
+from .level import (AggregationLevel, AMGLevel, ClassicalLevel,
+                    PairwiseLevel)
+from .pairwise import dia_arrays, dia_to_scipy, pairwise_galerkin_dia
+
+
+#: sentinel: the structured pairwise path declined (too irregular) and the
+#: caller should retry with a graph-matching selector
+_PAIRWISE_FALLBACK = object()
+
+
+def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
+    """A hierarchy child matrix inheriting the parent's device dtype
+    (mixed precision flows down the whole hierarchy)."""
+    m = Matrix(a, block_dim=block_dim)
+    m.device_dtype = parent.device_dtype
+    m.placement = parent.placement
+    return m
 
 
 class AMGHierarchy:
@@ -128,15 +144,20 @@ class AMGHierarchy:
                 agg, nc = data
                 Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
                 lvl = AggregationLevel(cur, i, agg, nc)
+            elif kind == "pairwise":
+                n_f, = data
+                Ac_host, _ = self._pairwise_numeric(cur.scalar_csr(), n_f)
+                lvl = PairwiseLevel(cur, i, n_f)
             else:
                 P_host, = data
                 R_host = sp.csr_matrix(P_host.T)
                 Ac_host = sp.csr_matrix(R_host @ cur.scalar_csr() @ P_host)
-                lvl = ClassicalLevel(cur, i, Matrix(P_host).device(),
-                                     Matrix(R_host).device())
+                lvl = ClassicalLevel(cur, i,
+                                     _child_matrix(cur, P_host).device(),
+                                     _child_matrix(cur, R_host).device())
             self.levels.append(lvl)
             self._structure.append(struct)
-            cur = Matrix(Ac_host, block_dim=cur.block_dim)
+            cur = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
         # rebuild any remaining levels fresh from the reused prefix
         cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
@@ -144,6 +165,13 @@ class AMGHierarchy:
     def _coarsen_once(self, cur: Matrix, idx: int):
         if self.algorithm == "AGGREGATION":
             name = str(self.cfg.get("selector", self.scope))
+            if name == "PAIRWISE":    # alias for the structured GEO path
+                name = "GEO"
+            if name == "GEO" and cur.block_dim == 1 and cur.dist is None:
+                out = self._coarsen_pairwise(cur, idx)
+                if out is not _PAIRWISE_FALLBACK:
+                    return out
+                name = "SIZE_2"  # too irregular for the structured path
             selector = create_selector(name, self.cfg, self.scope)
             if cur.dist is not None:
                 return self._coarsen_aggregation_dist(cur, idx, selector)
@@ -155,7 +183,7 @@ class AMGHierarchy:
                 return None, None, None
             Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
             level = AggregationLevel(cur, idx, agg, nc)
-            Ac = Matrix(Ac_host, block_dim=cur.block_dim)
+            Ac = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             return level, Ac, ("aggregation", (agg, nc))
         elif self.algorithm in ("CLASSICAL", "ENERGYMIN"):
             if cur.block_dim != 1:
@@ -209,16 +237,49 @@ class AMGHierarchy:
                 P_pad = embed_padded(P_host, f_off, curd.n_loc, c_off,
                                      c_nloc)
                 R_pad = sp.csr_matrix(P_pad.T)
-                Ac = Matrix(Ac_host)
+                Ac = _child_matrix(cur, Ac_host)
                 Ac.set_distribution(mesh, axis, c_off, n_loc=c_nloc)
-                level = ClassicalLevel(cur, idx, Matrix(P_pad).device(),
-                                       Matrix(R_pad).device(), None)
+                level = ClassicalLevel(
+                    cur, idx, _child_matrix(cur, P_pad).device(),
+                    _child_matrix(cur, R_pad).device(), None)
                 return level, Ac, ("classical", (P_host,))
-            level = ClassicalLevel(cur, idx, Matrix(P_host).device(),
-                                   Matrix(R_host).device(), cf_map)
-            return level, Matrix(Ac_host), ("classical", (P_host,))
+            level = ClassicalLevel(
+                cur, idx, _child_matrix(cur, P_host).device(),
+                _child_matrix(cur, R_host).device(), cf_map)
+            return level, _child_matrix(cur, Ac_host), ("classical", (P_host,))
         raise BadConfigurationError(f"unknown AMG algorithm "
                                     f"{self.algorithm!r}")
+
+    def _coarsen_pairwise(self, cur: Matrix, idx: int,
+                          max_diags: int = 48):
+        """Structured GEO path (amg/pairwise.py): DIA-preserving pairwise
+        coarsening with reshape transfers; returns ``_PAIRWISE_FALLBACK``
+        when the operator has too many distinct diagonals for the DIA
+        representation (caller retries with a matching selector).
+        ``max_diags`` matches ``pack_device``'s ``dia_max_diags`` so every
+        level this path produces really is packed gather-free."""
+        Asc = cur.scalar_csr()
+        n = Asc.shape[0]
+        if n < 2:
+            return None, None, None   # stop coarsening here
+        arrs = dia_arrays(Asc, max_diags=max_diags)
+        if arrs is None:
+            return _PAIRWISE_FALLBACK
+        Ac_host, lvl_n = self._pairwise_numeric(Asc, n, arrs)
+        level = PairwiseLevel(cur, idx, n)
+        Ac = _child_matrix(cur, Ac_host)
+        return level, Ac, ("pairwise", (n,))
+
+    @staticmethod
+    def _pairwise_numeric(Asc, n_f: int, arrs=None):
+        """Shared numeric pipeline (fresh + structure-reuse paths):
+        diagonal arrays → pairwise Galerkin → scipy coarse matrix."""
+        if arrs is None:
+            arrs = dia_arrays(Asc)
+        offs, vals = arrs
+        offs_c, vals_c = pairwise_galerkin_dia(offs, vals)
+        nc = (n_f + 1) // 2
+        return dia_to_scipy(offs_c, vals_c, nc), n_f
 
     def _coarsen_aggregation_dist(self, cur: Matrix, idx: int, selector):
         """Distributed aggregation coarsening.
@@ -261,7 +322,7 @@ class AMGHierarchy:
         # mesh — subsequent levels run replicated
         lower = int(self.cfg.get("matrix_consolidation_lower_threshold"))
         if lower > 0 and nc // n_parts < lower:
-            Ac = Matrix(Ac_host)
+            Ac = _child_matrix(cur, Ac_host)
             n_loc_f = curd.n_loc
             agg_pad = np.full(n_parts * n_loc_f, nc, dtype=np.int64)
             for p in range(n_parts):
@@ -271,7 +332,7 @@ class AMGHierarchy:
             level = AggregationLevel(cur, idx, agg_pad, n_coarse=nc,
                                      trash_segment=True)
             return level, Ac, ("aggregation-consolidated", (agg_real, nc))
-        Ac = Matrix(Ac_host)
+        Ac = _child_matrix(cur, Ac_host)
         Ac.set_distribution(mesh, axis, coarse_offsets, n_loc=nc_loc)
         # aggregates in padded coordinates: fine pad rows → coarse pad slot
         n_loc_f = curd.n_loc

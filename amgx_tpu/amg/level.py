@@ -26,12 +26,13 @@ from ..ops.spmv import spmv
 
 
 class AMGLevel:
+    kind = "?"
+
     def __init__(self, A: Matrix, level_index: int):
         self.A = A
         self.Ad = A.device()
         self.level_index = level_index
         self.smoother = None
-        self.kind = "?"
 
     # traced ops --------------------------------------------------------
     def restrict_residual(self, r: jax.Array) -> jax.Array:
@@ -89,6 +90,32 @@ class AggregationLevel(AMGLevel):
             return x + e[self.aggregates]
         eb = e.reshape(-1, b)
         return x + eb[self.aggregates].reshape(-1)
+
+
+class PairwiseLevel(AMGLevel):
+    """Strict index-order pairing {2I, 2I+1} (GEO selector fast path).
+
+    Grid transfers are pure reshapes — no gather, no segment_sum — which
+    is the TPU-optimal expression of unsmoothed-aggregation transfers
+    (``aggregation_amg_level.cu:115-196``); see amg/pairwise.py.
+    """
+
+    kind = "pairwise"
+
+    def __init__(self, A: Matrix, level_index: int, n_fine: int):
+        super().__init__(A, level_index)
+        self.n_fine = int(n_fine)
+        self.n_coarse = (self.n_fine + 1) // 2
+        self._odd = (self.n_fine % 2) == 1
+
+    def restrict_residual(self, r):
+        if self._odd:
+            r = jnp.concatenate([r, jnp.zeros((1,), r.dtype)])
+        return r.reshape(self.n_coarse, 2).sum(axis=1)
+
+    def prolongate_and_correct(self, x, e):
+        e2 = jnp.broadcast_to(e[:, None], (self.n_coarse, 2)).reshape(-1)
+        return x + e2[: self.n_fine]
 
 
 class ClassicalLevel(AMGLevel):

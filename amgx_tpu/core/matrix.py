@@ -104,6 +104,11 @@ class Matrix:
         self.dist = None
         #: optional jax.Device to pin the pack to (host modes → CPU)
         self.placement = None
+        #: preferred dtype of the device pack (mixed precision: host keeps
+        #: the wide dtype for setup + iterative-refinement residuals while
+        #: the device computes narrow — the reference's dDFI mixed mode,
+        #: amgx_config.h:114-123)
+        self.device_dtype = None
         if a is not None:
             self.set(a, block_dim=block_dim)
 
@@ -197,7 +202,7 @@ class Matrix:
 
     # ---------------------------------------------------------------- packing
     def device(self, dtype=None, ell_max_width: int = 2048):
-        dtype = np.dtype(dtype or self.dtype)
+        dtype = np.dtype(dtype or self.device_dtype or self.dtype)
         if self._device is not None and self._device_dtype == dtype:
             return self._device
         if self.dist is not None:

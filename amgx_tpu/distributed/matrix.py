@@ -40,7 +40,7 @@ from .partition import Partition, build_partition
     jax.tree_util.register_dataclass,
     data_fields=["cols", "vals", "diag", "send_idx", "halo_src"],
     meta_fields=["n_global", "n_parts", "n_loc", "ell_width", "block_dim",
-                 "axis", "use_ring", "offsets"],
+                 "axis", "use_ring", "offsets", "mesh"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardedMatrix:
@@ -63,6 +63,8 @@ class ShardedMatrix:
     axis: str             # mesh axis name
     use_ring: bool
     offsets: tuple        # (P+1,) real row offsets per rank
+    #: static (meta) so traced packs keep it — tracers have no .sharding
+    mesh: Mesh = None
 
     @property
     def n(self) -> int:
@@ -79,13 +81,6 @@ class ShardedMatrix:
     @property
     def fmt(self):
         return "sharded-ell"
-
-    @property
-    def mesh(self) -> Mesh:
-        sh = self.cols.sharding
-        if isinstance(sh, NamedSharding):
-            return sh.mesh
-        raise ValueError("ShardedMatrix arrays must carry a NamedSharding")
 
 
 def pad_map(offsets: np.ndarray, n_loc: int) -> np.ndarray:
@@ -196,7 +191,7 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
         n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
         ell_width=K, block_dim=1, axis=axis,
         use_ring=part.ring_neighbors_only,
-        offsets=tuple(int(o) for o in part.offsets))
+        offsets=tuple(int(o) for o in part.offsets), mesh=mesh)
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,155 @@
+"""Device-argument binding for jitted solve loops.
+
+The reference streams any-size matrices through its kernels
+(``multiply.cu:75-196``, ``solver.cu:589-970`` work at any N).  The TPU
+analog of that contract is that the jitted solve function must receive the
+matrix / hierarchy / smoother arrays as *arguments* — never as trace-time
+closure constants, which XLA bakes into the executable (at 128³ that is
+~2 GB of captured constants and a failed compile).
+
+:class:`DeviceBindings` walks the solver object graph — nested solvers,
+the AMG hierarchy and its levels, host ``Matrix`` handles with cached
+device packs — and records every attribute slot holding device data
+(a ``jax.Array``, a ``DeviceMatrix``/``ShardedMatrix`` pytree, or a
+list/tuple of those).  ``collect()`` gathers the current values as one
+argument pytree; ``bind()`` temporarily swaps tracers into the same slots
+while the solve function is traced, so unmodified solver code picks the
+tracers up through its normal ``self.X`` attribute reads.
+
+Slots that alias the identical object (e.g. ``solver.Ad`` and
+``solver.A._device``) are deduplicated so each buffer appears once in the
+argument pytree and both slots receive the same tracer.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+
+
+def _is_device_value(v) -> bool:
+    """True when ``v`` is pure device data: a pytree whose leaves are all
+    jax Arrays (covers jax.Array, DeviceMatrix, ShardedMatrix, and
+    lists/tuples/dicts of them).  Host numpy arrays are deliberately
+    excluded — they are setup-phase data and must stay static."""
+    leaves = jax.tree_util.tree_leaves(v)
+    return bool(leaves) and all(isinstance(l, jax.Array) for l in leaves)
+
+
+def _is_traversable(v) -> bool:
+    """Objects whose attributes may hold device slots: anything defined in
+    this package (solvers, hierarchy, levels, matrix handles) that carries
+    an instance ``__dict__``.  Config/coloring/scaler objects are harmless
+    to visit — they simply contain no device leaves."""
+    cls = type(v)
+    mod = getattr(cls, "__module__", "")
+    return mod.startswith("amgx_tpu") and hasattr(v, "__dict__")
+
+
+class DeviceBindings:
+    def __init__(self, root):
+        self._slots: List[Tuple[Any, str]] = []
+        #: slot index -> index into the deduplicated value list
+        self._value_index: List[int] = []
+        self._discover(root)
+
+    # ------------------------------------------------------------ discovery
+    def _discover(self, root):
+        seen = set()
+        stack = [root]
+        slots = []
+        while stack:
+            obj = stack.pop()
+            if obj is None or id(obj) in seen:
+                continue
+            seen.add(id(obj))
+            for k, v in list(vars(obj).items()):
+                if k.startswith("_solve_fn") or k == "_bindings":
+                    continue
+                if _is_device_value(v):
+                    slots.append((obj, k))
+                elif _is_traversable(v):
+                    stack.append(v)
+                elif isinstance(v, (list, tuple)):
+                    stack.extend(e for e in v if _is_traversable(e))
+                elif isinstance(v, dict):
+                    stack.extend(e for e in v.values()
+                                 if _is_traversable(e))
+        # dedup aliased slots by object identity of the current value
+        by_id = {}
+        self._slots = slots
+        self._value_index = []
+        for obj, k in slots:
+            vid = id(getattr(obj, k))
+            if vid not in by_id:
+                by_id[vid] = len(by_id)
+            self._value_index.append(by_id[vid])
+        self._n_values = len(by_id)
+
+    # --------------------------------------------------------- runtime API
+    def collect(self) -> list:
+        """The deduplicated device-value list (a pytree) to pass to jit."""
+        values = [None] * self._n_values
+        for (obj, k), vi in zip(self._slots, self._value_index):
+            if values[vi] is None:
+                values[vi] = getattr(obj, k)
+        return values
+
+    def bind(self, values: list) -> list:
+        """Swap ``values`` into every slot; returns the previous values
+        (in ``collect()`` layout) for restoring after the trace."""
+        prev = self.collect()
+        for (obj, k), vi in zip(self._slots, self._value_index):
+            new = values[vi]
+            if _frozen(obj):
+                object.__setattr__(obj, k, new)
+            else:
+                setattr(obj, k, new)
+        return prev
+
+    def n_slots(self) -> int:
+        return len(self._slots)
+
+    def normalize_placement(self, mesh) -> None:
+        """Distributed solves: every bound array must live on the mesh's
+        device set (jit rejects mixed device sets).  Arrays on a subset —
+        e.g. a consolidated coarse level replicated on one device (the
+        reference 'glue' path, distributed/glue.h) — are re-placed as
+        mesh-replicated; the result is written back into the slots so the
+        transfer happens once, not per solve."""
+        import jax.numpy  # noqa: F401  (jax imported at module top)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh_devs = set(mesh.devices.flat)
+
+        def fix_leaf(leaf):
+            if not isinstance(leaf, jax.Array):
+                return leaf
+            if set(leaf.devices()) == mesh_devs:
+                return leaf
+            repl = NamedSharding(mesh, PartitionSpec())
+            return jax.device_put(leaf, repl)
+
+        values = [jax.tree_util.tree_map(fix_leaf, v)
+                  for v in self.collect()]
+        self.bind(values)
+
+
+def _frozen(obj) -> bool:
+    params = getattr(type(obj), "__dataclass_params__", None)
+    return bool(params and params.frozen)
+
+
+def bind_for_trace(bindings: DeviceBindings, fn):
+    """Wrap ``fn(*args)`` as ``wrapped(values, *args)`` where ``values`` is
+    the bindings' device pytree: during tracing the slots are temporarily
+    rebound to the traced values and restored afterwards."""
+
+    def wrapped(values, *args):
+        prev = bindings.bind(values)
+        try:
+            return fn(*args)
+        finally:
+            bindings.bind(prev)
+
+    return wrapped

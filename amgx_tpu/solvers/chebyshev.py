@@ -18,7 +18,7 @@ import numpy as np
 from ..ops import blas
 from ..ops.spmv import spmv
 from .base import Solver, register_solver
-from .jacobi import _apply_dinv, _invert_block_diag
+from .jacobi import _apply_dinv, setup_dinv
 from .krylov import _PrecondMixin
 
 
@@ -130,7 +130,7 @@ class ChebyshevPolySmoother(Solver):
         self.order = int(cfg.get("chebyshev_polynomial_order", scope))
 
     def solver_setup(self):
-        self.dinv = _invert_block_diag(self.Ad.diag)
+        self.dinv = setup_dinv(self)
         lmax = float(_power_iteration_lambda_max(self.Ad, self.dinv))
         self.lmax = 1.05 * lmax
         self.lmin = self.lmax / 30.0  # standard smoothing interval upper part
@@ -166,7 +166,7 @@ class PolynomialSmoother(Solver):
         self.mu = int(cfg.get("kpz_mu", scope))
 
     def solver_setup(self):
-        self.dinv = _invert_block_diag(self.Ad.diag)
+        self.dinv = setup_dinv(self)
 
     def solve_iteration(self, b, x, state, iter_idx):
         r = b - spmv(self.Ad, x)

@@ -31,7 +31,7 @@ from ..core.matrix import Matrix, pack_device
 from ..errors import BadConfigurationError
 from ..ops.spmv import spmv
 from .base import Solver, register_solver
-from .jacobi import _apply_dinv, _invert_block_diag
+from .jacobi import _apply_dinv
 
 
 def _transpose_aligned_values(csr: sp.csr_matrix) -> np.ndarray:

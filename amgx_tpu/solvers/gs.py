@@ -19,7 +19,7 @@ import numpy as np
 from ..coloring import color_matrix
 from ..ops.spmv import spmv
 from .base import Solver, register_solver
-from .jacobi import _apply_dinv, _invert_block_diag
+from .jacobi import _apply_dinv, setup_dinv
 
 
 class _ColoredSmootherBase(Solver):
@@ -47,7 +47,7 @@ class _ColoredSmootherBase(Solver):
             else:
                 masks.append(jnp.asarray(m))
         self.color_masks = masks
-        self.dinv = _invert_block_diag(self.Ad.diag)
+        self.dinv = setup_dinv(self)
 
 
 @register_solver("MULTICOLOR_GS")

@@ -1,0 +1,98 @@
+"""The jitted solve must receive device data as arguments, not constants.
+
+Regression for the benchmark-scale failure mode: closing over the matrix /
+hierarchy bakes them into the XLA executable as constants (2 GB at 128³).
+The reference contract is any-size kernels (``multiply.cu:75-196``,
+``solver.cu:589-970``); here we assert the lowered computation embeds no
+large dense constants and that the binder finds the device slots.
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+from amgx_tpu.solvers._bind import DeviceBindings, bind_for_trace
+
+
+def _lower_solve(slv, b):
+    fn = jax.jit(bind_for_trace(slv._bindings, slv._build_solve_fn()))
+    bj = jnp.asarray(b)
+    return fn.lower(slv._bindings.collect(), bj, jnp.zeros_like(bj),
+                    jnp.asarray(slv.tolerance, bj.dtype),
+                    jnp.asarray(slv.max_iters, jnp.int32))
+
+
+def _assert_no_large_consts(lowered, limit_elems=4096):
+    """No inline dense constant with more elements than a small workspace
+    (index vectors of O(max_iters) are fine; O(n)/O(nnz) payloads are not).
+    """
+    txt = lowered.as_text()
+    # stablehlo prints big tensors as dense<"0x..."> or dense<[...]>;
+    # find constant ops with large tensor types
+    for m in re.finditer(r"stablehlo\.constant[^:]*:\s*tensor<([^>]+)>", txt):
+        dims = re.findall(r"(\d+)x", m.group(1))
+        n = int(np.prod([int(d) for d in dims])) if dims else 1
+        assert n <= limit_elems, (
+            f"large constant captured in lowered solve: tensor<{m.group(1)}>")
+
+
+CFG_FGMRES_AMG = (
+    "config_version=2, solver(out)=FGMRES, out:max_iters=30, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:gmres_n_restart=10, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+CFG_PCG_CLASSICAL = (
+    "config_version=2, solver(out)=PCG, out:max_iters=30, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, amg:interpolator=D2, "
+    "amg:max_iters=1, amg:max_levels=10, amg:min_coarse_rows=16, "
+    "amg:smoother(sm)=MULTICOLOR_DILU, sm:max_iters=1, "
+    "amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+@pytest.mark.parametrize("cfg_str", [CFG_FGMRES_AMG, CFG_PCG_CLASSICAL],
+                         ids=["fgmres_agg", "pcg_classical_dilu"])
+def test_solve_captures_no_large_constants(cfg_str):
+    A = poisson7pt(12, 12, 12)
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(cfg_str))
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)  # builds bindings + jitted fn, must converge
+    relres = np.linalg.norm(b - A @ np.asarray(res.x)) / np.linalg.norm(b)
+    assert relres < 1e-6
+    assert slv._bindings.n_slots() > 0
+    _assert_no_large_consts(_lower_solve(slv, b))
+
+
+def test_bindings_restore_after_trace():
+    """After tracing, the solver's attributes hold real arrays again."""
+    A = poisson7pt(8, 8, 8)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver=PCG, max_iters=10, monitor_residual=1"))
+    slv.setup(amgx.Matrix(A))
+    slv.solve(np.ones(A.shape[0]))
+    assert isinstance(slv.Ad.vals, jax.Array)
+    assert not isinstance(slv.Ad.vals,
+                          jax.core.Tracer)
+
+
+def test_solve_twice_reuses_compilation():
+    A = poisson7pt(8, 8, 8)
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver=BICGSTAB, max_iters=40, "
+        "monitor_residual=1, tolerance=1e-10"))
+    slv.setup(amgx.Matrix(A))
+    r1 = slv.solve(b)
+    r2 = slv.solve(b)
+    assert r1.iterations == r2.iterations
+    np.testing.assert_allclose(np.asarray(r1.x), np.asarray(r2.x))

@@ -1,0 +1,91 @@
+"""Convergence honesty: the declared status must reflect the TRUE residual.
+
+Reference contract: the convergence loop recomputes true residuals
+(``solver.cu:776-805``); a quasi-residual (FGMRES) may steer the loop but
+must never be the basis of a SUCCESS claim.  In narrow dtypes the solve
+either refines (mixed-precision, the dDFI analog) or refuses to claim
+convergence below the precision floor.
+"""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.errors import SolveStatus
+from amgx_tpu.io import poisson7pt
+
+FGMRES_AMG = (
+    "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance={tol}, "
+    "out:convergence=RELATIVE_INI, out:gmres_n_restart=20, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=12, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def _true_relres(A, b, x):
+    return float(np.linalg.norm(b - A @ np.asarray(x, dtype=np.float64))
+                 / np.linalg.norm(b))
+
+
+def test_success_implies_true_residual_below_tol():
+    """Declared SUCCESS ⇒ true relative residual ≤ tolerance (fp64)."""
+    A = poisson7pt(12, 12, 12)
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(
+        amgx.AMGConfig(FGMRES_AMG.format(tol="1e-8")))
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert _true_relres(A, b, res.x) <= 1e-8
+
+
+def test_fp32_no_false_convergence_claim():
+    """An fp32-only solve asked for 1e-10 must NOT claim SUCCESS unless the
+    true residual actually reaches it (it can't in fp32)."""
+    A = poisson7pt(10, 10, 10).astype(np.float32)
+    b = np.ones(A.shape[0], dtype=np.float32)
+    slv = amgx.create_solver(
+        amgx.AMGConfig(FGMRES_AMG.format(tol="1e-10")))
+    slv.setup(amgx.Matrix(A))   # fp32 host + fp32 device: no refinement
+    res = slv.solve(b)
+    relres = _true_relres(A.astype(np.float64), b.astype(np.float64), res.x)
+    if res.status == SolveStatus.SUCCESS:
+        assert relres <= 1e-10
+    else:
+        assert res.status == SolveStatus.NOT_CONVERGED
+
+
+def test_mixed_precision_refinement_reaches_deep_tolerance():
+    """fp64 host matrix + fp32 device pack: iterative refinement carries
+    the true residual below an fp32-unreachable tolerance.  The rhs is
+    deliberately NOT fp32-representable: refinement must converge to the
+    caller's fp64 b, not its fp32 rounding."""
+    A = poisson7pt(10, 10, 10)            # fp64 host
+    b = np.random.default_rng(7).standard_normal(A.shape[0])
+    slv = amgx.create_solver(
+        amgx.AMGConfig(FGMRES_AMG.format(tol="1e-9")))
+    m = amgx.Matrix(A)
+    # fp32 device pack under an fp64 host matrix (what a TPU backend does
+    # with f64 input); the whole hierarchy inherits the narrow pack dtype
+    m.device_dtype = np.float32
+    slv.setup(m)
+    assert slv.Ad.dtype == np.float32
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert _true_relres(A, b, res.x) <= 1e-9
+    assert res.iterations > 0
+
+
+def test_final_norm_is_true_residual():
+    """The reported residual_norm equals an independently computed true
+    residual norm (not the quasi-residual)."""
+    A = poisson7pt(10, 10, 10)
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(
+        amgx.AMGConfig(FGMRES_AMG.format(tol="1e-6")))
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    true_nrm = np.linalg.norm(b - A @ np.asarray(res.x))
+    assert np.max(np.abs(res.residual_norm - true_nrm)) <= \
+        1e-6 * max(true_nrm, 1e-30) + 1e-12
