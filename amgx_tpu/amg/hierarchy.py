@@ -34,8 +34,10 @@ from .classical.interpolators import create_interpolator
 from .classical.selectors import create_cf_selector
 from .classical.strength import create_strength
 from .level import (AggregationLevel, AMGLevel, ClassicalLevel,
-                    PairwiseLevel)
+                    PairwiseLevel, StructuredLevel)
 from .pairwise import dia_arrays, dia_to_scipy, pairwise_galerkin_dia
+from .structured import (coarse_dims, decompose_offsets, infer_grid_dims,
+                         structured_galerkin)
 
 
 #: sentinel: the structured pairwise path declined (too irregular) and the
@@ -148,6 +150,12 @@ class AMGHierarchy:
                 n_f, = data
                 Ac_host, _ = self._pairwise_numeric(cur.scalar_csr(), n_f)
                 lvl = PairwiseLevel(cur, i, n_f)
+            elif kind == "structured":
+                dims, = data
+                offs, vals = dia_arrays(cur.scalar_csr())
+                offs3 = decompose_offsets(offs, dims)
+                Ac_host, cdims = self._structured_numeric(offs3, vals, dims)
+                lvl = StructuredLevel(cur, i, dims, cdims)
             else:
                 P_host, = data
                 R_host = sp.csr_matrix(P_host.T)
@@ -158,6 +166,8 @@ class AMGHierarchy:
             self.levels.append(lvl)
             self._structure.append(struct)
             cur = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
+            if kind == "structured":
+                cur.grid_dims = lvl.cdims
         # rebuild any remaining levels fresh from the reused prefix
         cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
@@ -265,10 +275,38 @@ class AMGHierarchy:
         arrs = dia_arrays(Asc, max_diags=max_diags)
         if arrs is None:
             return _PAIRWISE_FALLBACK
+        # isotropic 2×2×2 cells when the grid geometry is known/inferable
+        # (geo_selector.cu analog); falls back to 1D index pairing
+        dims = getattr(cur, "grid_dims", None)
+        offs, vals = arrs
+        if dims is None:
+            dims = infer_grid_dims(offs, n)
+        if dims is not None and max(dims) > 1:
+            offs3 = decompose_offsets(offs, dims)
+            if offs3 is not None:
+                out = self._structured_numeric(offs3, vals, dims)
+                if out is not None:
+                    Ac_host, cdims = out
+                    level = StructuredLevel(cur, idx, dims, cdims)
+                    Ac = _child_matrix(cur, Ac_host)
+                    Ac.grid_dims = cdims
+                    return level, Ac, ("structured", (dims,))
         Ac_host, lvl_n = self._pairwise_numeric(Asc, n, arrs)
         level = PairwiseLevel(cur, idx, n)
         Ac = _child_matrix(cur, Ac_host)
         return level, Ac, ("pairwise", (n,))
+
+    @staticmethod
+    def _structured_numeric(offs3, vals, dims):
+        """Numeric pipeline for the grid-structured path; None when the
+        coarse grid would not shrink (all dims already 1)."""
+        cdims = coarse_dims(dims)
+        if int(np.prod(cdims)) >= int(np.prod(dims)):
+            return None
+        offs3_c, vals_c, cdims = structured_galerkin(offs3, vals, dims)
+        cz, cy, cx = cdims
+        flat = [(dz * cy + dy) * cx + dx for dz, dy, dx in offs3_c]
+        return dia_to_scipy(flat, vals_c, cz * cy * cx), cdims
 
     @staticmethod
     def _pairwise_numeric(Asc, n_f: int, arrs=None):

@@ -118,6 +118,45 @@ class PairwiseLevel(AMGLevel):
         return x + e2[: self.n_fine]
 
 
+class StructuredLevel(AMGLevel):
+    """Isotropic 2×2×2 cell aggregation on an (nz, ny, nx) grid (GEO
+    selector with grid geometry — amg/structured.py).  Transfers are pure
+    reshape/reduce — no gather, no segment_sum."""
+
+    kind = "structured"
+
+    def __init__(self, A: Matrix, level_index: int, dims, cdims):
+        super().__init__(A, level_index)
+        self.dims = tuple(int(d) for d in dims)
+        self.cdims = tuple(int(d) for d in cdims)
+        self.n_fine = int(np.prod(self.dims))
+        self.n_coarse = int(np.prod(self.cdims))
+        # per-axis aggregation factor (2 where coarsened, 1 on singletons)
+        self._f = tuple(2 if c < d or d > 1 else 1
+                        for d, c in zip(self.dims, self.cdims))
+        self._pad = tuple(c * f for c, f in zip(self.cdims, self._f))
+
+    def restrict_residual(self, r):
+        nz, ny, nx = self.dims
+        pz, py, px = self._pad
+        cz, cy, cx = self.cdims
+        fz, fy, fx = self._f
+        r3 = r.reshape(nz, ny, nx)
+        if (pz, py, px) != (nz, ny, nx):
+            r3 = jnp.pad(r3, ((0, pz - nz), (0, py - ny), (0, px - nx)))
+        return r3.reshape(cz, fz, cy, fy, cx, fx).sum(
+            axis=(1, 3, 5)).reshape(-1)
+
+    def prolongate_and_correct(self, x, e):
+        nz, ny, nx = self.dims
+        cz, cy, cx = self.cdims
+        fz, fy, fx = self._f
+        e6 = jnp.broadcast_to(e.reshape(cz, 1, cy, 1, cx, 1),
+                              (cz, fz, cy, fy, cx, fx))
+        ef = e6.reshape(cz * fz, cy * fy, cx * fx)[:nz, :ny, :nx]
+        return x + ef.reshape(-1)
+
+
 class ClassicalLevel(AMGLevel):
     """Explicit P/R transfer (classical or energymin)."""
 

@@ -24,22 +24,9 @@ from __future__ import annotations
 import numpy as np
 import scipy.sparse as sp
 
-
-def dia_arrays(csr: sp.csr_matrix, max_diags: int = None):
-    """Row-aligned diagonal arrays of a CSR matrix:
-    returns (offsets list, vals (nd, n)) with A[i, i+d_k] = vals[k, i],
-    or None when the matrix has more than ``max_diags`` distinct
-    diagonals (too irregular for the DIA representation)."""
-    n = csr.shape[0]
-    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(csr.indptr))
-    offs_per_entry = csr.indices.astype(np.int64) - rows
-    offsets = np.unique(offs_per_entry)
-    if max_diags is not None and len(offsets) > max_diags:
-        return None
-    vals = np.zeros((len(offsets), n), dtype=csr.data.dtype)
-    k = np.searchsorted(offsets, offs_per_entry)
-    vals[k, rows] = csr.data
-    return [int(o) for o in offsets], vals
+# canonical DIA layout lives in core.matrix; re-exported here for the
+# AMG modules that consume it
+from ..core.matrix import dia_arrays  # noqa: F401
 
 
 def pairwise_galerkin_dia(offsets, vals: np.ndarray):

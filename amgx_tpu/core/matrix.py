@@ -79,6 +79,37 @@ class DeviceMatrix:
             self, vals=self.vals.astype(dtype), diag=self.diag.astype(dtype))
 
 
+def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
+    """Row-aligned diagonal arrays of a CSR matrix: returns
+    (offsets list, vals (nd, n)) with A[i, i+d_k] = vals[k, i], or None
+    when the matrix has more than ``max_diags`` distinct diagonals.
+
+    THE canonical DIA layout — the device pack (:func:`_try_pack_dia`),
+    the structured-AMG Galerkin (amg/pairwise.py, amg/structured.py) and
+    the refinement residue pack (solvers/base.py) all share it."""
+    n = csr.shape[0]
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(csr.indptr))
+    offs_per_entry = csr.indices.astype(np.int64) - rows
+    offsets = np.unique(offs_per_entry)
+    if max_diags is not None and len(offsets) > max_diags:
+        return None
+    vals = np.zeros((len(offsets), n), dtype=csr.data.dtype)
+    k = np.searchsorted(offsets, offs_per_entry)
+    vals[k, rows] = csr.data
+    return [int(o) for o in offsets], vals
+
+
+def ell_layout(indptr: np.ndarray, indices: np.ndarray):
+    """Shared ELL scatter layout: (for_rows, pos_in_row, width) such that
+    the padded arrays are filled by ``out[for_rows, pos_in_row] = data``."""
+    deg = np.diff(indptr)
+    k = max(int(deg.max()) if len(deg) else 1, 1)
+    for_rows = np.repeat(np.arange(len(deg), dtype=np.int64), deg)
+    pos = np.arange(len(indices), dtype=np.int64) - np.repeat(
+        indptr[:-1].astype(np.int64), deg)
+    return for_rows, pos, k
+
+
 def _bsr_from_any(a, block_dim: int) -> sp.bsr_matrix:
     if block_dim == 1:
         return sp.csr_matrix(a)
@@ -255,22 +286,16 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
         n_cols = bsr.shape[1] // b
         block_shape = (b, b)
 
-    deg = np.diff(indptr)
-    k = int(deg.max()) if len(deg) else 1
-    k = max(k, 1)
+    for_rows, pos_in_row, k = ell_layout(indptr, indices)
 
     # block diagonal extraction (reference: Matrix::computeDiagonal)
     diag = np.zeros((n_rows,) + block_shape, dtype=dtype)
-    for_rows = np.repeat(np.arange(n_rows, dtype=np.int64), deg)
     on_diag = indices == for_rows
     diag[for_rows[on_diag]] = vals[on_diag]
 
     if k <= ell_max_width:
         cols = np.zeros((n_rows, k), dtype=np.int32)
         ell_vals = np.zeros((n_rows, k) + block_shape, dtype=dtype)
-        # scatter each row's entries into its padded slot
-        pos_in_row = np.arange(len(indices), dtype=np.int64) - np.repeat(
-            indptr[:-1].astype(np.int64), deg)
         cols[for_rows, pos_in_row] = indices
         ell_vals[for_rows, pos_in_row] = vals
         return DeviceMatrix(
@@ -291,15 +316,12 @@ def _try_pack_dia(csr: sp.csr_matrix, dtype, max_diags: int
     n = csr.shape[0]
     if n == 0 or csr.nnz == 0:
         return None
-    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(csr.indptr))
-    offs_per_entry = csr.indices.astype(np.int64) - rows
-    offsets = np.unique(offs_per_entry)
-    if len(offsets) > max_diags:
+    arrs = dia_arrays(csr, max_diags=max_diags)
+    if arrs is None:
         return None
+    offsets, vals = arrs
+    vals = vals.astype(dtype)
     nd = len(offsets)
-    vals = np.zeros((nd, n), dtype=dtype)
-    k = np.searchsorted(offsets, offs_per_entry)
-    vals[k, rows] = csr.data
     diag = np.zeros(n, dtype=dtype)
     zero_pos = np.searchsorted(offsets, 0)
     if zero_pos < nd and offsets[zero_pos] == 0:

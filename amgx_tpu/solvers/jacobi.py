@@ -32,10 +32,19 @@ def _invert_block_diag(diag) -> jax.Array:
     if d.ndim == 1:
         out = np.where(d != 0, 1.0 / np.where(d == 0, 1.0, d), 0.0)
     else:
-        bad = np.abs(np.linalg.det(d)) < np.finfo(d.dtype).tiny
-        safe = d.copy()
-        safe[bad] = np.eye(d.shape[-1], dtype=d.dtype)
-        out = np.linalg.inv(safe)
+        # scale-invariant singularity test: normalise each block by its
+        # max entry first (raw |det| underflows for well-conditioned but
+        # small-magnitude blocks, silently replacing D⁻¹ with I)
+        bdim = d.shape[-1]
+        scale = np.max(np.abs(d), axis=(-2, -1))
+        nz = scale > 0
+        dn = d / np.where(nz, scale, 1.0)[:, None, None]
+        bad = ~nz | (np.abs(np.linalg.det(dn))
+                     < bdim * np.finfo(d.dtype).eps)
+        safe = np.where(bad[:, None, None],
+                        np.eye(bdim, dtype=d.dtype), dn)
+        out = np.linalg.inv(safe) / np.where(nz & ~bad, scale,
+                                             1.0)[:, None, None]
     return jnp.asarray(out.astype(d.dtype))
 
 
