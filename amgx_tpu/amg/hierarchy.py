@@ -37,7 +37,7 @@ from .level import (AggregationLevel, AMGLevel, ClassicalLevel,
                     PairwiseLevel, StructuredLevel)
 from .pairwise import dia_arrays, dia_to_scipy, pairwise_galerkin_dia
 from .structured import (coarse_dims, decompose_offsets, infer_grid_dims,
-                         structured_galerkin)
+                         stencil_values_consistent, structured_galerkin)
 
 
 #: sentinel: the structured pairwise path declined (too irregular) and the
@@ -279,10 +279,15 @@ class AMGHierarchy:
         # (geo_selector.cu analog); falls back to 1D index pairing
         dims = getattr(cur, "grid_dims", None)
         offs, vals = arrs
+        if dims is not None and int(np.prod(dims)) != n:
+            dims = None          # stale/wrong user attach: fall back
         if dims is None:
             dims = infer_grid_dims(offs, n)
         if dims is not None and max(dims) > 1:
             offs3 = decompose_offsets(offs, dims)
+            if offs3 is not None and \
+                    not stencil_values_consistent(offs3, vals, dims):
+                offs3 = None     # periodic/wrap stencil: decode is a lie
             if offs3 is not None:
                 out = self._structured_numeric(offs3, vals, dims)
                 if out is not None:
@@ -303,10 +308,8 @@ class AMGHierarchy:
         cdims = coarse_dims(dims)
         if int(np.prod(cdims)) >= int(np.prod(dims)):
             return None
-        offs3_c, vals_c, cdims = structured_galerkin(offs3, vals, dims)
-        cz, cy, cx = cdims
-        flat = [(dz * cy + dy) * cx + dx for dz, dy, dx in offs3_c]
-        return dia_to_scipy(flat, vals_c, cz * cy * cx), cdims
+        _, flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
+        return dia_to_scipy(flat, vals_c, int(np.prod(cdims))), cdims
 
     @staticmethod
     def _pairwise_numeric(Asc, n_f: int, arrs=None):

@@ -120,8 +120,15 @@ class PairwiseLevel(AMGLevel):
 
 class StructuredLevel(AMGLevel):
     """Isotropic 2×2×2 cell aggregation on an (nz, ny, nx) grid (GEO
-    selector with grid geometry — amg/structured.py).  Transfers are pure
-    reshape/reduce — no gather, no segment_sum."""
+    selector with grid geometry — amg/structured.py).
+
+    TPU layout note: the obvious ``reshape(cz,2,cy,2,cx,2).sum((1,3,5))``
+    creates tensors whose LAST dim is 2 — TPU tiling pads the trailing dim
+    to 128 (64× memory) and, materialised inside a ``while_loop`` body,
+    that cost ~11 GB of temp HBM at 128³.  Restriction therefore sums
+    stride-2 slices per axis, and prolongation interleaves the x-axis with
+    a tiny 0/1 matmul on the MXU and the y/z axes with stack+reshape
+    (whose trailing dims stay large)."""
 
     kind = "structured"
 
@@ -135,25 +142,46 @@ class StructuredLevel(AMGLevel):
         self._f = tuple(2 if c < d or d > 1 else 1
                         for d, c in zip(self.dims, self.cdims))
         self._pad = tuple(c * f for c, f in zip(self.cdims, self._f))
+        cx, px = self.cdims[2], self._pad[2]
+        if self._f[2] == 2:
+            # x-interleave as an MXU matmul: e @ Ix duplicates each column
+            ix = np.zeros((cx, px), dtype=np.float32)
+            ix[np.arange(cx), 2 * np.arange(cx)] = 1.0
+            ix[np.arange(cx), 2 * np.arange(cx) + 1] = 1.0
+            self._interleave_x = jnp.asarray(ix, dtype=self.Ad.dtype)
+        else:
+            self._interleave_x = None
 
     def restrict_residual(self, r):
         nz, ny, nx = self.dims
         pz, py, px = self._pad
-        cz, cy, cx = self.cdims
-        fz, fy, fx = self._f
         r3 = r.reshape(nz, ny, nx)
         if (pz, py, px) != (nz, ny, nx):
             r3 = jnp.pad(r3, ((0, pz - nz), (0, py - ny), (0, px - nx)))
-        return r3.reshape(cz, fz, cy, fy, cx, fx).sum(
-            axis=(1, 3, 5)).reshape(-1)
+        if self._f[0] == 2:
+            r3 = r3[0::2] + r3[1::2]
+        if self._f[1] == 2:
+            r3 = r3[:, 0::2] + r3[:, 1::2]
+        if self._f[2] == 2:
+            r3 = r3[:, :, 0::2] + r3[:, :, 1::2]
+        return r3.reshape(-1)
 
     def prolongate_and_correct(self, x, e):
         nz, ny, nx = self.dims
         cz, cy, cx = self.cdims
-        fz, fy, fx = self._f
-        e6 = jnp.broadcast_to(e.reshape(cz, 1, cy, 1, cx, 1),
-                              (cz, fz, cy, fy, cx, fx))
-        ef = e6.reshape(cz * fz, cy * fy, cx * fx)[:nz, :ny, :nx]
+        e3 = e.reshape(cz, cy, cx)
+        if self._interleave_x is not None:
+            # HIGHEST: the default TPU matmul precision feeds the MXU bf16
+            # inputs, which would truncate the correction to ~3 digits
+            e3 = jnp.einsum("zyc,cx->zyx", e3, self._interleave_x,
+                            precision=jax.lax.Precision.HIGHEST)
+        if self._f[1] == 2:
+            e3 = jnp.stack([e3, e3], axis=2).reshape(
+                e3.shape[0], -1, e3.shape[2])
+        if self._f[0] == 2:
+            e3 = jnp.stack([e3, e3], axis=1).reshape(
+                -1, e3.shape[1], e3.shape[2])
+        ef = e3[:nz, :ny, :nx]
         return x + ef.reshape(-1)
 
 

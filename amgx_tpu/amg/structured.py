@@ -59,10 +59,32 @@ def decompose_offsets(offsets: Sequence[int], dims: Dims,
         if max(abs(dx), abs(dy), abs(dz)) > max_extent:
             return None
         if (nx > 1 and dx and 2 * abs(dx) >= nx) or \
-           (ny > 1 and dy and 2 * abs(dy) >= ny):
+           (ny > 1 and dy and 2 * abs(dy) >= ny) or \
+           (dz and abs(dz) >= nz):
             return None
         out.append((dz, dy, dx))
     return out
+
+
+def stencil_values_consistent(offsets3: List[Off3], vals: np.ndarray,
+                              dims: Dims) -> bool:
+    """Definitive geometry check: a decoded stencil move that leaves the
+    grid must sit on zero values everywhere.  Periodic/wrap couplings
+    (whose modular decode masquerades as an interior move plus a phantom
+    z-step) fail this and the caller falls back to 1D pairing — the
+    structured Galerkin would otherwise silently misplace them."""
+    nz, ny, nx = dims
+    for k, (dz, dy, dx) in enumerate(offsets3):
+        V = vals[k].reshape(nz, ny, nx)
+        for axis, d, size in ((0, dz, nz), (1, dy, ny), (2, dx, nx)):
+            if d == 0:
+                continue
+            sl = [slice(None)] * 3
+            # rows whose neighbour row+d leaves [0, size)
+            sl[axis] = slice(size - d, None) if d > 0 else slice(0, -d)
+            if np.any(V[tuple(sl)]):
+                return False
+    return True
 
 
 def infer_grid_dims(offsets: Sequence[int], n: int) -> Optional[Dims]:
@@ -108,7 +130,8 @@ def structured_galerkin(offsets3: List[Off3], vals: np.ndarray, dims: Dims):
 
     ``vals`` is (nd, n) row-aligned: A[i, i+flat(d)] = vals[k, i] with
     zeros where the stencil leaves the grid.  Returns
-    (coarse offsets3, coarse vals (ndc, nc), coarse dims).
+    (coarse offsets3, coarse flat offsets, coarse vals (ndc, nc),
+    coarse dims).
     """
     nz, ny, nx = dims
     cz, cy, cx = coarse_dims(dims)
@@ -152,4 +175,4 @@ def structured_galerkin(offsets3: List[Off3], vals: np.ndarray, dims: Dims):
     offs3_c = [out[f][0] for f in flat_sorted]
     vals_c = np.stack([out[f][1].reshape(-1) for f in flat_sorted]) \
         if flat_sorted else np.zeros((0, nc), dtype=vals.dtype)
-    return offs3_c, vals_c, (cz, cy, cx)
+    return offs3_c, flat_sorted, vals_c, (cz, cy, cx)

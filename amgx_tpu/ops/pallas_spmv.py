@@ -1,0 +1,129 @@
+"""Pallas TPU kernel: DIA (shifted-diagonal) SpMV.
+
+The XLA expression of the DIA SpMV (ops/spmv.py: nd multiply-adds over
+statically shifted slices of a padded x) reaches only ~20% of v5e HBM
+bandwidth — each shifted slice re-streams x and the pad materialises a
+copy.  This kernel streams ``vals`` exactly once, DMAs one overlapping x
+window per row-block into VMEM, and builds every diagonal's shifted view
+from that single window with static sublane/lane slices:
+
+* grid over row-blocks of T = Tr·128 rows; ``vals`` (nd, n) rides the
+  pallas pipeline as (nd, Tr, 128) blocks (auto double-buffered),
+* x, zero-padded and 128-aligned on both ends, stays in HBM; the kernel
+  copies rows [i·Tr + q_min , i·Tr + q_max + Tr + 1) of its (rows, 128)
+  view once per block,
+* diagonal k with aligned offset a_k = q_k·128 + r_k reads the window at
+  sublane shift (q_k − q_min) and lane rotation r_k — a static two-slice
+  lane concat, no gathers anywhere.
+
+Reference analog: the CUDA DIA kernel family dispatched from
+``multiply.cu:94-110``; roofline contract: bytes ≈ (nd+2)·4·n moved once.
+
+f64 (refinement residuals) and sub-128-row matrices stay on the XLA path
+— Mosaic has no emulated f64, and tiny levels are latency-bound anyway.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: VMEM budget for the vals block (bytes); Tr adapts to the diagonal count
+#: (4 MB → Tr=1024 for 7-pt: vals×2 (pipeline) + window + y×2 ≈ 9 MB VMEM)
+_VALS_BLOCK_BYTES = 4 << 20
+#: largest |offset| the windowed DMA supports before falling back
+_MAX_ABS_OFFSET = 4 << 20
+#: test hook: run the kernel in the pallas interpreter (works on CPU)
+_INTERPRET = os.environ.get("AMGX_PALLAS_INTERPRET", "") == "1"
+
+
+def dia_spmv_supported(n: int, offsets: Sequence[int], dtype) -> bool:
+    if jnp.dtype(dtype) != jnp.float32:
+        return False
+    if n % 128 != 0 or n < 16384:
+        return False
+    if not offsets or max(abs(o) for o in offsets) > _MAX_ABS_OFFSET:
+        return False
+    return True
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _dia_spmv_call(vals, xp2, meta):
+    (nd, n_rows128, Tr, W, q_base, q_rel, r_lane, grid) = meta
+
+    def kernel(xp_ref, vals_ref, y_ref, xw, sem):
+        i = pl.program_id(0)
+        cp = pltpu.make_async_copy(
+            xp_ref.at[pl.ds(i * Tr + q_base, W), :], xw, sem)
+        cp.start()
+        cp.wait()
+        acc = None
+        for k in range(nd):
+            d, r = q_rel[k], r_lane[k]
+            if r == 0:
+                shifted = xw[d:d + Tr, :]
+            else:
+                shifted = jnp.concatenate(
+                    [xw[d:d + Tr, r:], xw[d + 1:d + Tr + 1, :r]], axis=1)
+            term = vals_ref[k] * shifted
+            acc = term if acc is None else acc + term
+        y_ref[:] = acc
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_rows128, 128), vals.dtype),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),           # xp2 stays in HBM
+            # literals via jnp.int32: under jax_enable_x64 a Python 0
+            # becomes i64 and Mosaic rejects the mixed-width index tuple
+            pl.BlockSpec((nd, Tr, 128),
+                         lambda i: (jnp.int32(0), i, jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((Tr, 128), lambda i: (i, jnp.int32(0)),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((W, 128), vals.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+        interpret=_INTERPRET,
+    )(xp2, vals.reshape(nd, n_rows128, 128))
+
+
+def dia_spmv(A, x: jax.Array) -> jax.Array:
+    """y = A @ x for a DIA DeviceMatrix via the Pallas kernel."""
+    n = A.n_rows
+    offs = A.dia_offsets
+    nd = len(offs)
+
+    # block rows: fit the vals block in its VMEM budget (multiple of 8 —
+    # the sublane tile — as Pallas requires of block dims)
+    Tr = max(8, min(1024, (_VALS_BLOCK_BYTES // (nd * 128 * 4)) // 8 * 8))
+    n_rows128 = n // 128
+    grid = -(-n_rows128 // Tr)
+    n_cov = grid * Tr * 128                     # grid-covered rows
+
+    o_min, o_max = min(min(offs), 0), max(max(offs), 0)
+    L = (-(-(-o_min) // 128)) * 128 if o_min < 0 else 0
+    # aligned absolute offsets a_k = L + o_k = q_k·128 + r_k
+    q = [(L + o) // 128 for o in offs]
+    r = [(L + o) % 128 for o in offs]
+    q_min, q_max = min(q), max(q)
+    W = -(-(q_max - q_min + Tr + 1) // 8) * 8     # sublane-aligned window
+    # right pad: tail cover + o_max reach + the window's alignment slack
+    R = (n_cov - n) + ((o_max + 127) // 128) * 128 + 128 * (W - (q_max -
+        q_min + Tr))
+    xp2 = jnp.pad(x, (L, R)).reshape(-1, 128)
+    # q_min is folded into the kernel's DMA base row — no forward slice
+    # (that slice was a full extra copy of x per SpMV)
+    q_rel = tuple(qk - q_min for qk in q)
+    meta = (nd, n_rows128, Tr, W, q_min, q_rel, tuple(r), grid)
+    y2 = _dia_spmv_call(A.vals, xp2, meta)
+    return y2.reshape(-1)[:n]

@@ -27,6 +27,10 @@ def spmv(A, x: jax.Array) -> jax.Array:
         from ..distributed.matrix import dist_spmv
         return dist_spmv(A, x)
     if A.fmt == "dia":
+        from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
+        if ((jax.default_backend() == "tpu" or _INTERPRET)
+                and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)):
+            return dia_spmv(A, x)
         # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
         # padded copy of x — no gathers (reference SpMV kernel dispatch
         # multiply.cu:94-110; this is the TPU-optimal stencil path)

@@ -216,7 +216,8 @@ class Solver:
     # ------------------------------------------------------------- solve API
     def _tolerance_floor(self, dtype) -> float:
         """Smallest relative residual honestly reachable in ``dtype``."""
-        return 25.0 * float(np.finfo(np.dtype(dtype)).eps)
+        # jnp.finfo also understands ml_dtypes (bfloat16); np.finfo raises
+        return 25.0 * float(jnp.finfo(jnp.dtype(dtype)).eps)
 
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
@@ -248,9 +249,14 @@ class Solver:
         dist = self.Ad.fmt == "sharded-ell"
 
         floor = self._tolerance_floor(dtype)
+        # refinement requires an f32 device pack: the rounding residue
+        # lo = vals64 − f64(f32(vals64)) reconstructs the exact f64
+        # operator only when hi is the f32 rounding (a bf16 hi+lo pair
+        # would be ~1e-7 off and could declare false convergence)
         refine = (self.monitor_residual and self.tolerance < floor
                   and not dist and self.scaler is None
                   and self.A is not None
+                  and jnp.dtype(dtype) == jnp.float32
                   and np.dtype(self.A.host.dtype).itemsize >
                   np.dtype(dtype).itemsize)
         if (self.monitor_residual and self.tolerance < floor

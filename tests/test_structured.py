@@ -31,10 +31,8 @@ def _structured_coarse(A, dims):
     offs3 = decompose_offsets(offs, dims)
     if offs3 is None:
         return None
-    offs3_c, vals_c, cdims = structured_galerkin(offs3, vals, dims)
-    cz, cy, cx = cdims
-    flat = [(dz * cy + dy) * cx + dx for dz, dy, dx in offs3_c]
-    return dia_to_scipy(flat, vals_c, cz * cy * cx)
+    _, flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
+    return dia_to_scipy(flat, vals_c, int(np.prod(cdims)))
 
 
 @pytest.mark.parametrize("dims", [(6, 6, 6), (5, 6, 7), (1, 8, 8),
@@ -67,6 +65,43 @@ def test_ambiguous_inner_dims_fall_back(dims):
     A = poisson7pt(nx, ny, nz)
     offs, _ = dia_arrays(sp.csr_matrix(A))
     assert decompose_offsets(offs, dims) is None
+
+
+def test_periodic_stencil_rejected():
+    """Periodic wrap diagonals decode as phantom interior moves — the
+    value-consistency check must reject them (was: silent wrong coarse
+    operator)."""
+    from amgx_tpu.amg.structured import stencil_values_consistent
+    nx = 8
+    # 2D periodic 5-pt Laplacian on 8×8
+    n = nx * nx
+    A = sp.lil_matrix((n, n))
+    for yy in range(nx):
+        for xx in range(nx):
+            i = yy * nx + xx
+            A[i, i] = 4.0
+            for Dx, Dy in ((1, 0), (-1, 0), (0, 1), (0, -1)):
+                j = ((yy + Dy) % nx) * nx + (xx + Dx) % nx
+                A[i, j] -= 1.0
+    A = sp.csr_matrix(A)
+    offs, vals = dia_arrays(A)
+    dims = (1, nx, nx)
+    offs3 = decompose_offsets(offs, dims)
+    assert offs3 is None or not stencil_values_consistent(offs3, vals, dims)
+
+
+def test_bad_grid_dims_attach_falls_back():
+    """A wrong user grid_dims attach must not crash setup."""
+    A = poisson7pt(6, 6, 6)
+    m = amgx.Matrix(A)
+    m.grid_dims = (10, 10, 10)          # prod != n
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(s)=AMG, s:algorithm=AGGREGATION, "
+        "s:selector=GEO, s:max_iters=1, s:monitor_residual=0, "
+        "s:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "s:coarse_solver=BLOCK_JACOBI")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)                         # must not raise
 
 
 def test_infer_grid_dims():
