@@ -308,7 +308,7 @@ class AMGHierarchy:
         cdims = coarse_dims(dims)
         if int(np.prod(cdims)) >= int(np.prod(dims)):
             return None
-        _, flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
+        flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
         return dia_to_scipy(flat, vals_c, int(np.prod(cdims))), cdims
 
     @staticmethod

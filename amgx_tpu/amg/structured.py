@@ -130,8 +130,7 @@ def structured_galerkin(offsets3: List[Off3], vals: np.ndarray, dims: Dims):
 
     ``vals`` is (nd, n) row-aligned: A[i, i+flat(d)] = vals[k, i] with
     zeros where the stencil leaves the grid.  Returns
-    (coarse offsets3, coarse flat offsets, coarse vals (ndc, nc),
-    coarse dims).
+    (coarse flat offsets, coarse vals (ndc, nc), coarse dims).
     """
     nz, ny, nx = dims
     cz, cy, cx = coarse_dims(dims)
@@ -168,11 +167,10 @@ def structured_galerkin(offsets3: List[Off3], vals: np.ndarray, dims: Dims):
             continue
         flat = (dz * cy + dy) * cx + dx
         if flat in out:            # distinct tuples, same flat offset —
-            out[flat][1] += buf    # only possible on degenerate tiny grids
+            out[flat] = out[flat] + buf  # only on degenerate tiny grids
         else:
-            out[flat] = [(dz, dy, dx), buf]
+            out[flat] = buf
     flat_sorted = sorted(out)
-    offs3_c = [out[f][0] for f in flat_sorted]
-    vals_c = np.stack([out[f][1].reshape(-1) for f in flat_sorted]) \
+    vals_c = np.stack([out[f].reshape(-1) for f in flat_sorted]) \
         if flat_sorted else np.zeros((0, nc), dtype=vals.dtype)
-    return offs3_c, flat_sorted, vals_c, (cz, cy, cx)
+    return flat_sorted, vals_c, (cz, cy, cx)

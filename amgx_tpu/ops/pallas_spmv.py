@@ -43,12 +43,23 @@ _MAX_ABS_OFFSET = 4 << 20
 _INTERPRET = os.environ.get("AMGX_PALLAS_INTERPRET", "") == "1"
 
 
+def _block_rows(nd: int) -> int:
+    """Block rows Tr: vals block fits its VMEM budget, multiple of 8."""
+    return max(8, min(1024, (_VALS_BLOCK_BYTES // (nd * 128 * 4)) // 8 * 8))
+
+
 def dia_spmv_supported(n: int, offsets: Sequence[int], dtype) -> bool:
     if jnp.dtype(dtype) != jnp.float32:
         return False
     if n % 128 != 0 or n < 16384:
         return False
     if not offsets or max(abs(o) for o in offsets) > _MAX_ABS_OFFSET:
+        return False
+    # the x-window scratch (offset span + Tr rows of 128 lanes) must fit
+    # its VMEM share, or the kernel would fail to compile rather than
+    # fall back to the XLA path
+    span_rows = (max(offsets) - min(offsets)) // 128 + 2
+    if (span_rows + _block_rows(len(offsets))) * 512 > (6 << 20):
         return False
     return True
 
@@ -103,9 +114,7 @@ def dia_spmv(A, x: jax.Array) -> jax.Array:
     offs = A.dia_offsets
     nd = len(offs)
 
-    # block rows: fit the vals block in its VMEM budget (multiple of 8 —
-    # the sublane tile — as Pallas requires of block dims)
-    Tr = max(8, min(1024, (_VALS_BLOCK_BYTES // (nd * 128 * 4)) // 8 * 8))
+    Tr = _block_rows(nd)
     n_rows128 = n // 128
     grid = -(-n_rows128 // Tr)
     n_cov = grid * Tr * 128                     # grid-covered rows

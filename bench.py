@@ -61,23 +61,37 @@ def main():
         v = jax.lax.fori_loop(0, K, body, v)
         return jnp.sum(v)
 
-    def timed(K, reps=3):
-        float(spmv_chain(Ad, x, K))  # compile + warm
+    def timed(K, Adf, reps=3):
+        float(spmv_chain(Adf, x, K))  # compile + warm
         t0 = time.perf_counter()
         for _ in range(reps):
-            float(spmv_chain(Ad, x, K))  # host fetch = true sync
+            float(spmv_chain(Adf, x, K))  # host fetch = true sync
         return (time.perf_counter() - t0) / reps
 
-    k1, k2 = 10, 210
-    spmv_t = max((timed(k2) - timed(k1)) / (k2 - k1), 1e-9)
-    spmv_gflops = 2.0 * A.nnz / spmv_t / 1e9
-    itemsize = dtype.itemsize
-    if Ad.fmt == "dia":
-        bytes_moved = (Ad.ell_width + 2) * n * itemsize
-    else:  # ELL: values at the value dtype + int32 column indices
-        bytes_moved = (Ad.ell_width + 2) * n * itemsize + \
-            Ad.ell_width * n * 4
-    spmv_gbs = bytes_moved / spmv_t / 1e9
+    def measure(Adf, k1=10, k2=210):
+        t = max((timed(k2, Adf) - timed(k1, Adf)) / (k2 - k1), 1e-9)
+        itemsize = dtype.itemsize
+        if Adf.fmt == "dia":
+            bytes_moved = (Adf.ell_width + 2) * n * itemsize
+        elif Adf.fmt == "ell":  # values + int32 column indices
+            bytes_moved = (Adf.ell_width + 2) * n * itemsize + \
+                Adf.ell_width * n * 4
+        else:  # CSR: nnz vals + int32 cols/row_ids + x/y vectors
+            bytes_moved = A.nnz * (itemsize + 8) + 2 * n * itemsize
+        return t, 2.0 * A.nnz / t / 1e9, bytes_moved / t / 1e9
+
+    spmv_t, spmv_gflops, spmv_gbs = measure(Ad)
+    # per-format throughput (BASELINE.md metric 2 wants CSR GFLOPS/chip):
+    # repack the same operator as ELL (gather) and CSR (segment-sum)
+    from amgx_tpu.core.matrix import pack_device
+    fmt_stats = {Ad.fmt: round(spmv_gflops, 2)}
+    for fmt_name, kw in (("ell", dict(dia_max_diags=0)),
+                         ("csr", dict(dia_max_diags=0, ell_max_width=0))):
+        if n > 3_000_000:
+            break      # gather formats at 256³ exceed sane bench time
+        Af = pack_device(m.host, 1, dtype, **kw)
+        _, gf, _ = measure(Af, 2, 22)
+        fmt_stats[fmt_name] = round(gf, 2)
 
     # ---------------- FGMRES + aggregation AMG ----------------
     cfg = amgx.AMGConfig(
@@ -121,6 +135,7 @@ def main():
             "spmv_gflops": round(spmv_gflops, 3),
             "spmv_gbs": round(spmv_gbs, 1),
             "spmv_s": round(spmv_t, 8),
+            "spmv_gflops_by_format": fmt_stats,
             "matrix_fmt": Ad.fmt,
             "device_dtype": str(dtype),
         },

@@ -1,8 +1,13 @@
-"""Test configuration: run everything on an 8-device virtual CPU mesh.
+"""Test configuration.
 
+Default tier: everything runs on an 8-device virtual CPU mesh.
 Multi-node behaviour is simulated single-process (the reference does the
 same with in-process partitions, ``generated_matrix_distributed_io.cu`` —
 SURVEY.md §4.4); distributed tests shard over the 8 virtual devices.
+
+TPU tier: ``pytest -m tpu`` leaves the platform alone so the real chip is
+used (the reference analog is the mode-keyed test driver,
+``testframework.h:56-120``).  TPU-marked tests are skipped on CPU runs.
 """
 import os
 
@@ -10,13 +15,32 @@ os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")
 
 import jax
-
-# The axon TPU plugin ignores JAX_PLATFORMS env; the config knob works.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
-
 import numpy as np
 import pytest
+
+
+def _tpu_tier(config) -> bool:
+    # exact match: 'pytest -m "not tpu"' must remain a CPU-tier run
+    return (config.getoption("-m") or "").strip() == "tpu"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: runs on the real TPU chip (pytest -m tpu)")
+    if not _tpu_tier(config):
+        # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
+        # works.
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+
+def pytest_collection_modifyitems(config, items):
+    if _tpu_tier(config):
+        return
+    skip = pytest.mark.skip(reason="TPU tier (run with: pytest -m tpu)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture

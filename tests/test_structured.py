@@ -31,7 +31,7 @@ def _structured_coarse(A, dims):
     offs3 = decompose_offsets(offs, dims)
     if offs3 is None:
         return None
-    _, flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
+    flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
     return dia_to_scipy(flat, vals_c, int(np.prod(cdims)))
 
 
