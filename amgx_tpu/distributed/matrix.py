@@ -33,35 +33,45 @@ import numpy as np
 import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..errors import BadParametersError
 from .partition import Partition, build_partition
 
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["cols", "vals", "diag", "send_idx", "halo_src"],
+    data_fields=["cols", "vals", "diag", "send_idx", "halo_src",
+                 "bnd_rows", "send_idx2", "halo_src2"],
     meta_fields=["n_global", "n_parts", "n_loc", "ell_width", "block_dim",
-                 "axis", "use_ring", "offsets", "mesh"],
+                 "axis", "dists", "dists2", "offsets", "mesh"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardedMatrix:
     """Frozen sharded ELL pack (leading axis = mesh axis ``p``).
 
     ``cols`` index into the per-shard extended vector
-    ``[x_local (n_loc) | halo (H)]``.
+    ``[x_local (n_loc) | halo (H)]``.  ``bnd_rows`` lists each shard's
+    boundary rows (padded with the trash slot ``n_loc``) so SpMV can
+    overlap the halo exchange with the interior compute; ``send_idx2`` /
+    ``halo_src2`` are the ring-2 B2L maps (``distributed_manager.h:
+    284-305`` per-ring maps).
     """
 
     cols: jax.Array       # (P, n_loc, K) int32
     vals: jax.Array       # (P, n_loc, K)
     diag: jax.Array       # (P·n_loc,) flat, sharded like vectors
-    send_idx: jax.Array   # (P, B) int32 — B2L gather map
-    halo_src: jax.Array   # (P, H) int32 — into flattened (P·B) gathered buf
+    send_idx: jax.Array   # (P, B) int32 — ring-1 B2L gather map
+    halo_src: jax.Array   # (P, H) int32 — d_slot·B + pos into recv bufs
+    bnd_rows: jax.Array   # (P, Bd) int32 — boundary rows, pad → n_loc
+    send_idx2: jax.Array  # (P, B2) int32 — ring-2 B2L gather map
+    halo_src2: jax.Array  # (P, H2) int32
     n_global: int
     n_parts: int
     n_loc: int
     ell_width: int
     block_dim: int
     axis: str             # mesh axis name
-    use_ring: bool
+    dists: tuple          # ring-1 rank distances (owner − p) mod P
+    dists2: tuple         # ring-2 rank distances
     offsets: tuple        # (P+1,) real row offsets per rank
     #: static (meta) so traced packs keep it — tracers have no .sharding
     mesh: Mesh = None
@@ -139,7 +149,9 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
     dtype = np.dtype(dtype or A.dtype)
     mesh = _auto_mesh(mesh)
     n_parts = mesh.shape[axis]
-    part = partition or build_partition(A, n_parts, offsets)
+    part = partition or build_partition(A, n_parts, offsets, n_rings=2)
+    if len(part.rings) < 2:
+        raise BadParametersError("shard_matrix requires a 2-ring partition")
     if n_loc is not None and n_loc > part.n_loc:
         part = dataclasses.replace(part, n_loc=n_loc)
     n_loc = part.n_loc
@@ -182,53 +194,111 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
     spec3 = NamedSharding(mesh, P(axis, None, None))
     spec2 = NamedSharding(mesh, P(axis, None))
     spec1 = NamedSharding(mesh, P(axis))
+    r2 = part.rings[1]
     return ShardedMatrix(
         cols=jax.device_put(cols, spec3),
         vals=jax.device_put(vals, spec3),
         diag=jax.device_put(diag.reshape(-1), spec1),
         send_idx=jax.device_put(part.send_idx, spec2),
         halo_src=jax.device_put(part.halo_src, spec2),
+        bnd_rows=jax.device_put(part.bnd_rows, spec2),
+        send_idx2=jax.device_put(r2.send_idx, spec2),
+        halo_src2=jax.device_put(r2.halo_src, spec2),
         n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
         ell_width=K, block_dim=1, axis=axis,
-        use_ring=part.ring_neighbors_only,
+        dists=part.dists, dists2=r2.dists,
         offsets=tuple(int(o) for o in part.offsets), mesh=mesh)
 
 
 # --------------------------------------------------------------------------
 # distributed SpMV
 # --------------------------------------------------------------------------
+def _exchange(buf: jax.Array, dists: tuple, axis: str,
+              n_parts: int) -> jax.Array:
+    """Distance-wise neighbour exchange: rank p receives, for each d in
+    ``dists``, rank (p+d) mod P's send buffer — one ``ppermute`` per
+    distance (neighbour-wise like ``comms_mpi_hostbuffer_stream.cu:
+    354-523``, O(D·B) instead of the all-gather's O(P·B)).  Falls back to
+    one all_gather when the link set is dense."""
+    if n_parts == 1:
+        return buf
+    if len(dists) >= n_parts - 1:
+        all_bufs = jax.lax.all_gather(buf, axis)            # (P, B)
+        i = jax.lax.axis_index(axis)
+        order = (i + jnp.asarray(dists, jnp.int32)) % n_parts
+        return all_bufs[order].reshape(-1)
+    parts = []
+    for d in dists:
+        # source s delivers to (s − d) mod P ⇒ rank p receives from p+d
+        perm = [(s, (s - d) % n_parts) for s in range(n_parts)]
+        parts.append(jax.lax.ppermute(buf, axis, perm))
+    return jnp.concatenate(parts)
+
+
+def exchange_halo(A: ShardedMatrix, x: jax.Array, ring: int = 1
+                  ) -> jax.Array:
+    """Gather the ring-``ring`` halo values of sharded ``x``: returns a
+    (P, H_ring) array whose row p holds the values of
+    ``partition.rings[ring-1].halo_global[p]`` (reference
+    ``exchange_halo``, rings machinery of ``vector.h:38-51``)."""
+    if ring not in (1, 2):
+        raise BadParametersError(f"halo ring must be 1 or 2, got {ring}")
+    axis = A.axis
+    send_idx = A.send_idx if ring == 1 else A.send_idx2
+    halo_src = A.halo_src if ring == 1 else A.halo_src2
+    dists = A.dists if ring == 1 else A.dists2
+
+    def local(si, hs, xl):
+        buf = xl[si[0]]
+        got = _exchange(buf, dists, axis, A.n_parts)
+        return got[hs[0]][None]
+
+    return jax.shard_map(
+        local, mesh=A.mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis)),
+        out_specs=P(axis, None),
+    )(send_idx, halo_src, x)
+
+
 def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
-    """y = A·x for a flat sharded x of length P·n_loc (call under jit)."""
+    """y = A·x for a flat sharded x of length P·n_loc (call under jit).
+
+    Interior/boundary latency hiding (``multiply.cu:113-196``): the
+    interior term reads only local x (halo slots as zeros) and carries no
+    data dependency on the exchange, so XLA's async collectives overlap
+    the ppermutes with the bulk gather/multiply; boundary rows then get a
+    small gathered correction scattered back through a trash slot.
+    """
     axis = A.axis
     n_parts = A.n_parts
 
-    def local(cols, vals, send_idx, halo_src, xl):
+    def local(cols, vals, send_idx, halo_src, bnd_rows, xl):
         cols, vals = cols[0], vals[0]
-        send_idx, halo_src = send_idx[0], halo_src[0]
+        send_idx, halo_src, bnd = send_idx[0], halo_src[0], bnd_rows[0]
+        n_loc = xl.shape[0]
+        H = halo_src.shape[0]
         buf = xl[send_idx]                                  # B2L gather
-        if A.use_ring and n_parts > 2:
-            # neighbour-only ppermute schedule (ICI ring, SURVEY §5.7)
-            B = buf.shape[0]
-            right = [(i, (i + 1) % n_parts) for i in range(n_parts)]
-            left = [(i, (i - 1) % n_parts) for i in range(n_parts)]
-            from_left = jax.lax.ppermute(buf, axis, right)
-            from_right = jax.lax.ppermute(buf, axis, left)
-            idx = jax.lax.axis_index(axis)
-            q = halo_src // B
-            pos = halo_src % B
-            halo = jnp.where(q == idx - 1, from_left[pos], from_right[pos])
-        else:
-            all_bufs = jax.lax.all_gather(buf, axis)        # (P, B)
-            halo = all_bufs.reshape(-1)[halo_src]           # (H,)
-        xfull = jnp.concatenate([xl, halo])
-        return jnp.sum(vals * xfull[cols], axis=1)
+        got = _exchange(buf, A.dists, axis, n_parts)
+        hvals = got[halo_src]                               # (H,)
+        # interior: halo slots read zero — independent of the exchange
+        xfull0 = jnp.concatenate([xl, jnp.zeros((H,), xl.dtype)])
+        y0 = jnp.sum(vals * xfull0[cols], axis=1)
+        # boundary correction: only rows with halo columns
+        rows = jnp.minimum(bnd, n_loc - 1)
+        cb = cols[rows]                                     # (Bd, K)
+        vb = vals[rows]
+        hb = jnp.where(cb >= n_loc,
+                       vb * hvals[jnp.clip(cb - n_loc, 0, H - 1)], 0.0)
+        corr = jnp.sum(hb, axis=1)                          # (Bd,)
+        yext = jnp.zeros((n_loc + 1,), xl.dtype).at[bnd].add(corr)
+        return y0 + yext[:n_loc]
 
     return jax.shard_map(
         local, mesh=A.mesh,
         in_specs=(P(axis, None, None), P(axis, None, None),
-                  P(axis, None), P(axis, None), P(axis)),
+                  P(axis, None), P(axis, None), P(axis, None), P(axis)),
         out_specs=P(axis),
-    )(A.cols, A.vals, A.send_idx, A.halo_src, x)
+    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, x)
 
 
 def vector_sharding(A: ShardedMatrix) -> NamedSharding:

@@ -7,26 +7,55 @@ TPU-native re-design of the reference distributed layer (SURVEY §2.8):
   send maps, L2H maps, halo offsets/ranges, interior-first renumbering.
 * ``DistributedArranger`` (``distributed_arranger.cu:85-140`` create_B2L)
   builds that state from global column indices + a partition vector.
+* Multi-ring halos: the reference keeps per-ring B2L maps
+  (``distributed_manager.h:284-305``, rings default 2, ``vector.h:38-51``
+  INTERIOR/BOUNDARY/HALO1/HALO2 views).
 
 Here the equivalent state is built on host by :func:`build_partition`:
 rows are partitioned into P equal contiguous shards (padded with identity
 rows), each shard's matrix is packed in ELL form with column indices into
-``[0, n_loc + H)`` where slots ``n_loc..n_loc+H`` hold received halo values;
-``send_idx`` (the B2L map) gathers boundary values into a fixed-size send
-buffer, and ``halo_src`` addresses the all-gathered send buffers.  At solve
-time the exchange is ``all_gather`` over the mesh axis (general graphs) —
-the ``lax.ppermute`` neighbour schedule lives in
-:mod:`amgx_tpu.distributed.spmv` for ring partitions.
+``[0, n_loc + H)`` where slots ``n_loc..n_loc+H`` hold received ring-1 halo
+values; ``send_idx`` (the B2L map) gathers boundary values into a
+fixed-size send buffer.
+
+Exchange layout is **distance-wise** (the neighbour-wise schedule of
+``comms_mpi_hostbuffer_stream.cu:354-523``, re-expressed as ICI
+collectives): the union of neighbour links is a small set of rank
+distances d = (owner − p) mod P; the solve-time exchange issues one
+``ppermute`` per distance, and ``halo_src`` addresses the received
+buffers as d_slot·B + position.  ``bnd_rows`` lists each rank's boundary
+rows (rows with any halo column) so the SpMV can overlap the exchange
+with the interior compute and apply only a small boundary correction.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 import scipy.sparse as sp
 
 from ..errors import BadParametersError
+
+
+@dataclasses.dataclass
+class Ring:
+    """One halo ring's maps (B2L send side + receive addressing)."""
+
+    dists: Tuple[int, ...]      # rank distances (owner − p) mod P, sorted
+    send_idx: np.ndarray        # (P, B) local row ids to send (B2L map)
+    send_count: np.ndarray      # (P,)
+    halo_src: np.ndarray        # (P, H) d_slot·B + pos into recv buffers
+    halo_count: np.ndarray      # (P,)
+    halo_global: List[np.ndarray]   # per-rank global col/row ids of slots
+
+    @property
+    def B(self):
+        return self.send_idx.shape[1]
+
+    @property
+    def H(self):
+        return self.halo_src.shape[1]
 
 
 @dataclasses.dataclass
@@ -37,22 +66,48 @@ class Partition:
     n_parts: int
     n_loc: int                  # padded rows per shard
     offsets: np.ndarray         # (P+1,) original row offsets per rank
-    # per-rank halo structure (lists of arrays, rank-major)
-    send_idx: np.ndarray        # (P, B) local row ids to send (B2L map)
-    send_count: np.ndarray      # (P,)
-    halo_src: np.ndarray        # (P, H) index into flattened (P*B) gathered buf
-    halo_count: np.ndarray      # (P,)
-    halo_global: List[np.ndarray]   # per-rank global col ids of halo slots
+    rings: List[Ring]           # ring 1 (+ ring 2 when requested)
     neighbors: List[np.ndarray]     # per-rank neighbour rank lists
-    ring_neighbors_only: bool = False  # every neighbour is rank±1
+    bnd_rows: np.ndarray        # (P, Bd) boundary row ids (pad → n_loc)
+    bnd_count: np.ndarray       # (P,)
+
+    # ring-1 shorthands (the SpMV pack consumes these)
+    @property
+    def send_idx(self):
+        return self.rings[0].send_idx
+
+    @property
+    def halo_src(self):
+        return self.rings[0].halo_src
+
+    @property
+    def halo_global(self):
+        return self.rings[0].halo_global
+
+    @property
+    def dists(self):
+        return self.rings[0].dists
 
     @property
     def B(self):
-        return self.send_idx.shape[1]
+        return self.rings[0].B
 
     @property
     def H(self):
-        return self.halo_src.shape[1]
+        return self.rings[0].H
+
+    @property
+    def halo_count(self):
+        return self.rings[0].halo_count
+
+    @property
+    def send_count(self):
+        return self.rings[0].send_count
+
+    @property
+    def ring_neighbors_only(self) -> bool:
+        """Every neighbour link is rank±1 (a 1D stencil partition)."""
+        return set(self.dists) <= {1, self.n_parts - 1}
 
 
 def partition_offsets_from_vector(partition_vector: np.ndarray,
@@ -72,13 +127,71 @@ def partition_offsets_from_vector(partition_vector: np.ndarray,
     return np.concatenate([[0], np.cumsum(counts)])
 
 
+def _build_ring(targets: List[np.ndarray], owner: np.ndarray,
+                offsets: np.ndarray, n_parts: int) -> Ring:
+    """Build one ring's maps from each rank's needed-global-ids lists."""
+    # send lists: union of what every rank needs from q, sorted —
+    # deterministic layout both sides can compute
+    need = [[None] * n_parts for _ in range(n_parts)]
+    for p, ext in enumerate(targets):
+        own = owner[ext] if len(ext) else np.zeros(0, dtype=np.int32)
+        for q in np.unique(own):
+            need[q][p] = ext[own == q]
+    send_lists: List[np.ndarray] = []
+    for q in range(n_parts):
+        allneed = [need[q][p] for p in range(n_parts)
+                   if need[q][p] is not None]
+        s = (np.unique(np.concatenate(allneed)) if allneed
+             else np.zeros(0, dtype=np.int64))
+        send_lists.append(s)
+
+    B = max(max((len(s) for s in send_lists), default=0), 1)
+    H = max(max((len(h) for h in targets), default=0), 1)
+
+    send_idx = np.zeros((n_parts, B), dtype=np.int32)
+    send_count = np.zeros(n_parts, dtype=np.int32)
+    for q, s in enumerate(send_lists):
+        send_idx[q, :len(s)] = s - offsets[q]  # local row ids
+        send_count[q] = len(s)
+
+    dset = set()
+    for p, ext in enumerate(targets):
+        if len(ext):
+            dset.update(int(d) for d in
+                        np.unique((owner[ext] - p) % n_parts))
+    dists = tuple(sorted(dset)) or (1,)
+    dslot = {d: i for i, d in enumerate(dists)}
+
+    halo_src = np.zeros((n_parts, H), dtype=np.int32)
+    halo_count = np.zeros(n_parts, dtype=np.int32)
+    for p, ext in enumerate(targets):
+        if not len(ext):
+            continue
+        own = owner[ext]
+        pos = np.empty(len(ext), dtype=np.int64)
+        slot = np.empty(len(ext), dtype=np.int64)
+        for q in np.unique(own):
+            mask = own == q
+            pos[mask] = np.searchsorted(send_lists[q], ext[mask])
+            slot[mask] = dslot[int((q - p) % n_parts)]
+        halo_src[p, :len(ext)] = slot * B + pos
+        halo_count[p] = len(ext)
+
+    return Ring(dists=dists, send_idx=send_idx, send_count=send_count,
+                halo_src=halo_src, halo_count=halo_count,
+                halo_global=targets)
+
+
 def build_partition(A: sp.csr_matrix, n_parts: int,
-                    offsets: Optional[np.ndarray] = None) -> Partition:
+                    offsets: Optional[np.ndarray] = None,
+                    n_rings: int = 2) -> Partition:
     """Analyse the global matrix and build all halo maps.
 
-    Equivalent of ``DistributedArranger::create_B2L`` + interior-first
-    renumbering (here rows keep their order; padding replaces renumbering
-    because SPMD shards must be equal-sized).
+    Equivalent of ``DistributedArranger::create_B2L`` (+``create_B2L``'s
+    ring-2 extension when ``n_rings=2``); rows keep their order — padding
+    replaces interior-first renumbering because SPMD shards must be
+    equal-sized, and the boundary set is carried as an explicit row list
+    instead.
     """
     A = sp.csr_matrix(A)
     n = A.shape[0]
@@ -94,59 +207,44 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
     for p in range(n_parts):
         owner[offsets[p]:offsets[p + 1]] = p
 
-    halo_global: List[np.ndarray] = []
+    halo1: List[np.ndarray] = []
     neighbors: List[np.ndarray] = []
-    # send_sets[q][p] = global rows of q needed by p
-    need = [[None] * n_parts for _ in range(n_parts)]
+    bnd_lists: List[np.ndarray] = []
     for p in range(n_parts):
         lo, hi = offsets[p], offsets[p + 1]
-        sub = A[lo:hi]
-        cols = np.unique(sub.indices)
-        ext = cols[(cols < lo) | (cols >= hi)]
-        halo_global.append(ext)
-        nb = np.unique(owner[ext])
-        neighbors.append(nb)
-        for q in nb:
-            need[q][p] = ext[owner[ext] == q]
+        sub = sp.csr_matrix(A[lo:hi])
+        cols = sub.indices
+        ext_mask = (cols < lo) | (cols >= hi)
+        ext = np.unique(cols[ext_mask])
+        halo1.append(ext)
+        neighbors.append(np.unique(owner[ext]))
+        rows = np.repeat(np.arange(hi - lo), np.diff(sub.indptr))
+        bnd_lists.append(np.unique(rows[ext_mask]))
 
-    # per-rank send lists (B2L): union of what every neighbour needs,
-    # sorted — deterministic layout both sides can compute
-    send_lists: List[np.ndarray] = []
-    for q in range(n_parts):
-        allneed = [need[q][p] for p in range(n_parts)
-                   if need[q][p] is not None]
-        s = (np.unique(np.concatenate(allneed)) if allneed
-             else np.zeros(0, dtype=np.int64))
-        send_lists.append(s)
+    Bd = max(max((len(b) for b in bnd_lists), default=0), 1)
+    bnd_rows = np.full((n_parts, Bd), n_loc, dtype=np.int32)  # pad→trash
+    bnd_count = np.zeros(n_parts, dtype=np.int32)
+    for p, bl in enumerate(bnd_lists):
+        bnd_rows[p, :len(bl)] = bl
+        bnd_count[p] = len(bl)
 
-    B = max((len(s) for s in send_lists), default=0)
-    B = max(B, 1)
-    H = max((len(h) for h in halo_global), default=0)
-    H = max(H, 1)
+    rings = [_build_ring(halo1, owner, offsets, n_parts)]
+    if n_rings >= 2:
+        halo2: List[np.ndarray] = []
+        for p in range(n_parts):
+            lo, hi = offsets[p], offsets[p + 1]
+            ring1 = halo1[p]
+            if len(ring1):
+                cols2 = np.unique(sp.csr_matrix(A[ring1]).indices)
+                known = np.concatenate(
+                    [ring1, np.arange(lo, hi, dtype=cols2.dtype)])
+                ext2 = np.setdiff1d(cols2, known)
+            else:
+                ext2 = np.zeros(0, dtype=np.int64)
+            halo2.append(ext2)
+        rings.append(_build_ring(halo2, owner, offsets, n_parts))
 
-    send_idx = np.zeros((n_parts, B), dtype=np.int32)
-    send_count = np.zeros(n_parts, dtype=np.int32)
-    for q, s in enumerate(send_lists):
-        send_idx[q, :len(s)] = s - offsets[q]  # local row ids
-        send_count[q] = len(s)
-
-    halo_src = np.zeros((n_parts, H), dtype=np.int32)
-    halo_count = np.zeros(n_parts, dtype=np.int32)
-    for p, ext in enumerate(halo_global):
-        own = owner[ext]
-        pos = np.empty(len(ext), dtype=np.int64)
-        for q in np.unique(own):
-            mask = own == q
-            pos[mask] = np.searchsorted(send_lists[q], ext[mask])
-        halo_src[p, :len(ext)] = own.astype(np.int64) * B + pos
-        halo_count[p] = len(ext)
-
-    ring = all((len(nb) == 0 or
-                np.all((nb == p - 1) | (nb == p + 1)))
-               for p, nb in enumerate(neighbors))
     return Partition(
-        n_global=n, n_parts=n_parts, n_loc=n_loc,
-        offsets=offsets, send_idx=send_idx, send_count=send_count,
-        halo_src=halo_src, halo_count=halo_count,
-        halo_global=halo_global, neighbors=neighbors,
-        ring_neighbors_only=bool(ring))
+        n_global=n, n_parts=n_parts, n_loc=n_loc, offsets=offsets,
+        rings=rings, neighbors=neighbors,
+        bnd_rows=bnd_rows, bnd_count=bnd_count)
