@@ -322,22 +322,35 @@ class AMGHierarchy:
         nc = (n_f + 1) // 2
         return dia_to_scipy(offs_c, vals_c, nc), n_f
 
+    @staticmethod
+    def _rank_blocks(cur: Matrix, offsets: np.ndarray):
+        """Per-rank row-block views of this level's matrix — direct in
+        block mode; sliced from the global host otherwise (the legacy
+        global-upload path)."""
+        if cur.host is None and cur.blocks is not None:
+            return cur.blocks
+        from ..distributed.partition import split_row_blocks
+        return split_row_blocks(cur.scalar_csr(), offsets)
+
     def _coarsen_aggregation_dist(self, cur: Matrix, idx: int, selector):
-        """Distributed aggregation coarsening.
+        """Distributed aggregation coarsening, per-rank end to end.
 
         Each rank aggregates its own diagonal block (the reference also
-        runs selectors per-rank, with halo aggregates resolved afterwards —
-        ``aggregation_amg_level.cu`` distributed path); coarse ids are
-        rank-contiguous so restriction/prolongation stay shard-local.
-        The coarse matrix keeps cross-rank couplings via the global
-        Galerkin product and inherits a distribution over the same mesh.
+        runs selectors per-rank, ``aggregation_amg_level.cu`` distributed
+        path); coarse ids are rank-contiguous so restriction/prolongation
+        stay shard-local.  The Galerkin product is computed per-rank from
+        the rank's row block — cross-rank couplings resolve through the
+        aggregate ids of halo columns (the ``exchange_halo_rows_P`` /
+        ``exchange_RAP_ext`` analog, ``distributed_arranger.h:223-231``)
+        — so no step assembles a global matrix, and the coarse level is
+        again a block-distributed Matrix.
         """
         mesh, axis, offsets, _ = cur.dist
         curd = cur.device()             # ShardedMatrix of this level
         offsets = np.asarray(curd.offsets)
         n_parts = curd.n_parts
-        Asc = cur.scalar_csr()
-        n = Asc.shape[0]
+        n = int(offsets[-1])
+        blocks = self._rank_blocks(cur, offsets)
         agg_real = np.empty(n, dtype=np.int64)
         counts = []
         base = 0
@@ -346,7 +359,7 @@ class AMGHierarchy:
             if hi == lo:
                 counts.append(0)
                 continue
-            sub = sp.csr_matrix(Asc[lo:hi, lo:hi])
+            sub = sp.csr_matrix(blocks[p][:, lo:hi])   # diagonal block
             agg_p = selector.select(sub)
             agg_real[lo:hi] = agg_p + base
             cnt = int(agg_p.max()) + 1 if len(agg_p) else 0
@@ -356,32 +369,72 @@ class AMGHierarchy:
         if nc == 0 or nc >= n:
             return None, None, None
         coarse_offsets = np.concatenate([[0], np.cumsum(counts)])
-        nc_loc = max(counts) + 1        # ≥1 padding slot per rank
-        Ac_host = galerkin_coarse(Asc, agg_real, 1)
+
+        # per-rank Galerkin: rank p's coarse rows from rank p's row block;
+        # agg_real[halo cols] is the halo-aggregate resolution (multi-host:
+        # one neighbour-wise int exchange)
+        def coarse_block(p):
+            lo, hi = offsets[p], offsets[p + 1]
+            coo = blocks[p].tocoo()
+            rows_c = agg_real[coo.row + lo] - coarse_offsets[p]
+            cols_c = agg_real[coo.col]
+            C = sp.csr_matrix((coo.data, (rows_c, cols_c)),
+                              shape=(counts[p], nc))
+            C.sum_duplicates()
+            C.sort_indices()
+            return C
+
+        c_blocks = [coarse_block(p) for p in range(n_parts)]
+
         # consolidation ("glue", distributed/glue.h + amg.cu:328-390):
-        # when the coarse grid is too small per rank, migrate it off the
-        # mesh — subsequent levels run replicated
+        # when the coarse grid is too small per rank, migrate it onto a
+        # SUB-mesh (fewer active ranks) or — when even one rank's worth —
+        # off the mesh entirely (replicated)
         lower = int(self.cfg.get("matrix_consolidation_lower_threshold"))
-        if lower > 0 and nc // n_parts < lower:
-            Ac = _child_matrix(cur, Ac_host)
-            n_loc_f = curd.n_loc
-            agg_pad = np.full(n_parts * n_loc_f, nc, dtype=np.int64)
-            for p in range(n_parts):
-                lo, hi = offsets[p], offsets[p + 1]
-                agg_pad[p * n_loc_f:p * n_loc_f + (hi - lo)] = \
-                    agg_real[lo:hi]
-            level = AggregationLevel(cur, idx, agg_pad, n_coarse=nc,
-                                     trash_segment=True)
-            return level, Ac, ("aggregation-consolidated", (agg_real, nc))
-        Ac = _child_matrix(cur, Ac_host)
-        Ac.set_distribution(mesh, axis, coarse_offsets, n_loc=nc_loc)
-        # aggregates in padded coordinates: fine pad rows → coarse pad slot
         n_loc_f = curd.n_loc
+        if lower > 0 and nc // n_parts < lower:
+            upper = max(int(self.cfg.get(
+                "matrix_consolidation_upper_threshold")), 1)
+            p_active = min(n_parts, max(1, -(-nc // upper)))
+            if p_active <= 1:
+                # fully consolidated: replicated coarse level
+                Ac_host = sp.csr_matrix(sp.vstack(c_blocks))
+                Ac = _child_matrix(cur, Ac_host)
+                agg_pad = np.full(n_parts * n_loc_f, nc, dtype=np.int64)
+                for p in range(n_parts):
+                    lo, hi = offsets[p], offsets[p + 1]
+                    agg_pad[p * n_loc_f:p * n_loc_f + (hi - lo)] = \
+                        agg_real[lo:hi]
+                level = AggregationLevel(cur, idx, agg_pad, n_coarse=nc,
+                                         trash_segment=True)
+                return level, Ac, ("aggregation-consolidated",
+                                   (agg_real, nc))
+            # sub-mesh: re-bucket coarse rows onto the first p_active
+            # ranks (equal split); the other ranks hold only padding
+            nc_act = -(-nc // p_active)
+            coarse_offsets = np.concatenate([
+                np.minimum(np.arange(p_active + 1) * nc_act, nc),
+                np.full(n_parts - p_active, nc, dtype=np.int64)])
+            c_blocks = _rebucket_blocks(c_blocks, coarse_offsets)
+
+        nc_loc = int(np.max(np.diff(coarse_offsets))) + 1  # ≥1 pad slot
+        Ac = Matrix()
+        Ac.set_distributed_blocks(c_blocks, coarse_offsets, mesh,
+                                  axis=axis)
+        Ac.dist = (mesh, axis, coarse_offsets, nc_loc)
+        Ac.device_dtype = cur.device_dtype
+        Ac.placement = cur.placement
+        # aggregates in padded coordinates: fine pad rows → coarse pad
+        # slot under the (possibly re-bucketed) coarse offsets
+        own = np.searchsorted(coarse_offsets, np.arange(nc),
+                              side="right") - 1
+        pad_of = own * nc_loc + (np.arange(nc) - coarse_offsets[own])
         agg_pad = np.empty(n_parts * n_loc_f, dtype=np.int64)
         for p in range(n_parts):
             lo, hi = offsets[p], offsets[p + 1]
-            row = np.full(n_loc_f, p * nc_loc + nc_loc - 1, dtype=np.int64)
-            row[:hi - lo] = agg_real[lo:hi] - coarse_offsets[p] + p * nc_loc
+            row = np.full(n_loc_f, p * nc_loc + nc_loc - 1,
+                          dtype=np.int64)
+            row[:hi - lo] = pad_of[agg_real[lo:hi]]
             agg_pad[p * n_loc_f:(p + 1) * n_loc_f] = row
         level = AggregationLevel(cur, idx, agg_pad,
                                  n_coarse=n_parts * nc_loc)
@@ -424,6 +477,13 @@ class AMGHierarchy:
                 "         ------------------------------------------\n"
                 f"         Grid Complexity: {grid_cmpl:.5g}\n"
                 f"         Operator Complexity: {op_cmpl:.5g}\n")
+
+
+def _rebucket_blocks(blocks, new_offsets):
+    """Re-split per-rank row blocks to new offsets (consolidation-time
+    only — the data being migrated is small by definition)."""
+    from ..distributed.partition import split_row_blocks
+    return split_row_blocks(sp.vstack(blocks), new_offsets)
 
 
 def _block_condensed(m: Matrix) -> sp.csr_matrix:

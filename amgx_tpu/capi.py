@@ -23,7 +23,7 @@ from . import io as _io
 from .config import AMGConfig
 from .core.matrix import Matrix
 from .eigen import EigenSolverFactory
-from .errors import AMGXError, RC, SolveStatus
+from .errors import AMGXError, BadParametersError, RC, SolveStatus
 from .modes import parse_mode
 from .solvers import SolverFactory
 from .utils import register_print_callback as _register_cb
@@ -244,7 +244,12 @@ def _apply_mode_policy(mtx: MatrixHandle):
     m.placement = mtx.mode.placement_device()
     eff = mtx.mode.effective_mat_dtype()
     if np.dtype(m.dtype) != eff:
-        m.set(m.host.astype(eff), block_dim=m.block_dim)
+        if m.host is None and m.blocks is not None:
+            m.blocks = [b.astype(eff) for b in m.blocks]
+            m.dtype = np.dtype(eff)
+            m._device = None
+        else:
+            m.set(m.host.astype(eff), block_dim=m.block_dim)
 
 
 @_catches(1)
@@ -597,15 +602,73 @@ def AMGX_matrix_upload_distributed(mtx: MatrixHandle, n_global, n, nnz,
                                    block_dimx, block_dimy, row_ptrs,
                                    col_indices_global, data, diag_data,
                                    distribution):
-    """``amgx_c.h:592-609`` with an AMGX_distribution handle."""
-    AMGX_matrix_upload_all.__wrapped__(
-        mtx, n, nnz, block_dimx, block_dimy, row_ptrs, col_indices_global,
-        data, diag_data)
+    """``amgx_c.h:592-609`` with an AMGX_distribution handle.
+
+    The reference contract is per-rank: each MPI rank passes its LOCAL
+    rows (``n < n_global``) with global column indices.  This embedding
+    is single-process, so successive calls with local blocks accumulate
+    on the handle until all partitions are uploaded (scalable path: the
+    global CSR is never assembled); a call with ``n == n_global`` is the
+    whole matrix at once (legacy path).
+    """
+    import scipy.sparse as _sp
+    n_global = int(n_global)
+    n = int(n)
+    offsets = None
     if distribution is not None:
         offsets = distribution.get("partition_offsets")
-        n_parts = (len(offsets) - 1 if offsets is not None
-                   else distribution.get("num_partitions", 1))
-        _maybe_distribute(mtx.matrix, n_parts, offsets)
+    if n == n_global or offsets is None:
+        mtx._pending_blocks = None    # abandon any partial block sequence
+        AMGX_matrix_upload_all.__wrapped__(
+            mtx, n, nnz, block_dimx, block_dimy, row_ptrs,
+            col_indices_global, data, diag_data)
+        if distribution is not None:
+            n_parts = (len(offsets) - 1 if offsets is not None
+                       else distribution.get("num_partitions", 1))
+            _maybe_distribute(mtx.matrix, n_parts, offsets)
+        return
+    # per-rank block accumulation (AMGX per-rank upload semantics):
+    # blocks arrive in rank order, validated against the offsets
+    if int(block_dimx) != 1 or int(block_dimy) != 1:
+        raise BadParametersError(
+            "distributed upload currently requires 1x1 blocks")
+    offsets = np.asarray(offsets)
+    pending = getattr(mtx, "_pending_blocks", None) or []
+    rank = len(pending)
+    expect = int(offsets[rank + 1] - offsets[rank]) \
+        if rank + 1 < len(offsets) else -1
+    if n != expect:
+        mtx._pending_blocks = None
+        raise BadParametersError(
+            f"distributed upload out of order: rank {rank} owns {expect} "
+            f"rows per the partition offsets, got {n}")
+    dtype = mtx.mode.mat_dtype
+    block = _sp.csr_matrix(
+        (np.asarray(data, dtype=dtype).ravel(),
+         np.asarray(col_indices_global).copy(),
+         np.asarray(row_ptrs).copy()), shape=(n, n_global))
+    if diag_data is not None:
+        # external-diagonal property: fold the separate diagonal in
+        # (upload_all does the same for the global path)
+        rows = np.arange(n)
+        block = _sp.csr_matrix(block + _sp.csr_matrix(
+            (np.asarray(diag_data, dtype=dtype).ravel(),
+             (rows, rows + int(offsets[rank]))), shape=(n, n_global)))
+    pending.append(block)
+    mtx._pending_blocks = pending
+    if len(pending) < len(offsets) - 1:
+        return                     # more ranks to come
+    n_parts = len(offsets) - 1
+    import jax as _jax
+    mtx.matrix = Matrix()
+    if len(_jax.devices()) >= n_parts > 1:
+        from .distributed import make_mesh
+        mtx.matrix.set_distributed_blocks(pending, offsets,
+                                          make_mesh(n_parts))
+    else:   # single-chip session: assemble and solve globally
+        mtx.matrix.set(_sp.vstack(pending).tocsr())
+    mtx._pending_blocks = None
+    _apply_mode_policy(mtx)
 
 
 @_catches(1)

@@ -133,6 +133,9 @@ class Matrix:
         self._device_dtype = None
         #: distribution spec: (mesh, axis, offsets, n_loc) or None
         self.dist = None
+        #: per-rank row blocks (scalable distributed upload) or None
+        self.blocks = None
+        self.block_offsets = None
         #: optional jax.Device to pin the pack to (host modes → CPU)
         self.placement = None
         #: preferred dtype of the device pack (mixed precision: host keeps
@@ -154,6 +157,41 @@ class Matrix:
         self.dist = (mesh, axis, offsets, n_loc)
         self._device = None
         return self
+
+    def set_distributed_blocks(self, blocks, offsets, mesh,
+                               axis: str = "p"):
+        """Upload per-rank row blocks (global column ids) — the true
+        ``AMGX_matrix_upload_distributed`` contract: the global matrix is
+        NEVER assembled, so host memory per processing step stays
+        O(rank block + halo).  Setup algorithms (partition maps, per-rank
+        coarsening, per-rank Galerkin) all consume the blocks directly
+        (reference: ``distributed_arranger.h:85-231``)."""
+        import scipy.sparse as _sp
+        blocks = [_sp.csr_matrix(b) for b in blocks]
+        offsets = np.asarray(offsets)
+        if len(blocks) != len(offsets) - 1:
+            raise BadParametersError("one row block per partition required")
+        for p, b in enumerate(blocks):
+            if b.shape[0] != offsets[p + 1] - offsets[p]:
+                raise BadParametersError(
+                    f"block {p} has {b.shape[0]} rows, offsets say "
+                    f"{offsets[p + 1] - offsets[p]}")
+        self.block_dim = 1
+        self.dtype = np.dtype(blocks[0].dtype)
+        self._host = None
+        self.blocks = blocks
+        self.block_offsets = offsets
+        self.dist = (mesh, axis, offsets, None)
+        self._device = None
+        return self
+
+    def assemble_global(self) -> sp.csr_matrix:
+        """Assemble the global matrix from blocks — for consolidation of
+        SMALL coarse grids and for test oracles only; never called by the
+        scalable setup path on fine levels."""
+        if self._host is not None:
+            return sp.csr_matrix(self._host)
+        return sp.csr_matrix(sp.vstack(self.blocks))
 
     # ------------------------------------------------------------------ setup
     def set(self, a, block_dim: int = 1):
@@ -211,24 +249,40 @@ class Matrix:
         return self._host
 
     def scalar_csr(self) -> sp.csr_matrix:
-        """The matrix as a scalar (non-block) CSR, for setup algorithms."""
+        """The matrix as a scalar (non-block) CSR, for setup algorithms.
+
+        Raises in block-distributed mode: scalable setup must consume
+        ``self.blocks`` per rank instead of a global view."""
+        if self._host is None and self.blocks is not None:
+            raise BadParametersError(
+                "global view of a block-distributed matrix requested — "
+                "setup algorithms must use .blocks (scalable contract); "
+                "assemble_global() exists for small consolidated grids")
         return sp.csr_matrix(self._host)
 
     @property
     def n_block_rows(self) -> int:
+        if self._host is None and self.blocks is not None:
+            return int(self.block_offsets[-1]) // self.block_dim
         return self._host.shape[0] // self.block_dim
 
     @property
     def n_block_cols(self) -> int:
+        if self._host is None and self.blocks is not None:
+            return self.blocks[0].shape[1] // self.block_dim
         return self._host.shape[1] // self.block_dim
 
     @property
     def shape(self):
+        if self._host is None and self.blocks is not None:
+            return (int(self.block_offsets[-1]), self.blocks[0].shape[1])
         return self._host.shape
 
     @property
     def nnz(self) -> int:
         # number of stored blocks × block area = scalar nnz
+        if self._host is None and self.blocks is not None:
+            return int(sum(b.nnz for b in self.blocks))
         return self._host.nnz
 
     # ---------------------------------------------------------------- packing
@@ -237,11 +291,17 @@ class Matrix:
         if self._device is not None and self._device_dtype == dtype:
             return self._device
         if self.dist is not None:
-            from ..distributed.matrix import shard_matrix
             mesh, axis, offsets, n_loc = self.dist
-            self._device = shard_matrix(self.scalar_csr(), mesh, axis=axis,
-                                        dtype=dtype, offsets=offsets,
-                                        n_loc=n_loc)
+            if self._host is None and self.blocks is not None:
+                from ..distributed.matrix import shard_matrix_from_blocks
+                self._device = shard_matrix_from_blocks(
+                    self.blocks, self.block_offsets, mesh, axis=axis,
+                    dtype=dtype, n_loc=n_loc)
+            else:
+                from ..distributed.matrix import shard_matrix
+                self._device = shard_matrix(self.scalar_csr(), mesh,
+                                            axis=axis, dtype=dtype,
+                                            offsets=offsets, n_loc=n_loc)
         else:
             self._device = pack_device(self._host, self.block_dim, dtype,
                                        ell_max_width)

@@ -139,28 +139,56 @@ def _auto_mesh(mesh: Mesh) -> Mesh:
 def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
                  dtype=None, offsets=None, n_loc: Optional[int] = None,
                  partition: Optional[Partition] = None) -> ShardedMatrix:
-    """Pack a global CSR matrix into a ShardedMatrix laid out over ``mesh``.
-
-    Mirrors ``DistributedManager::loadDistributedMatrix``
-    (``distributed_manager.h:1815``): build B2L maps, renumber columns to
-    [local | halo] slots, pad shards to equal size with identity rows.
-    """
+    """Pack a global CSR matrix into a ShardedMatrix laid out over ``mesh``
+    (convenience wrapper: splits into per-rank row blocks first)."""
     A = sp.csr_matrix(A)
-    dtype = np.dtype(dtype or A.dtype)
     mesh = _auto_mesh(mesh)
     n_parts = mesh.shape[axis]
-    part = partition or build_partition(A, n_parts, offsets, n_rings=2)
+    if partition is not None:
+        offsets = np.asarray(partition.offsets)
+    elif offsets is None:
+        n = A.shape[0]
+        nl = -(-n // n_parts)
+        offsets = np.minimum(np.arange(n_parts + 1) * nl, n)
+    else:
+        offsets = np.asarray(offsets)
+    from .partition import split_row_blocks
+    return shard_matrix_from_blocks(split_row_blocks(A, offsets), offsets,
+                                    mesh, axis=axis, dtype=dtype,
+                                    n_loc=n_loc, partition=partition)
+
+
+def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
+                             dtype=None, n_loc: Optional[int] = None,
+                             partition: Optional[Partition] = None
+                             ) -> ShardedMatrix:
+    """Pack per-rank row blocks (global column ids) into a ShardedMatrix.
+
+    The scalable-setup entry point — no step materialises a global matrix
+    (``AMGX_matrix_upload_distributed`` semantics).  Mirrors
+    ``DistributedManager::loadDistributedMatrix``
+    (``distributed_manager.h:1815``): build B2L maps from per-rank data
+    (``distributed_arranger.h:85-140``), renumber columns to
+    [local | halo] slots, pad shards to equal size with identity rows.
+    """
+    from .partition import build_partition_from_blocks
+    blocks = [sp.csr_matrix(b) for b in blocks]
+    offsets = np.asarray(offsets)
+    dtype = np.dtype(dtype or blocks[0].dtype)
+    mesh = _auto_mesh(mesh)
+    n_parts = mesh.shape[axis]
+    if len(blocks) != n_parts:
+        raise BadParametersError(
+            f"{len(blocks)} row blocks for a {n_parts}-way mesh axis")
+    part = partition or build_partition_from_blocks(blocks, offsets,
+                                                    n_rings=2)
     if len(part.rings) < 2:
         raise BadParametersError("shard_matrix requires a 2-ring partition")
     if n_loc is not None and n_loc > part.n_loc:
         part = dataclasses.replace(part, n_loc=n_loc)
     n_loc = part.n_loc
-    K = 1
-    for p in range(n_parts):
-        lo, hi = part.offsets[p], part.offsets[p + 1]
-        deg = np.diff(A.indptr[lo:hi + 1])
-        if len(deg):
-            K = max(K, int(deg.max()))
+    K = max((int(np.diff(b.indptr).max()) if b.nnz else 1
+             for b in blocks), default=1)
 
     cols = np.zeros((n_parts, n_loc, K), dtype=np.int32)
     vals = np.zeros((n_parts, n_loc, K), dtype=dtype)
@@ -168,7 +196,7 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
     for p in range(n_parts):
         lo, hi = part.offsets[p], part.offsets[p + 1]
         nl = hi - lo
-        sub = sp.csr_matrix(A[lo:hi])
+        sub = blocks[p]
         sub.sort_indices()
         ext = part.halo_global[p]
         gcols = sub.indices.astype(np.int64)
@@ -183,8 +211,10 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
         pos = np.arange(len(gcols)) - np.repeat(sub.indptr[:-1], deg)
         cols[p, rr, pos] = lcols
         vals[p, rr, pos] = sub.data
-        d = A.diagonal()[lo:hi]
-        diag[p, :nl] = d
+        on_diag = gcols == rr + lo
+        # add (not assign): duplicate diagonal entries are legal CSR
+        # input and the ELL pack sums them too
+        np.add.at(diag[p], rr[on_diag], sub.data[on_diag])
         # identity padding rows
         r = np.arange(nl, n_loc)
         cols[p, r, 0] = r

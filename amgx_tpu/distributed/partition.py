@@ -110,6 +110,15 @@ class Partition:
         return set(self.dists) <= {1, self.n_parts - 1}
 
 
+def split_row_blocks(A: sp.spmatrix, offsets: np.ndarray
+                     ) -> List[sp.csr_matrix]:
+    """Split a global matrix into per-rank row blocks (global col ids)."""
+    A = sp.csr_matrix(A)
+    offsets = np.asarray(offsets)
+    return [sp.csr_matrix(A[offsets[p]:offsets[p + 1]])
+            for p in range(len(offsets) - 1)]
+
+
 def partition_offsets_from_vector(partition_vector: np.ndarray,
                                   n_parts: int) -> np.ndarray:
     """Partition vector (rank id per row, rank-contiguous) → offsets.
@@ -185,14 +194,8 @@ def _build_ring(targets: List[np.ndarray], owner: np.ndarray,
 def build_partition(A: sp.csr_matrix, n_parts: int,
                     offsets: Optional[np.ndarray] = None,
                     n_rings: int = 2) -> Partition:
-    """Analyse the global matrix and build all halo maps.
-
-    Equivalent of ``DistributedArranger::create_B2L`` (+``create_B2L``'s
-    ring-2 extension when ``n_rings=2``); rows keep their order — padding
-    replaces interior-first renumbering because SPMD shards must be
-    equal-sized, and the boundary set is carried as an explicit row list
-    instead.
-    """
+    """Analyse a *global* matrix and build all halo maps (convenience
+    wrapper over :func:`build_partition_from_blocks`)."""
     A = sp.csr_matrix(A)
     n = A.shape[0]
     if offsets is None:
@@ -200,6 +203,26 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
         offsets = np.minimum(np.arange(n_parts + 1) * n_loc, n)
     else:
         offsets = np.asarray(offsets)
+    return build_partition_from_blocks(split_row_blocks(A, offsets),
+                                       offsets, n_rings=n_rings)
+
+
+def build_partition_from_blocks(blocks: List[sp.csr_matrix],
+                                offsets: np.ndarray,
+                                n_rings: int = 2) -> Partition:
+    """Build all halo maps from per-rank row blocks (global column ids) —
+    the scalable setup contract: no step touches more than one rank's
+    block plus its halo rows.
+
+    Equivalent of ``DistributedArranger::create_B2L``
+    (``distributed_arranger.h:85-140`` builds B2L from per-rank data) with
+    the ring-2 extension; rows keep their order — padding replaces
+    interior-first renumbering because SPMD shards must be equal-sized,
+    and the boundary set is carried as an explicit row list instead.
+    """
+    offsets = np.asarray(offsets)
+    n_parts = len(blocks)
+    n = int(offsets[-1])
     n_loc = int(np.max(np.diff(offsets)))
 
     # which rank owns each global row
@@ -212,7 +235,7 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
     bnd_lists: List[np.ndarray] = []
     for p in range(n_parts):
         lo, hi = offsets[p], offsets[p + 1]
-        sub = sp.csr_matrix(A[lo:hi])
+        sub = blocks[p]
         cols = sub.indices
         ext_mask = (cols < lo) | (cols >= hi)
         ext = np.unique(cols[ext_mask])
@@ -235,9 +258,15 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
             lo, hi = offsets[p], offsets[p + 1]
             ring1 = halo1[p]
             if len(ring1):
-                cols2 = np.unique(sp.csr_matrix(A[ring1]).indices)
+                # ring-1 halo rows live in the owners' blocks (the
+                # multi-host analog exchanges those rows neighbour-wise)
+                cols2 = np.unique(np.concatenate([
+                    blocks[q].indices[
+                        blocks[q].indptr[r0]:blocks[q].indptr[r1]]
+                    for q, r0, r1 in _owner_row_runs(ring1, owner, offsets)
+                ]))
                 known = np.concatenate(
-                    [ring1, np.arange(lo, hi, dtype=cols2.dtype)])
+                    [ring1, np.arange(lo, hi, dtype=np.int64)])
                 ext2 = np.setdiff1d(cols2, known)
             else:
                 ext2 = np.zeros(0, dtype=np.int64)
@@ -248,3 +277,17 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
         n_global=n, n_parts=n_parts, n_loc=n_loc, offsets=offsets,
         rings=rings, neighbors=neighbors,
         bnd_rows=bnd_rows, bnd_count=bnd_count)
+
+
+def _owner_row_runs(rows: np.ndarray, owner: np.ndarray,
+                    offsets: np.ndarray):
+    """Split a sorted global-row list into (owner, local_lo, local_hi+1)
+    runs of CONSECUTIVE local rows so indptr slicing stays vectorised."""
+    out = []
+    for q in np.unique(owner[rows]):
+        rq = rows[owner[rows] == q] - offsets[q]
+        # split into consecutive runs
+        breaks = np.where(np.diff(rq) != 1)[0] + 1
+        for run in np.split(rq, breaks):
+            out.append((int(q), int(run[0]), int(run[-1]) + 1))
+    return out

@@ -22,7 +22,12 @@ class DenseLUSolver(Solver):
 
     def solver_setup(self):
         if self.A is not None:
-            dense = np.asarray(self.A.host.todense(), dtype=self.Ad.dtype)
+            # block-distributed coarsest: the coarsest grid is tiny, so
+            # assembling it here is the consolidation, not a scalability
+            # leak
+            host = (self.A.assemble_global() if self.A.host is None
+                    and self.A.blocks is not None else self.A.host)
+            dense = np.asarray(host.todense(), dtype=self.Ad.dtype)
         else:
             dense = _densify_device(self.Ad)
         if self.Ad.fmt == "sharded-ell":

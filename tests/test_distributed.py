@@ -239,3 +239,104 @@ def test_dist_spmv_multi_distance_schedule(mesh, rng):
     y = unshard_vector(sm, jax.jit(lambda v: dist_spmv(sm, v))(
         shard_vector(sm, x)))
     np.testing.assert_allclose(y, A @ x, rtol=1e-12)
+
+
+def _poisson_blocks(nx, ny, nz, n_parts):
+    """Per-rank row blocks of a global Poisson WITHOUT keeping the global
+    (test builds it once as the oracle only)."""
+    A = sp.csr_matrix(poisson7pt(nx, ny, nz))
+    n = A.shape[0]
+    nl = -(-n // n_parts)
+    offsets = np.minimum(np.arange(n_parts + 1) * nl, n)
+    blocks = [sp.csr_matrix(A[offsets[p]:offsets[p + 1]])
+              for p in range(n_parts)]
+    return A, blocks, offsets
+
+
+def test_block_upload_solve_matches_global(mesh):
+    """set_distributed_blocks: scalable upload (no global CSR) solves the
+    same system to the same answer as the global-upload path."""
+    A, blocks, offsets = _poisson_blocks(16, 16, 16, 8)
+    b = np.sin(np.arange(A.shape[0]))
+    cfgs = ("config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+            "out:monitor_residual=1, out:tolerance=1e-8, "
+            "out:convergence=RELATIVE_INI, out:gmres_n_restart=20, "
+            "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+            "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=12, "
+            "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+            "amg:presweeps=1, amg:postsweeps=2, amg:min_coarse_rows=16, "
+            "amg:coarse_solver=DENSE_LU_SOLVER")
+    m = amgx.Matrix()
+    m.set_distributed_blocks(blocks, offsets, mesh)
+    assert m.host is None
+    with pytest.raises(Exception):
+        m.scalar_csr()          # the scalable contract, enforced
+    slv = amgx.create_solver(amgx.AMGConfig(cfgs))
+    slv.setup(m)
+    # hierarchy coarse levels stay block-distributed (no global assembly)
+    lvl1_A = slv.preconditioner.hierarchy.levels[1].A
+    assert lvl1_A.blocks is not None and lvl1_A.host is None
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert relres < 1e-7, (relres, res.iterations)
+
+
+def test_block_setup_never_assembles_large(mesh, monkeypatch):
+    """The scalable-setup memory contract: nothing bigger than a
+    consolidated coarse grid is ever assembled globally."""
+    A, blocks, offsets = _poisson_blocks(12, 12, 12, 8)
+    assembled = []
+    orig = amgx.Matrix.assemble_global
+
+    def spy(self):
+        assembled.append(self.shape[0])
+        return orig(self)
+
+    monkeypatch.setattr(amgx.Matrix, "assemble_global", spy)
+    m = amgx.Matrix()
+    m.set_distributed_blocks(blocks, offsets, mesh)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=2, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    n = A.shape[0]
+    assert assembled, "coarsest-level consolidation expected"
+    assert max(assembled) <= n // 4, assembled
+
+
+def test_submesh_consolidation(mesh):
+    """Glue analog: a too-small coarse grid migrates onto a sub-mesh
+    (fewer active ranks) before full replication (glue.h:73-263)."""
+    A, blocks, offsets = _poisson_blocks(12, 12, 12, 8)
+    b = np.ones(A.shape[0])
+    m = amgx.Matrix()
+    m.set_distributed_blocks(blocks, offsets, mesh)
+    cfg = amgx.AMGConfig(
+        "config_version=2, matrix_consolidation_lower_threshold=200, "
+        "matrix_consolidation_upper_threshold=300, "
+        "solver(out)=PCG, out:max_iters=100, out:monitor_residual=1, "
+        "out:tolerance=1e-8, out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=2, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    # level-1 coarse (1728 → ~864 rows, 108/rank < 200) must sit on a
+    # sub-mesh: ceil(864/300) = 3 active ranks
+    lvls = slv.preconditioner.hierarchy.levels
+    c_off = np.asarray(lvls[1].A.dist[2])
+    active = int(np.sum(np.diff(c_off) > 0))
+    assert 1 < active < 8, c_off
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert relres < 1e-7, (relres, res.iterations)
