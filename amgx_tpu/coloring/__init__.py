@@ -251,6 +251,24 @@ def color_matrix(matrix, cfg, scope) -> MatrixColoring:
         return cached
     scheme = str(cfg.get("matrix_coloring_scheme", scope))
     algo = create_coloring(scheme, cfg, scope)
+    if getattr(matrix, "blocks", None) is not None \
+            and getattr(matrix, "host", 1) is None:
+        # block-distributed matrix: color each rank's diagonal block
+        # independently (the reference also colors per-rank; cross-rank
+        # edges are relaxed Jacobi-style by the masked sharded sweep)
+        offs = matrix.block_offsets
+        parts = []
+        num = 1
+        for p, blk in enumerate(matrix.blocks):
+            lo, hi = offs[p], offs[p + 1]
+            sub = sp.csr_matrix(blk[:, lo:hi])
+            cp = algo.color(sub)
+            parts.append(cp.colors)
+            num = max(num, cp.num_colors)
+        coloring = MatrixColoring(np.concatenate(parts)
+                                  if parts else np.zeros(0, np.int64), num)
+        matrix.coloring = coloring
+        return coloring
     if hasattr(matrix, "block_dim") and matrix.block_dim > 1:
         # color the block graph: one color per block row (matrix.h:108)
         bd = matrix.block_dim

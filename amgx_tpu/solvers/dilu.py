@@ -34,6 +34,51 @@ from .base import Solver, register_solver
 from .jacobi import _apply_dinv
 
 
+def _scalar_dilu_factor(csr: sp.csr_matrix, colors: np.ndarray):
+    """Scalar DILU factorisation on one matrix: returns (L, U, 1/E) with
+    L/U the strict lower/upper parts in color-rank order."""
+    csr = sp.csr_matrix(csr)
+    csr.sort_indices()
+    n = csr.shape[0]
+    rows = np.repeat(np.arange(n), np.diff(csr.indptr))
+    cr_i = colors[rows]
+    cr_j = colors[csr.indices]
+    lower = cr_j < cr_i
+    upper = cr_j > cr_i
+    a_ji = _transpose_aligned_values(csr)
+    diag = csr.diagonal().astype(np.float64)
+    E = np.zeros(n, dtype=np.float64)
+    Einv = np.zeros(n, dtype=np.float64)
+    num_colors = int(colors.max()) + 1 if n else 1
+    for c in range(num_colors):
+        rc = colors == c
+        contrib = np.zeros(n, dtype=np.float64)
+        mask = lower & rc[rows]
+        np.add.at(contrib, rows[mask],
+                  csr.data[mask] * Einv[csr.indices[mask]] * a_ji[mask])
+        E[rc] = diag[rc] - contrib[rc]
+        bad = rc & (E == 0)
+        E[bad] = 1.0
+        Einv[rc] = 1.0 / E[rc]
+    L = sp.csr_matrix((np.where(lower, csr.data, 0.0),
+                       csr.indices.copy(), csr.indptr.copy()),
+                      shape=csr.shape)
+    L.eliminate_zeros()
+    U = sp.csr_matrix((np.where(upper, csr.data, 0.0),
+                       csr.indices.copy(), csr.indptr.copy()),
+                      shape=csr.shape)
+    U.eliminate_zeros()
+    return L, U, Einv
+
+
+def _shift_cols(M: sp.csr_matrix, shift: int, n_cols: int
+                ) -> sp.csr_matrix:
+    """Re-embed a local-column matrix at global column offset ``shift``."""
+    M = sp.coo_matrix(M)
+    return sp.csr_matrix((M.data, (M.row, M.col + shift)),
+                         shape=(M.shape[0], n_cols))
+
+
 def _transpose_aligned_values(csr: sp.csr_matrix) -> np.ndarray:
     """For each stored entry (i,j) return a_ji (0 when (j,i) not stored)."""
     n = csr.shape[0]
@@ -66,45 +111,50 @@ class MulticolorDILUSolver(Solver):
 
         # entry classification in color-rank order
         if b == 1:
-            csr = self.A.scalar_csr()
-            csr.sort_indices()
-            n = csr.shape[0]
-            rows = np.repeat(np.arange(n), np.diff(csr.indptr))
-            cr_i = colors[rows]
-            cr_j = colors[csr.indices]
-            lower = cr_j < cr_i
-            upper = cr_j > cr_i
-            a_ji = _transpose_aligned_values(csr)
-            diag = csr.diagonal().astype(np.float64)
-            E = np.zeros(n, dtype=np.float64)
-            Einv = np.zeros(n, dtype=np.float64)
-            order = np.argsort(colors, kind="stable")
-            for c in range(self.num_colors):
-                rc = colors == c
-                contrib = np.zeros(n, dtype=np.float64)
-                mask = lower & rc[rows]
-                np.add.at(contrib, rows[mask],
-                          csr.data[mask] * Einv[csr.indices[mask]] *
-                          a_ji[mask])
-                E[rc] = diag[rc] - contrib[rc]
-                bad = rc & (E == 0)
-                E[bad] = 1.0
-                Einv[rc] = 1.0 / E[rc]
-            L = sp.csr_matrix((np.where(lower, csr.data, 0.0),
-                               csr.indices.copy(), csr.indptr.copy()),
-                              shape=csr.shape)
-            L.eliminate_zeros()
-            U = sp.csr_matrix((np.where(upper, csr.data, 0.0),
-                               csr.indices.copy(), csr.indptr.copy()),
-                              shape=csr.shape)
-            U.eliminate_zeros()
+            if dist and self.A.host is None and self.A.blocks is not None:
+                # block-distributed level: per-rank local-block DILU —
+                # E and L/U factor each rank's diagonal block (the
+                # reference's distributed DILU also factors the local
+                # matrix; cross-rank couplings relax through the outer
+                # residual)
+                offs = self.A.block_offsets
+                L_blocks, U_blocks, Einv_parts = [], [], []
+                for p, blk in enumerate(self.A.blocks):
+                    lo, hi = offs[p], offs[p + 1]
+                    sub = sp.csr_matrix(blk[:, lo:hi])
+                    cp = colors[lo:hi]
+                    Lp, Up, Einv_p = _scalar_dilu_factor(sub, cp)
+                    # re-embed into global columns for the sharded pack
+                    L_blocks.append(_shift_cols(Lp, lo, blk.shape[1]))
+                    U_blocks.append(_shift_cols(Up, lo, blk.shape[1]))
+                    Einv_parts.append(Einv_p)
+                L = U = None
+                Einv = np.concatenate(Einv_parts)
+            else:
+                csr = self.A.scalar_csr()
+                csr.sort_indices()
+                L, U, Einv = _scalar_dilu_factor(csr, colors)
+            self.L_slabs = self.U_slabs = None
             if dist:
-                from ..distributed.matrix import shard_matrix, shard_vector
+                from ..distributed.matrix import (shard_matrix,
+                                                  shard_matrix_from_blocks,
+                                                  shard_vector)
                 mesh, axis, offsets, n_loc = self.A.dist
-                self.Ld = shard_matrix(L, mesh, axis, self.Ad.dtype,
-                                       offsets=offsets, n_loc=self.Ad.n_loc)
-                self.Ud = shard_matrix(U, mesh, axis, self.Ad.dtype,
-                                       offsets=offsets, n_loc=self.Ad.n_loc)
+                if L is None:      # block-distributed level
+                    offs = self.A.block_offsets
+                    self.Ld = shard_matrix_from_blocks(
+                        L_blocks, offs, mesh, axis, self.Ad.dtype,
+                        n_loc=self.Ad.n_loc)
+                    self.Ud = shard_matrix_from_blocks(
+                        U_blocks, offs, mesh, axis, self.Ad.dtype,
+                        n_loc=self.Ad.n_loc)
+                else:
+                    self.Ld = shard_matrix(L, mesh, axis, self.Ad.dtype,
+                                           offsets=offsets,
+                                           n_loc=self.Ad.n_loc)
+                    self.Ud = shard_matrix(U, mesh, axis, self.Ad.dtype,
+                                           offsets=offsets,
+                                           n_loc=self.Ad.n_loc)
                 # identity pad rows contribute E=1 in L/U packs; zero them
                 # out of the sweeps by masking with real-row Einv
                 self.Einv = shard_vector(self.Ad, Einv)
@@ -112,13 +162,20 @@ class MulticolorDILUSolver(Solver):
                 for c in range(self.num_colors):
                     masks.append(shard_vector(
                         self.Ad, (colors == c).astype(np.float64)) > 0.5)
+                self.color_masks = masks
             else:
-                self.Ld = pack_device(L, 1, self.Ad.dtype)
-                self.Ud = pack_device(U, 1, self.Ad.dtype)
-                self.Einv = jnp.asarray(Einv.astype(self.Ad.dtype))
-                masks = [jnp.asarray(colors == c)
-                         for c in range(self.num_colors)]
-            self.color_masks = masks
+                # per-color packed slabs (the reference's per-color
+                # kernels): each sweep touches only its color's rows —
+                # O(nnz) total per DILU application
+                from .gs import build_color_slabs
+                dt = self.Ad.dtype
+                self.L_slabs = build_color_slabs(
+                    L, colors, self.num_colors, dt)
+                self.U_slabs = build_color_slabs(
+                    U, colors, self.num_colors, dt)
+                self.Einv = jnp.asarray(Einv.astype(dt))
+                self.Ld = self.Ud = None
+                self.color_masks = None
             self.block = False
         else:
             self._setup_block(colors)
@@ -167,17 +224,59 @@ class MulticolorDILUSolver(Solver):
         Ub = sp.bsr_matrix((np.where(upper[:, None, None], bsr.data, 0.0),
                             cols_.copy(), bsr.indptr.copy()),
                            shape=bsr.shape)
-        self.Ld = pack_device(Lb, bd, self.Ad.dtype)
-        self.Ud = pack_device(Ub, bd, self.Ad.dtype)
-        self.Einv = jnp.asarray(Einv.astype(self.Ad.dtype))
-        self.color_masks = [
-            jnp.asarray(np.repeat(colors == c, bd))
-            for c in range(int(colors.max()) + 1)]
+        from .gs import build_color_slabs_block
         self.num_colors = int(colors.max()) + 1
+        self.L_slabs = build_color_slabs_block(
+            Lb, colors, self.num_colors, self.Ad.dtype, bd)
+        self.U_slabs = build_color_slabs_block(
+            Ub, colors, self.num_colors, self.Ad.dtype, bd)
+        self.Einv = jnp.asarray(Einv.astype(self.Ad.dtype))
+        self.Ld = self.Ud = None
+        self.color_masks = None
         self.block = True
+        self.block_dim = bd
 
     def _apply_dilu(self, r):
         """z = M⁻¹ r via the two color-ordered sweeps."""
+        if getattr(self, "L_slabs", None) is not None:
+            # per-color slab sweeps: color c reads only its L/U rows
+            if not self.block:
+                y = jnp.zeros_like(r)
+                for c in range(self.num_colors):
+                    s = self.L_slabs[c]
+                    t = jnp.sum(s.vals * y[s.cols], axis=1)
+                    y = y.at[s.rows].set(
+                        self.Einv[s.rows] * (r[s.rows] - t))
+                z = y
+                for c in range(self.num_colors - 1, -1, -1):
+                    s = self.U_slabs[c]
+                    t = jnp.sum(s.vals * z[s.cols], axis=1)
+                    z = z.at[s.rows].set(
+                        y[s.rows] - self.Einv[s.rows] * t)
+                return z
+            bd = self.block_dim
+            dt = r.dtype
+            y = jnp.zeros_like(r)
+            for c in range(self.num_colors):
+                s = self.L_slabs[c]
+                t = jnp.einsum("nkab,nkb->na", s.vals,
+                               y.reshape(-1, bd)[s.cols],
+                               preferred_element_type=dt)
+                rhs = r.reshape(-1, bd)[s.rows] - t
+                upd = jnp.einsum("nab,nb->na", self.Einv[s.rows], rhs,
+                                 preferred_element_type=dt)
+                y = y.reshape(-1, bd).at[s.rows].set(upd).reshape(-1)
+            z = y
+            for c in range(self.num_colors - 1, -1, -1):
+                s = self.U_slabs[c]
+                t = jnp.einsum("nkab,nkb->na", s.vals,
+                               z.reshape(-1, bd)[s.cols],
+                               preferred_element_type=dt)
+                upd = y.reshape(-1, bd)[s.rows] - jnp.einsum(
+                    "nab,nb->na", self.Einv[s.rows], t,
+                    preferred_element_type=dt)
+                z = z.reshape(-1, bd).at[s.rows].set(upd).reshape(-1)
+            return z
         y = jnp.zeros_like(r)
         for c in range(self.num_colors):
             t = spmv(self.Ld, y)

@@ -168,3 +168,58 @@ def test_scalers(scaling):
     x = np.asarray(res.x)
     relres = np.linalg.norm(b - As @ x) / np.linalg.norm(b)
     assert relres < 1e-6, (scaling, relres)
+
+
+def test_color_slabs_cover_rows_once():
+    """Per-color packed sweeps: the slabs partition the rows, so one
+    sweep costs O(nnz) total regardless of the color count (VERDICT #5 /
+    multicolor_dilu_solver.cu per-color kernels)."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson9pt
+    A = sp.csr_matrix(poisson9pt(12, 12))
+    m = amgx.Matrix(A)
+    cfg = amgx.AMGConfig("config_version=2, solver(s)=MULTICOLOR_GS, "
+                         "s:max_iters=2")
+    slv = amgx.SolverFactory.create("MULTICOLOR_GS", cfg, "s")
+    slv.setup(m)
+    assert slv.color_slabs is not None
+    n = A.shape[0]
+    rows = np.concatenate([np.asarray(s.rows) for s in slv.color_slabs])
+    assert len(rows) == n and len(np.unique(rows)) == n
+    # total slab nnz capacity is bounded by padded-row nnz, NOT
+    # num_colors × nnz
+    cap = sum(int(np.prod(np.asarray(s.cols).shape))
+              for s in slv.color_slabs)
+    deg_max = int(np.diff(A.indptr).max())
+    assert cap <= n * deg_max
+
+
+def test_slab_gs_matches_masked_gs():
+    """The packed sweep performs the identical relaxation to the masked
+    full-width formulation."""
+    import scipy.sparse as sp
+    import jax.numpy as jnp
+    from amgx_tpu.io import poisson5pt
+    A = sp.csr_matrix(poisson5pt(9, 9))
+    n = A.shape[0]
+    b = np.sin(np.arange(n))
+    cfg = amgx.AMGConfig("config_version=2, solver(s)=MULTICOLOR_GS, "
+                         "s:max_iters=3, s:monitor_residual=0")
+    slv = amgx.SolverFactory.create("MULTICOLOR_GS", cfg, "s")
+    slv.setup(amgx.Matrix(A))
+    assert slv.color_slabs is not None
+    x_slab = np.asarray(slv.solve(b).x)
+
+    # force the masked path by dropping the slabs
+    slv2 = amgx.SolverFactory.create("MULTICOLOR_GS", cfg, "s")
+    slv2.setup(amgx.Matrix(A))
+    masks = []
+    colors = np.zeros(n, dtype=np.int64)
+    for c, s in enumerate(slv2.color_slabs):
+        colors[np.asarray(s.rows)] = c
+    for c in range(slv2.num_colors):
+        masks.append(jnp.asarray(colors == c))
+    slv2.color_slabs = None
+    slv2.color_masks = masks
+    x_mask = np.asarray(slv2.solve(b).x)
+    np.testing.assert_allclose(x_slab, x_mask, rtol=1e-12, atol=1e-13)

@@ -340,3 +340,27 @@ def test_submesh_consolidation(mesh):
     x = np.asarray(res.x)
     relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
     assert relres < 1e-7, (relres, res.iterations)
+
+
+@pytest.mark.parametrize("smoother", ["MULTICOLOR_DILU", "MULTICOLOR_GS"])
+def test_distributed_amg_with_colored_smoothers(mesh, smoother):
+    """Colored smoothers must work on block-distributed coarse levels
+    (regression: scalar_csr raise propagated into smoother setup)."""
+    A = poisson7pt(10, 10, 10)
+    b = np.ones(A.shape[0])
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=SIZE_2, amg:max_iters=1, "
+        f"amg:smoother(sm)={smoother}, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert relres < 1e-7, (relres, res.iterations)
