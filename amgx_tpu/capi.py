@@ -244,7 +244,15 @@ def _apply_mode_policy(mtx: MatrixHandle):
     m.placement = mtx.mode.placement_device()
     eff = mtx.mode.effective_mat_dtype()
     if np.dtype(m.dtype) != eff:
-        if m.host is None and m.blocks is not None:
+        if np.dtype(eff).itemsize < np.dtype(m.dtype).itemsize:
+            # narrower device than the uploaded data (dDDI on TPU):
+            # KEEP the wide host matrix and narrow only the device pack —
+            # mixed-precision refinement then recovers full-precision
+            # residuals (the dDFI path), instead of silently degrading
+            # every solve to fp32 accuracy
+            m.device_dtype = np.dtype(eff)
+            m._device = None
+        elif m.host is None and m.blocks is not None:
             m.blocks = [b.astype(eff) for b in m.blocks]
             m.dtype = np.dtype(eff)
             m._device = None
@@ -669,6 +677,7 @@ def AMGX_matrix_upload_distributed(mtx: MatrixHandle, n_global, n, nnz,
         mtx.matrix.set(_sp.vstack(pending).tocsr())
     mtx._pending_blocks = None
     _apply_mode_policy(mtx)
+    _try_validate_comm_maps(mtx)   # maps may have arrived before upload
 
 
 @_catches(1)
@@ -739,6 +748,247 @@ def AMGX_eigensolver_solve(es: EigenSolverHandle, x: VectorHandle):
 @_catches()
 def AMGX_eigensolver_destroy(es: EigenSolverHandle):
     es.solver = None
+
+
+# ------------------------------------------------------- error/abort tail
+_RC_STRINGS = {
+    RC.OK: "No error.",
+    RC.BAD_PARAMETERS: "Incorrect parameters to AMGX call.",
+    RC.UNKNOWN: "Unknown error.",
+    RC.NOT_SUPPORTED_TARGET: "Unsupported target.",
+    RC.NOT_SUPPORTED_BLOCKSIZE: "Unsupported block size.",
+    RC.CUDA_FAILURE: "Device failure.",
+    RC.THRUST_FAILURE: "Device library failure.",
+    RC.NO_MEMORY: "Insufficient memory.",
+    RC.IO_ERROR: "I/O error.",
+    RC.BAD_MODE: "Invalid mode.",
+    RC.CORE: "Error initializing amgx core.",
+    RC.PLUGIN: "Error initializing plugins.",
+    RC.BAD_CONFIGURATION: "Invalid configuration.",
+    RC.NOT_IMPLEMENTED: "Not implemented.",
+    RC.LICENSE_NOT_FOUND: "License not found.",
+    RC.INTERNAL: "Internal error.",
+}
+
+
+@_catches(1)
+def AMGX_get_error_string(err):
+    """``amgx_c.h:182-186`` — human-readable RC description."""
+    try:
+        rc = RC(int(err))
+    except ValueError:
+        return f"Unknown error code {int(err)}."
+    return _RC_STRINGS.get(rc, rc.name.replace("_", " ").capitalize())
+
+
+def AMGX_abort(rsrc, err):
+    """``amgx_c.h:196`` — report and terminate the process (the reference
+    aborts the communicator; never returns)."""
+    from .utils.logging import amgx_output
+    try:
+        rc_txt = AMGX_get_error_string(err)
+        msg = rc_txt[1] if isinstance(rc_txt, tuple) else str(err)
+        amgx_output(f"AMGX_abort: error {int(err)} ({msg})\n")
+    finally:
+        os._exit(int(err) if err else 1)
+
+
+# ------------------------------------------- user-supplied halo comm maps
+def _record_comm_maps(mtx: MatrixHandle, entry: dict):
+    """Accumulate per-rank comm maps (one call per rank, like the per-rank
+    upload path) and validate against the matrix's own partition analysis
+    once all ranks have reported.
+
+    In this single-process SPMD embedding the halo maps are derivable
+    from the uploaded blocks, so user maps serve as a cross-check (and
+    let reference drivers that supply their own maps run unchanged):
+    inconsistent neighbor lists are rejected with BAD_PARAMETERS.
+    """
+    pend = getattr(mtx, "_pending_comm", None) or []
+    pend.append(entry)
+    mtx._pending_comm = pend
+    _try_validate_comm_maps(mtx)
+
+
+def _try_validate_comm_maps(mtx: MatrixHandle):
+    """Validate accumulated comm maps once both the matrix and a full set
+    of per-rank maps exist — re-invoked from the upload completion path
+    so maps-before-upload call orders (the reference driver order) also
+    validate.  Entries are taken in rank order, matching the per-rank
+    upload's enforced ordering."""
+    pend = getattr(mtx, "_pending_comm", None)
+    m = mtx.matrix
+    if not pend or m is None or m.dist is None or m.dist[2] is None:
+        return
+    n_parts = len(np.asarray(m.dist[2])) - 1
+    if len(pend) < n_parts:
+        return
+    from .distributed.partition import build_partition_from_blocks
+    if m.blocks is not None:
+        part = build_partition_from_blocks(m.blocks, m.block_offsets)
+    else:
+        from .distributed.partition import build_partition
+        part = build_partition(m.scalar_csr(), n_parts,
+                               np.asarray(m.dist[2]))
+    for p, e in enumerate(pend[-n_parts:]):
+        want = set(int(q) for q in part.neighbors[p])
+        got = set(int(q) for q in e["neighbors"])
+        if not want <= got:
+            mtx._pending_comm = None
+            raise BadParametersError(
+                f"comm maps for rank {p} miss neighbors "
+                f"{sorted(want - got)} required by the matrix structure")
+    mtx.comm_maps = pend[-n_parts:]
+    mtx._pending_comm = None
+
+
+@_catches()
+def AMGX_matrix_comm_from_maps(mtx: MatrixHandle, allocated_halo_depth,
+                               num_import_rings, max_num_neighbors,
+                               neighbors, send_ptrs, send_maps,
+                               recv_ptrs, recv_maps):
+    """``amgx_c.h:337-346`` — supply multi-ring halo maps (CSR-style
+    per-neighbor pointer arrays)."""
+    rings = int(num_import_rings)
+    if rings not in (1, 2):
+        raise BadParametersError("num_import_rings must be 1 or 2")
+    nb = np.asarray(neighbors)[:int(max_num_neighbors)].astype(np.int64)
+    sp_ = np.asarray(send_ptrs)
+    rp_ = np.asarray(recv_ptrs)
+    entry = {
+        "rings": rings,
+        "neighbors": nb.copy(),
+        "send": [np.asarray(send_maps)[sp_[i]:sp_[i + 1]].copy()
+                 for i in range(len(nb))],
+        "recv": [np.asarray(recv_maps)[rp_[i]:rp_[i + 1]].copy()
+                 for i in range(len(nb))],
+    }
+    _record_comm_maps(mtx, entry)
+
+
+@_catches()
+def AMGX_matrix_comm_from_maps_one_ring(mtx: MatrixHandle,
+                                        allocated_halo_depth,
+                                        num_neighbors, neighbors,
+                                        send_sizes, send_maps,
+                                        recv_sizes, recv_maps):
+    """``amgx_c.h:348-356`` — one-ring maps with per-neighbor arrays."""
+    nn = int(num_neighbors)
+    nb = np.asarray(neighbors)[:nn].astype(np.int64)
+    entry = {
+        "rings": 1,
+        "neighbors": nb.copy(),
+        "send": [np.asarray(send_maps[i])[:int(send_sizes[i])].copy()
+                 for i in range(nn)],
+        "recv": [np.asarray(recv_maps[i])[:int(recv_sizes[i])].copy()
+                 for i in range(nn)],
+    }
+    _record_comm_maps(mtx, entry)
+
+
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass
+class OneRingSystem:
+    """One rank's local system + one-ring maps (a plain object, NOT a
+    tuple: ``_catches(1)`` splices tuples into the rc return)."""
+
+    n: int
+    nnz: int
+    block_dimx: int
+    block_dimy: int
+    row_ptrs: np.ndarray
+    col_indices: np.ndarray
+    data: np.ndarray
+    diag_data: Optional[np.ndarray]
+    rhs: np.ndarray
+    sol: np.ndarray
+    num_neighbors: int
+    neighbors: np.ndarray
+    send_sizes: np.ndarray
+    send_maps: list
+    recv_sizes: np.ndarray
+    recv_maps: list
+
+
+@_catches(1)
+def AMGX_read_system_maps_one_ring(rsrc: ResourcesHandle, mode, filename,
+                                   allocated_halo_depth=1,
+                                   num_partitions=1, partition_sizes=None,
+                                   partition_vector=None, rank=0):
+    """``amgx_c.h:475-499`` — read a system, partition it, and return one
+    rank's LOCAL matrix (columns renumbered to [local | halo]) plus its
+    one-ring communication maps.
+
+    The reference infers ``rank`` from the resources' communicator; this
+    single-process embedding takes it as an argument (default 0) so a
+    driver can loop over ranks.
+    """
+    mode = parse_mode(mode)
+    sysdata = _io.read_system_auto(filename)
+    A = sysdata.A.tocsr()
+    n_glob = A.shape[0]
+    num_partitions = int(num_partitions)
+    if partition_vector is not None:
+        from .distributed import partition_offsets_from_vector
+        offsets = partition_offsets_from_vector(
+            np.asarray(partition_vector), num_partitions)
+    elif partition_sizes is not None:
+        offsets = np.concatenate(
+            [[0], np.cumsum(np.asarray(partition_sizes))])
+    else:
+        nl = -(-n_glob // num_partitions)
+        offsets = np.minimum(np.arange(num_partitions + 1) * nl, n_glob)
+    from .distributed.partition import build_partition
+    part = build_partition(A, num_partitions, offsets)
+    r = int(rank)
+    lo, hi = int(part.offsets[r]), int(part.offsets[r + 1])
+    nl = hi - lo
+    import scipy.sparse as _sp
+    sub = _sp.csr_matrix(A[lo:hi])
+    sub.sort_indices()
+    ext = part.halo_global[r]          # sorted global ids of halo rows
+    gcols = sub.indices.astype(np.int64)
+    local = (gcols >= lo) & (gcols < hi)
+    lcols = np.where(local, gcols - lo, 0)
+    if len(ext):
+        slot = np.minimum(np.searchsorted(ext, gcols), len(ext) - 1)
+        lcols = np.where(local, lcols, nl + slot)
+    owner = np.zeros(n_glob, dtype=np.int64)
+    for p in range(num_partitions):
+        owner[part.offsets[p]:part.offsets[p + 1]] = p
+    nb = part.neighbors[r]
+    send_maps, recv_maps = [], []
+    for q in nb:
+        # rows of r that q needs (→ q's halo), as r-local ids
+        ext_q = part.halo_global[q]
+        send = ext_q[owner[ext_q] == r] - lo
+        send_maps.append(send.astype(np.int32))
+        # r's halo slots owned by q, in r-local [nl..nl+H) numbering
+        recv = nl + np.flatnonzero(owner[ext] == q)
+        recv_maps.append(recv.astype(np.int32))
+    dt = mode.mat_dtype
+    rhs_g = (np.asarray(sysdata.rhs) if sysdata.rhs is not None
+             else np.ones(n_glob))
+    sol_g = (np.asarray(sysdata.solution)
+             if sysdata.solution is not None else np.zeros(n_glob))
+    return OneRingSystem(
+        n=nl, nnz=sub.nnz, block_dimx=1, block_dimy=1,
+        row_ptrs=sub.indptr.copy(),
+        col_indices=lcols.astype(np.int32), data=sub.data.astype(dt),
+        diag_data=None, rhs=rhs_g[lo:hi].astype(mode.vec_dtype),
+        sol=sol_g[lo:hi].astype(mode.vec_dtype),
+        num_neighbors=len(nb), neighbors=nb.astype(np.int32),
+        send_sizes=np.asarray([len(s) for s in send_maps], np.int32),
+        send_maps=send_maps,
+        recv_sizes=np.asarray([len(s) for s in recv_maps], np.int32),
+        recv_maps=recv_maps)
+
+
+@_catches()
+def AMGX_free_system_maps_one_ring(*args, **kwargs):
+    """``amgx_c.h:501-513`` — buffers are GC-managed here; no-op."""
 
 
 __all__ = [n for n in dict(globals()) if n.startswith("AMGX_")]

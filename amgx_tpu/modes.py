@@ -53,9 +53,10 @@ def _warn_fp64_downgrade(mode_name: str):
     from .utils.logging import amgx_output
 
     amgx_output(
-        f"WARNING: mode {mode_name}: fp64 matrix data runs as fp32 on this "
-        "accelerator (TPU fp64 is emulated/unsupported); tolerances below "
-        "~1e-7 are unreachable. Use a host mode (h***) for true fp64.\n")
+        f"NOTE: mode {mode_name}: the device pack runs in fp32 on this "
+        "accelerator (TPU fp64 has no hardware path); the host matrix "
+        "stays fp64 and mixed-precision refinement recovers "
+        "full-precision residuals for tight tolerances.\n")
 
 
 @dataclasses.dataclass(frozen=True)

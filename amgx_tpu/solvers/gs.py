@@ -244,12 +244,13 @@ class KaczmarzSolver(_ColoredSmootherBase):
         # row squared norms + explicit transpose pack for the projections
         if self.A is not None:
             if self.A.host is None and self.A.blocks is not None:
+                csr = None
                 rn = np.concatenate([
                     np.asarray(b.multiply(b).sum(axis=1)).ravel()
                     for b in self.A.blocks])
             else:
-                rn = np.asarray(self.A.scalar_csr().multiply(
-                    self.A.scalar_csr()).sum(axis=1)).ravel()
+                csr = self.A.scalar_csr()
+                rn = np.asarray(csr.multiply(csr).sum(axis=1)).ravel()
             rn[rn == 0] = 1.0
             vec = (1.0 / rn).astype(self.Ad.dtype)
             if self.Ad.fmt == "sharded-ell":
@@ -259,7 +260,7 @@ class KaczmarzSolver(_ColoredSmootherBase):
             else:
                 self.rowinv = jnp.asarray(vec)
                 from ..core.matrix import Matrix as _M
-                self.AdT = _M(self.A.scalar_csr().T.tocsr().astype(
+                self.AdT = _M(csr.T.tocsr().astype(
                     self.Ad.dtype)).device()
         else:
             self.rowinv = jnp.ones((self.Ad.n,), self.Ad.dtype)

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Matrix file converter — mirror of ``examples/convert.c``: read a
+system in any supported format (MatrixMarket / NVAMGBinary, auto
+detected) and write it in the requested one.
+
+Usage: convert.py input.mtx output.bin [--format binary|matrixmarket]
+"""
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from amgx_tpu import io as aio
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("input")
+    ap.add_argument("output")
+    ap.add_argument("--format", choices=("binary", "matrixmarket"),
+                    default=None, help="default: by output extension")
+    args = ap.parse_args()
+
+    sysdata = aio.read_system_auto(args.input)
+    fmt = args.format or ("binary" if args.output.endswith(".bin")
+                          else "matrixmarket")
+    write = aio.write_binary if fmt == "binary" else aio.write_matrix_market
+    write(args.output, sysdata.A, rhs=sysdata.rhs,
+          solution=sysdata.solution, block_dim=sysdata.block_dimx)
+    print(f"wrote {args.output} ({fmt}): "
+          f"{sysdata.A.shape[0]} rows, {sysdata.A.nnz} nnz")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

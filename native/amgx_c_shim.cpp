@@ -173,6 +173,45 @@ AMGX_RC AMGX_finalize(void) {
     return unpack_rc(call("AMGX_finalize", PyTuple_New(0)));
 }
 
+AMGX_RC AMGX_get_error_string(AMGX_RC err, char *buf, int buf_len) {
+    /* pure-C table: usable before initialization, as the reference's
+       AMGX_SAFE_CALL error macro requires (amgx_c.h:160-165) */
+    const char *msg;
+    switch (err) {
+        case AMGX_RC_OK: msg = "No error."; break;
+        case AMGX_RC_BAD_PARAMETERS:
+            msg = "Incorrect parameters to AMGX call."; break;
+        case AMGX_RC_UNKNOWN: msg = "Unknown error."; break;
+        case AMGX_RC_NOT_SUPPORTED_TARGET:
+            msg = "Unsupported target."; break;
+        case AMGX_RC_NOT_SUPPORTED_BLOCKSIZE:
+            msg = "Unsupported block size."; break;
+        case AMGX_RC_CUDA_FAILURE: msg = "Device failure."; break;
+        case AMGX_RC_THRUST_FAILURE:
+            msg = "Device library failure."; break;
+        case AMGX_RC_NO_MEMORY: msg = "Insufficient memory."; break;
+        case AMGX_RC_IO_ERROR: msg = "I/O error."; break;
+        case AMGX_RC_BAD_MODE: msg = "Invalid mode."; break;
+        case AMGX_RC_CORE: msg = "Error initializing amgx core."; break;
+        case AMGX_RC_PLUGIN: msg = "Error initializing plugins."; break;
+        case AMGX_RC_BAD_CONFIGURATION:
+            msg = "Invalid configuration."; break;
+        case AMGX_RC_NOT_IMPLEMENTED: msg = "Not implemented."; break;
+        case AMGX_RC_LICENSE_NOT_FOUND: msg = "License not found."; break;
+        case AMGX_RC_INTERNAL: msg = "Internal error."; break;
+        default: msg = "Unknown error code."; break;
+    }
+    if (!buf || buf_len <= 0) return AMGX_RC_BAD_PARAMETERS;
+    std::snprintf(buf, (size_t)buf_len, "%s", msg);
+    return AMGX_RC_OK;
+}
+
+void AMGX_abort(AMGX_resources_handle, int err) {
+    std::fprintf(stderr, "AMGX_abort: error %d\n", err);
+    std::fflush(stderr);
+    std::exit(err ? err : 1);
+}
+
 AMGX_RC AMGX_get_api_version(int *major, int *minor) {
     if (major) *major = 2;
     if (minor) *minor = 0;
@@ -443,6 +482,61 @@ AMGX_RC AMGX_matrix_vector_multiply(AMGX_matrix_handle mtx,
 }
 
 /* ------------------------------------------------------------- vector */
+AMGX_RC AMGX_matrix_comm_from_maps(AMGX_matrix_handle mtx,
+                                   int allocated_halo_depth,
+                                   int num_import_rings,
+                                   int max_num_neighbors,
+                                   const int *neighbors,
+                                   const int *send_ptrs,
+                                   const int *send_maps,
+                                   const int *recv_ptrs,
+                                   const int *recv_maps) {
+    Gil gil;
+    Handle *h = static_cast<Handle *>(mtx);
+    int nn = max_num_neighbors;
+    PyObject *nb = np_view(neighbors, nn, NPY_INT32);
+    PyObject *sp = np_view(send_ptrs, nn + 1, NPY_INT32);
+    PyObject *sm = np_view(send_maps, nn ? send_ptrs[nn] : 0, NPY_INT32);
+    PyObject *rp = np_view(recv_ptrs, nn + 1, NPY_INT32);
+    PyObject *rm = np_view(recv_maps, nn ? recv_ptrs[nn] : 0, NPY_INT32);
+    PyObject *args = Py_BuildValue("(OiiiOOOOO)", h->obj,
+                                   allocated_halo_depth, num_import_rings,
+                                   nn, nb, sp, sm, rp, rm);
+    Py_DECREF(nb); Py_DECREF(sp); Py_DECREF(sm);
+    Py_DECREF(rp); Py_DECREF(rm);
+    return unpack_rc(call("AMGX_matrix_comm_from_maps", args));
+}
+
+AMGX_RC AMGX_matrix_comm_from_maps_one_ring(AMGX_matrix_handle mtx,
+                                            int allocated_halo_depth,
+                                            int num_neighbors,
+                                            const int *neighbors,
+                                            const int *send_sizes,
+                                            const int **send_maps,
+                                            const int *recv_sizes,
+                                            const int **recv_maps) {
+    Gil gil;
+    Handle *h = static_cast<Handle *>(mtx);
+    int nn = num_neighbors;
+    PyObject *nb = np_view(neighbors, nn, NPY_INT32);
+    PyObject *ss = np_view(send_sizes, nn, NPY_INT32);
+    PyObject *rs = np_view(recv_sizes, nn, NPY_INT32);
+    PyObject *sml = PyList_New(nn);
+    PyObject *rml = PyList_New(nn);
+    for (int i = 0; i < nn; ++i) {
+        PyList_SetItem(sml, i,
+                       np_view(send_maps[i], send_sizes[i], NPY_INT32));
+        PyList_SetItem(rml, i,
+                       np_view(recv_maps[i], recv_sizes[i], NPY_INT32));
+    }
+    PyObject *args = Py_BuildValue("(OiiOOOOO)", h->obj,
+                                   allocated_halo_depth, nn, nb, ss, sml,
+                                   rs, rml);
+    Py_DECREF(nb); Py_DECREF(ss); Py_DECREF(rs);
+    Py_DECREF(sml); Py_DECREF(rml);
+    return unpack_rc(call("AMGX_matrix_comm_from_maps_one_ring", args));
+}
+
 AMGX_RC AMGX_vector_create(AMGX_vector_handle *vec,
                            AMGX_resources_handle rsc, AMGX_Mode mode) {
     Gil gil;

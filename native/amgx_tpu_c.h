@@ -63,6 +63,8 @@ AMGX_RC AMGX_initialize_plugins(void);
 AMGX_RC AMGX_finalize(void);
 AMGX_RC AMGX_finalize_plugins(void);
 AMGX_RC AMGX_get_api_version(int *major, int *minor);
+AMGX_RC AMGX_get_error_string(AMGX_RC err, char *buf, int buf_len);
+void AMGX_abort(AMGX_resources_handle rsrc, int err);
 AMGX_RC AMGX_register_print_callback(AMGX_print_callback callback);
 AMGX_RC AMGX_install_signal_handler(void);
 AMGX_RC AMGX_reset_signal_handler(void);
@@ -111,6 +113,23 @@ AMGX_RC AMGX_matrix_download_all(AMGX_matrix_handle mtx, int *row_ptrs,
 AMGX_RC AMGX_matrix_vector_multiply(AMGX_matrix_handle mtx,
                                     AMGX_vector_handle x,
                                     AMGX_vector_handle y);
+AMGX_RC AMGX_matrix_comm_from_maps(AMGX_matrix_handle mtx,
+                                   int allocated_halo_depth,
+                                   int num_import_rings,
+                                   int max_num_neighbors,
+                                   const int *neighbors,
+                                   const int *send_ptrs,
+                                   const int *send_maps,
+                                   const int *recv_ptrs,
+                                   const int *recv_maps);
+AMGX_RC AMGX_matrix_comm_from_maps_one_ring(AMGX_matrix_handle mtx,
+                                            int allocated_halo_depth,
+                                            int num_neighbors,
+                                            const int *neighbors,
+                                            const int *send_sizes,
+                                            const int **send_maps,
+                                            const int *recv_sizes,
+                                            const int **recv_maps);
 
 /* vector */
 AMGX_RC AMGX_vector_create(AMGX_vector_handle *vec,

@@ -1,0 +1,50 @@
+"""Example drivers run end-to-end (reference examples/CMakeLists.txt
+suite analog) — each in a subprocess pinned to CPU."""
+import pathlib
+import subprocess
+import sys
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+
+import numpy as np
+import pytest
+
+from amgx_tpu.io import poisson7pt, write_matrix_market
+
+EXAMPLES = [
+    ("amgx_capi.py", ["-m", "{mtx}", "-c", "{cfg}"]),
+    ("amgx_mpi_capi_agg.py", ["-m", "{mtx}", "-p", "4"]),
+    ("amgx_mpi_capi_cla.py", ["-m", "{mtx}", "-p", "4"]),
+    ("eigensolver.py", ["-m", "{mtx}"]),
+    ("amgx_spmv_test.py", ["-m", "{mtx}", "-r", "3"]),
+    ("convert.py", ["{mtx}", "{out}"]),
+    ("amgx_capi_multi.py", ["-m", "{mtx}", "-t", "2"]),
+]
+
+
+@pytest.fixture(scope="module")
+def system_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("examples")
+    A = poisson7pt(8, 8, 8)
+    path = str(d / "p8.mtx")
+    write_matrix_market(path, A, rhs=np.ones(A.shape[0]))
+    cfg = str(d / "cfg.json")
+    with open(cfg, "w") as f:
+        f.write('{"config_version": 2, "solver": {"solver": "PCG", '
+                '"max_iters": 200, "monitor_residual": 1, '
+                '"tolerance": 1e-8, "convergence": "RELATIVE_INI"}}')
+    return {"mtx": path, "cfg": cfg, "out": str(d / "out.bin")}
+
+
+@pytest.mark.parametrize("script,args", EXAMPLES,
+                         ids=[e[0] for e in EXAMPLES])
+def test_example_runs(script, args, system_file):
+    argv = [a.format(**system_file) for a in args]
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import runpy, sys\n"
+        f"sys.argv = [{script!r}] + {argv!r}\n"
+        f"runpy.run_path('examples/{script}', run_name='__main__')\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, (script, r.stdout[-800:], r.stderr[-800:])
