@@ -23,7 +23,9 @@ class AMGSolver(Solver):
         if self.A is None:
             raise BadConfigurationError(
                 "AMG setup requires the host matrix (upload via Matrix)")
-        self.hierarchy = AMGHierarchy(self.cfg, self.scope)
+        if not (getattr(self, "_numeric_resetup", False)
+                and getattr(self, "hierarchy", None) is not None):
+            self.hierarchy = AMGHierarchy(self.cfg, self.scope)
         self.hierarchy.setup(self.A)
         self._cycle = build_cycle(self.hierarchy)
 
@@ -33,15 +35,7 @@ class AMGSolver(Solver):
     def grid_stats(self):
         return self.hierarchy.grid_stats()
 
-    def resetup(self, A):
-        """Refresh numeric values after AMGX_matrix_replace_coefficients
-        (reference AMGX_solver_resetup + structure_reuse_levels)."""
-        self.A = A
-        self.Ad = A.device()
-        if self.hierarchy.structure_reuse_levels != 0:
-            self.hierarchy.setup(A)
-            self._cycle = build_cycle(self.hierarchy)
-        else:
-            self.solver_setup()
-        self._solve_fn = None
-        return self
+    # resetup(): inherited from Solver — sets _numeric_resetup so
+    # solver_setup keeps the hierarchy OBJECT (structure reuse applies)
+    # and the base setup preserves compiled executables (same shapes →
+    # jit cache hit, no recompile).  A plain setup() rebuilds fresh.

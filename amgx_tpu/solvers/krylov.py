@@ -31,6 +31,15 @@ class _PrecondMixin:
     """Allocates the nested preconditioner from config scope."""
 
     def _setup_preconditioner(self, use_precond: bool):
+        existing = getattr(self, "preconditioner", None)
+        if existing is not None and use_precond \
+                and getattr(self, "_numeric_resetup", False):
+            # numeric re-setup ONLY: reuse the preconditioner INSTANCE so
+            # its hierarchy structure-reuse and compiled executables
+            # survive; a plain setup() re-allocates it fresh
+            a = self.A if self.A is not None else self.Ad
+            existing.resetup(a)
+            return
         self.preconditioner: Optional[Solver] = None
         if use_precond and self.cfg.has("preconditioner", self.scope):
             self.preconditioner = SolverFactory.allocate(

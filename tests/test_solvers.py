@@ -154,3 +154,66 @@ def test_energymin_amg():
     x = np.asarray(res.x)
     assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-7
     assert res.iterations < 30
+
+
+def test_resetup_preserves_compiled_solve():
+    """AMGX_solver_resetup contract: numeric refresh keeps the compiled
+    executable (same shapes -> jit cache hit) and solves the NEW
+    operator correctly."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson7pt
+    A = sp.csr_matrix(poisson7pt(12, 12, 12))
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:max_iters=1, "
+        "amg:cycle=CG, amg:cycle_iters=2, amg:structure_reuse_levels=99, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=2, amg:min_coarse_rows=32, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    assert slv.solve(b).status == amgx.SolveStatus.SUCCESS
+    fn_before = slv._solve_fn
+    precond_before = slv.preconditioner
+    A2 = sp.csr_matrix(A * 1.75)
+    slv.resetup(amgx.Matrix(A2))
+    # executable and preconditioner INSTANCES survive the numeric refresh
+    assert slv._solve_fn is fn_before
+    assert slv.preconditioner is precond_before
+    res = slv.solve(b)
+    x = np.asarray(res.x, dtype=np.float64)
+    rr = np.linalg.norm(b - A2 @ x) / np.linalg.norm(b)
+    assert res.status == amgx.SolveStatus.SUCCESS
+    assert rr <= 1e-8, rr
+
+
+def test_plain_setup_is_full_rebuild_after_solve():
+    """setup() keeps its full-rebuild contract: a structurally different
+    matrix after a solve must work (regression: resetup semantics leaked
+    into setup and applied a stale aggregation map)."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson7pt
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:max_iters=1, "
+        "amg:structure_reuse_levels=99, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=2, amg:min_coarse_rows=32, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    A1 = sp.csr_matrix(poisson7pt(8, 8, 8))
+    slv.setup(amgx.Matrix(A1))
+    assert slv.solve(np.ones(A1.shape[0])).status == \
+        amgx.SolveStatus.SUCCESS
+    A2 = sp.csr_matrix(poisson7pt(10, 10, 10))
+    slv.setup(amgx.Matrix(A2))          # different size: full rebuild
+    b2 = np.ones(A2.shape[0])
+    res = slv.solve(b2)
+    x = np.asarray(res.x, dtype=np.float64)
+    rr = np.linalg.norm(b2 - A2 @ x) / np.linalg.norm(b2)
+    assert res.status == amgx.SolveStatus.SUCCESS and rr <= 1e-8
