@@ -12,6 +12,7 @@ acceleration at each coarse level (``cycle_iters`` param).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..ops.spmv import spmv
@@ -22,6 +23,30 @@ def build_cycle(hierarchy, cycle_type: str = None):
     ct = cycle_type or hierarchy.cycle_type
     levels = hierarchy.levels
     h = hierarchy
+
+    # hybrid host/device hierarchy (amg_host_levels_rows, amg.h:169-173):
+    # the first level at or below the row threshold — and everything
+    # coarser — computes on the host CPU via XLA host-compute offload,
+    # inside the SAME executable (no extra host round trips).  Off by
+    # default (-1); note some TPU AOT toolchains cannot yet compile rich
+    # host regions (triangular solves/gathers) — the capability is
+    # exercised in CI on the CPU backend.
+    thr = getattr(h, "host_levels_rows", -1)
+    host_from = len(levels) + 1
+    if thr > 0:
+        sizes = [lvl.Ad.n_rows for lvl in levels] + \
+            [h.coarsest.n_block_rows]
+        for i, s in enumerate(sizes):
+            if s <= thr:
+                host_from = i
+                break
+
+    def maybe_host(i):
+        import contextlib
+        if i == host_from:
+            from jax.experimental.compute_on import compute_on
+            return compute_on("device_host")
+        return contextlib.nullcontext()
 
     def smooth(lvl, b, x, sweeps):
         if sweeps <= 0:
@@ -45,66 +70,94 @@ def build_cycle(hierarchy, cycle_type: str = None):
         return h.postsweeps
 
     def cycle(i, b, x, flavor):
-        """One multigrid cycle starting at level i (trace-time recursion)."""
+        """One multigrid cycle starting at level i: entering the host
+        region wraps EVERYTHING from level i down (recursion included) in
+        the host-compute context."""
+        with maybe_host(i):
+            return _cycle_body(i, b, x, flavor)
+
+    def _cycle_body(i, b, x, flavor):
+        """Trace-time recursion for one cycle at level i.
+
+        ``jax.named_scope`` marks each level in the XLA profile — the
+        runtime analog of the reference's AMGX_CPU_PROFILER markers in
+        ``fixed_cycle.cu:52`` (host markers can't see inside the fused
+        executable; named scopes can)."""
         if i == len(levels):
-            return coarse_solve(b, x)
+            with jax.named_scope("amg_coarse_solve"):
+                return coarse_solve(b, x)
         lvl = levels[i]
-        x = smooth(lvl, b, x, presweeps_at(i))
-        r = b - spmv(lvl.Ad, x)
-        bc = lvl.restrict_residual(r)
+        with jax.named_scope(f"amg_level_{i}"):
+            x = smooth(lvl, b, x, presweeps_at(i))
+            r = b - spmv(lvl.Ad, x)
+            bc = lvl.restrict_residual(r)
         xc = jnp.zeros_like(bc)
         if flavor == "V":
             xc = cycle(i + 1, bc, xc, "V")
         elif flavor == "W":
-            xc = cycle(i + 1, bc, xc, "W")
-            if i + 1 < len(levels):
-                xc = cycle(i + 1, bc, xc, "W")
+            # one host region across BOTH recursions: the intermediate xc
+            # stays on the host instead of bouncing device↔host between
+            # the two visits
+            with maybe_host(i + 1):
+                xc = _cycle_body(i + 1, bc, xc, "W")
+                if i + 1 < len(levels):
+                    xc = _cycle_body(i + 1, bc, xc, "W")
         elif flavor == "F":
             # F-cycle: one F-recursion then one V-recursion per level
-            xc = cycle(i + 1, bc, xc, "F")
-            if i + 1 < len(levels):
-                xc = cycle(i + 1, bc, xc, "V")
+            with maybe_host(i + 1):
+                xc = _cycle_body(i + 1, bc, xc, "F")
+                if i + 1 < len(levels):
+                    xc = _cycle_body(i + 1, bc, xc, "V")
         elif flavor in ("CG", "CGF"):
             xc = _kcycle(i + 1, bc, xc, flavor)
         else:
             raise ValueError(f"unknown cycle {flavor!r}")
-        x = lvl.prolongate_and_correct(x, xc)
-        x = smooth(lvl, b, x, postsweeps_at(i))
+        with jax.named_scope(f"amg_level_{i}_post"):
+            x = lvl.prolongate_and_correct(x, xc)
+            x = smooth(lvl, b, x, postsweeps_at(i))
         return x
 
     def _kcycle(i, b, x, flavor):
         """K-cycle: accelerate the level-i solve with `cycle_iters`
         iterations of flexible CG preconditioned by the next cycle
         (reference CG_Flex_Cycle, cycles/cg_flex_cycle.cu)."""
+        with maybe_host(i):
+            return _kcycle_body(i, b, x, flavor)
+
+    def _kcycle_body(i, b, x, flavor):
         if i == len(levels):
-            return coarse_solve(b, x)
+            with jax.named_scope("amg_coarse_solve"):
+                return coarse_solve(b, x)
         inner_flavor = "V" if flavor == "CGF" else flavor
         Ad = levels[i].Ad
 
-        r = b - spmv(Ad, x)
-        p = None
-        z_prev = None
-        r_prev = None
-        for _ in range(max(h.cycle_iters, 1)):
-            z = cycle(i, r, jnp.zeros_like(r), inner_flavor)
-            if p is None:
-                p = z
-            else:
-                # flexible (Notay) beta
-                rz = jnp.vdot(r_prev, z_prev)
-                beta_num = jnp.vdot(r, z) - jnp.vdot(r_prev, z)
-                beta = jnp.where(rz != 0,
-                                 beta_num / jnp.where(rz == 0, 1.0, rz), 0.0)
-                p = z + beta * p
-            q = spmv(Ad, p)
-            pq = jnp.vdot(p, q)
-            alpha = jnp.where(pq != 0,
-                              jnp.vdot(r, z) / jnp.where(pq == 0, 1.0, pq),
-                              0.0)
-            x = x + alpha * p
-            r_prev, z_prev = r, z
-            r = r - alpha * q
-        return x
+        with jax.named_scope(f"amg_kcycle_{i}"):
+            r = b - spmv(Ad, x)
+            p = None
+            z_prev = None
+            r_prev = None
+            for _ in range(max(h.cycle_iters, 1)):
+                z = cycle(i, r, jnp.zeros_like(r), inner_flavor)
+                if p is None:
+                    p = z
+                else:
+                    # flexible (Notay) beta
+                    rz = jnp.vdot(r_prev, z_prev)
+                    beta_num = jnp.vdot(r, z) - jnp.vdot(r_prev, z)
+                    beta = jnp.where(rz != 0,
+                                     beta_num / jnp.where(rz == 0, 1.0, rz),
+                                     0.0)
+                    p = z + beta * p
+                q = spmv(Ad, p)
+                pq = jnp.vdot(p, q)
+                alpha = jnp.where(pq != 0,
+                                  jnp.vdot(r, z) / jnp.where(pq == 0, 1.0,
+                                                             pq),
+                                  0.0)
+                x = x + alpha * p
+                r_prev, z_prev = r, z
+                r = r - alpha * q
+            return x
 
     def cycle_fn(b, x):
         return cycle(0, b, x, ct)

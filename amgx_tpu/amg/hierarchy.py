@@ -28,6 +28,7 @@ from ..core.matrix import Matrix
 from ..errors import BadConfigurationError
 from ..solvers.base import SolverFactory
 from ..utils.logging import amgx_output
+from ..utils.profiler import cpu_profiler
 from .aggregation.galerkin import galerkin_coarse
 from .aggregation.selectors import create_selector
 from .classical.interpolators import create_interpolator
@@ -71,6 +72,10 @@ class AMGHierarchy:
         self.coarsest_sweeps = int(g("coarsest_sweeps"))
         self.cycle_iters = int(g("cycle_iters"))
         self.structure_reuse_levels = int(g("structure_reuse_levels"))
+        #: levels with ≤ this many rows compute on the HOST inside the
+        #: same executable (reference amg_host_levels_rows, amg.h:169-173
+        #: — coarse levels on CPU while fine levels run on the device)
+        self.host_levels_rows = int(g("amg_host_levels_rows"))
         self.dense_lu_num_rows = int(g("dense_lu_num_rows"))
         self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
         self.print_grid_stats = bool(g("print_grid_stats"))
@@ -86,10 +91,12 @@ class AMGHierarchy:
         reuse = (self._structure is not None and
                  self.structure_reuse_levels != 0 and A.dist is None)
         try:
-            if reuse:
-                self._setup_reuse(A)
-            else:
-                self._setup_fresh(A)
+            with cpu_profiler("amg_setup_reuse" if reuse
+                              else "amg_setup"):
+                if reuse:
+                    self._setup_reuse(A)
+                else:
+                    self._setup_fresh(A)
         except BaseException:
             # a partial structure must never feed a later reuse pass
             self._structure = None
@@ -116,7 +123,9 @@ class AMGHierarchy:
                 break
             if n <= self.min_coarse_rows:
                 break
-            level, Ac, struct = self._coarsen_once(cur, len(self.levels))
+            with cpu_profiler(f"coarsen_level_{len(self.levels)}"):
+                level, Ac, struct = self._coarsen_once(cur,
+                                                       len(self.levels))
             if level is None:
                 break
             nc = Ac.n_block_rows
@@ -441,14 +450,16 @@ class AMGHierarchy:
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
-        for lvl in self.levels:
-            lvl.smoother = SolverFactory.allocate(self.cfg, self.scope,
-                                                  "smoother")
-            lvl.smoother.setup(lvl.A)
+        with cpu_profiler("setup_smoothers"):
+            for lvl in self.levels:
+                lvl.smoother = SolverFactory.allocate(self.cfg, self.scope,
+                                                      "smoother")
+                lvl.smoother.setup(lvl.A)
         self.coarsest = coarsest
-        self.coarse_solver = SolverFactory.allocate(self.cfg, self.scope,
-                                                    "coarse_solver")
-        self.coarse_solver.setup(coarsest)
+        with cpu_profiler("setup_coarse_solver"):
+            self.coarse_solver = SolverFactory.allocate(
+                self.cfg, self.scope, "coarse_solver")
+            self.coarse_solver.setup(coarsest)
         self.coarse_solver_is_smoother = self.coarse_solver.is_smoother
 
     # ------------------------------------------------------------------ info

@@ -33,6 +33,7 @@ from ..errors import BadConfigurationError, SolveStatus
 from ..ops import blas
 from ..ops.spmv import spmv
 from ..utils.logging import amgx_output
+from ..utils.profiler import cpu_profiler
 
 
 # --------------------------------------------------------------------------
@@ -158,15 +159,19 @@ class Solver:
                 # solver.cu:441-475 documents that workaround — a copy is
                 # cleaner and setup-phase only)
                 from .scalers import create_scaler
-                self.scaler = create_scaler(scaling, self.cfg, self.scope)
-                self.scaler.setup(A.scalar_csr())
-                A = Matrix(self.scaler.scale_matrix(A.scalar_csr()))
+                with cpu_profiler("setup_scaling"):
+                    self.scaler = create_scaler(scaling, self.cfg,
+                                                self.scope)
+                    self.scaler.setup(A.scalar_csr())
+                    A = Matrix(self.scaler.scale_matrix(A.scalar_csr()))
             self.A = A
-            self.Ad = A.device()
+            with cpu_profiler("matrix_pack_device"):
+                self.Ad = A.device()
         else:
             self.A = None
             self.Ad = A
-        self.solver_setup()
+        with cpu_profiler(f"setup:{self.config_name}"):
+            self.solver_setup()
         if getattr(self, "_numeric_resetup", False) \
                 and self._solve_fn is not None \
                 and self._bindings is not None:
@@ -347,23 +352,24 @@ class Solver:
             self._refined_fn = None
 
         t0 = time.perf_counter()
-        if refine:
-            # refinement must see the caller's full-precision rhs/guess —
-            # the dtype-cast b/x0 above would fold the fp32 rounding of b
-            # itself into the "converged" solution
-            x, iters, nrm, nrm_ini, history = self._solve_refined(b_in,
-                                                                  x0_in)
-        else:
-            x, stats, history = self._solve_fn(
-                self._bindings.collect(), b, x0,
-                jnp.asarray(self.tolerance, dtype),
-                jnp.asarray(self.max_iters, jnp.int32))
-            # ONE small host fetch for (iters, norms) — per-transfer cost
-            # dominates on remote-attached TPUs
-            stats = np.asarray(stats)
-            iters = int(stats[0])
-            m = (len(stats) - 1) // 2
-            nrm, nrm_ini = stats[1:1 + m], stats[1 + m:]
+        with cpu_profiler(f"solve:{self.config_name}"):
+            if refine:
+                # refinement must see the caller's full-precision
+                # rhs/guess — the dtype-cast b/x0 above would fold the
+                # fp32 rounding of b itself into the "converged" solution
+                x, iters, nrm, nrm_ini, history = self._solve_refined(
+                    b_in, x0_in)
+            else:
+                x, stats, history = self._solve_fn(
+                    self._bindings.collect(), b, x0,
+                    jnp.asarray(self.tolerance, dtype),
+                    jnp.asarray(self.max_iters, jnp.int32))
+                # ONE small host fetch for (iters, norms) — per-transfer
+                # cost dominates on remote-attached TPUs
+                stats = np.asarray(stats)
+                iters = int(stats[0])
+                m = (len(stats) - 1) // 2
+                nrm, nrm_ini = stats[1:1 + m], stats[1 + m:]
         solve_time = time.perf_counter() - t0
         if dist:
             from ..distributed.matrix import unshard_vector

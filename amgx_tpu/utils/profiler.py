@@ -81,16 +81,24 @@ class ProfilerTree:
         self._stack = [self.root]
 
 
-_tree = ProfilerTree()
+import threading as _threading
+
+_tls = _threading.local()
 
 
 def profiler_tree() -> ProfilerTree:
-    return _tree
+    """Per-THREAD profiler tree: markers now live in the library's hot
+    paths, and concurrent solver instances (amgx_capi_multi-style
+    drivers) must not interleave push/pops on one shared stack."""
+    tree = getattr(_tls, "tree", None)
+    if tree is None:
+        tree = _tls.tree = ProfilerTree()
+    return tree
 
 
 def cpu_profiler(name: str):
     """RAII marker (reference AMGX_CPU_PROFILER, amgx_timer.h:269)."""
-    return _tree.scope(name)
+    return profiler_tree().scope(name)
 
 
 class TimerMap:

@@ -225,3 +225,28 @@ def test_grid_stats_report():
     stats = slv.grid_stats()
     assert "Number of Levels" in stats
     assert "Grid Complexity" in stats
+
+
+def test_hybrid_host_levels():
+    """amg_host_levels_rows: coarse levels compute on the host inside the
+    same executable (reference amg.h:169-173 hybrid hierarchy)."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson7pt
+    A = sp.csr_matrix(poisson7pt(12, 12, 12))
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:max_iters=1, "
+        "amg:cycle=CG, amg:cycle_iters=2, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, amg:presweeps=1, "
+        "amg:postsweeps=2, amg:min_coarse_rows=32, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, amg_host_levels_rows=512")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(b)
+    assert res.status == amgx.SolveStatus.SUCCESS
+    x = np.asarray(res.x, dtype=np.float64)
+    rr = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert rr <= 1e-8

@@ -105,3 +105,28 @@ def test_signal_handlers_install_reset():
                                         reset_signal_handlers)
     install_signal_handlers()
     reset_signal_handlers()
+
+
+def test_profiler_markers_populate_hot_paths():
+    """Setup/solve must leave AMGX_CPU_PROFILER-style markers in the
+    profiler tree (reference scatters them through solver.cu:272-295)."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson5pt
+    from amgx_tpu.utils.profiler import profiler_tree
+    tree = profiler_tree()
+    tree.reset()
+    A = sp.csr_matrix(poisson5pt(12, 12))
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=50, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    slv.solve(np.ones(A.shape[0]))
+    report = tree.report()
+    for marker in ("setup:PCG", "amg_setup", "coarsen_level_0",
+                   "setup_smoothers", "setup_coarse_solver", "solve:PCG"):
+        assert marker in report, (marker, report)
