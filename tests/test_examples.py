@@ -19,6 +19,8 @@ EXAMPLES = [
     ("amgx_spmv_test.py", ["-m", "{mtx}", "-r", "3"]),
     ("convert.py", ["{mtx}", "{out}"]),
     ("amgx_capi_multi.py", ["-m", "{mtx}", "-t", "2"]),
+    ("amgx_mpi_poisson5pt.py", ["-p", "24", "24", "2", "2"]),
+    ("eigensolver_mpi.py", ["-m", "{mtx}", "-p", "4"]),
 ]
 
 
