@@ -15,6 +15,8 @@ from typing import Dict
 import numpy as np
 import scipy.sparse as sp
 
+from ...utils.determinism import SESSION_SEED
+
 from ...errors import BadConfigurationError
 
 _selector_registry: Dict[str, type] = {}
@@ -89,7 +91,7 @@ class PMISSelector(_CFSelectorBase):
     """Parallel Modified Independent Set (``selectors/pmis.cu``)."""
 
     def select(self, S):
-        seed = 7 if self.deterministic else np.random.randint(1 << 16)
+        seed = 7 if self.deterministic else SESSION_SEED
         return _pmis(S, seed)
 
 
@@ -103,7 +105,7 @@ class HMISSelector(_CFSelectorBase):
         S2.setdiag(0)
         S2.eliminate_zeros()
         S2.data[:] = 1
-        seed = 7 if self.deterministic else np.random.randint(1 << 16)
+        seed = 7 if self.deterministic else SESSION_SEED
         return _pmis(sp.csr_matrix(S2.astype(np.int8)), seed)
 
 
@@ -158,7 +160,7 @@ class AggressivePMISSelector(PMISSelector):
         Scc.setdiag(0)
         Scc.eliminate_zeros()
         Scc.data[:] = 1
-        seed = 11 if self.deterministic else np.random.randint(1 << 16)
+        seed = 11 if self.deterministic else SESSION_SEED
         cf_c = _pmis(sp.csr_matrix(Scc.astype(np.int8)), seed)
         out = np.zeros_like(cf)
         out[c_idx[cf_c.astype(bool)]] = 1
@@ -179,7 +181,7 @@ class AggressiveHMISSelector(HMISSelector):
         Scc.eliminate_zeros()
         if Scc.nnz:
             Scc.data[:] = 1
-        seed = 11 if self.deterministic else np.random.randint(1 << 16)
+        seed = 11 if self.deterministic else SESSION_SEED
         cf_c = _pmis(sp.csr_matrix(Scc.astype(np.int8)), seed)
         out = np.zeros_like(cf)
         out[c_idx[cf_c.astype(bool)]] = 1

@@ -113,7 +113,30 @@ def build_cycle(hierarchy, cycle_type: str = None):
         else:
             raise ValueError(f"unknown cycle {flavor!r}")
         with jax.named_scope(f"amg_level_{i}_post"):
-            x = lvl.prolongate_and_correct(x, xc)
+            es = getattr(h, "error_scaling", 0)
+            if es in (2, 3) and lvl.kind != "classical":
+                # scaled coarse correction x += λ·e (reference
+                # aggregation_amg_level.cu:740-860): the prolongated
+                # (optionally smoothed) error is applied with the λ that
+                # minimises the residual 2-norm (2) or error A-norm (3),
+                # clamped to [0.3, 10]
+                e = lvl.prolongate_and_correct(jnp.zeros_like(x), xc)
+                if h.scaling_smoother_steps > 0:
+                    e = smooth(lvl, r, e, h.scaling_smoother_steps)
+                Ae = spmv(lvl.Ad, e)
+                if es == 2:
+                    num = jnp.vdot(r, Ae)
+                    den = jnp.vdot(Ae, Ae)
+                else:
+                    num = jnp.vdot(r, e)
+                    den = jnp.vdot(e, Ae)
+                lam = jnp.where(den == 0, 1.0,
+                                num / jnp.where(den == 0, 1.0, den))
+                mag = jnp.clip(jnp.abs(lam), 0.3, 10.0)
+                lam = jnp.sign(lam) * mag
+                x = x + lam.astype(x.dtype) * e
+            else:
+                x = lvl.prolongate_and_correct(x, xc)
             x = smooth(lvl, b, x, postsweeps_at(i))
         return x
 

@@ -80,6 +80,11 @@ class AMGHierarchy:
         self.dense_lu_max_rows = int(g("dense_lu_max_rows"))
         self.print_grid_stats = bool(g("print_grid_stats"))
         self.aggressive_levels = int(g("aggressive_levels"))
+        #: coarse-correction scaling (aggregation levels; reference
+        #: aggregation_amg_level.cu:740-860): 2 = minimise residual
+        #: 2-norm, 3 = minimise error A-norm, 0 = off
+        self.error_scaling = int(g("error_scaling"))
+        self.scaling_smoother_steps = int(g("scaling_smoother_steps"))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.coarse_solver_is_smoother = False
@@ -450,11 +455,25 @@ class AMGHierarchy:
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
-        with cpu_profiler("setup_smoothers"):
-            for lvl in self.levels:
-                lvl.smoother = SolverFactory.allocate(self.cfg, self.scope,
-                                                      "smoother")
+        from ..utils.thread_manager import ThreadManager
+
+        def smoother_task(lvl):
+            def run():
+                lvl.smoother = SolverFactory.allocate(
+                    self.cfg, self.scope, "smoother")
                 lvl.smoother.setup(lvl.A)
+            return run
+
+        # per-level smoother setups are independent — overlap their host
+        # work and device uploads on the async task pool (reference
+        # ThreadManager, thread_manager.h:46-173; ``serialize_threads``
+        # forces the serial order for debugging)
+        serialize = bool(self.cfg.get("serialize_threads"))
+        with cpu_profiler("setup_smoothers"), \
+                ThreadManager(serialize=serialize) as tm:
+            for lvl in self.levels:
+                tm.push_work(smoother_task(lvl))
+            tm.wait_threads()
         self.coarsest = coarsest
         with cpu_profiler("setup_coarse_solver"):
             self.coarse_solver = SolverFactory.allocate(

@@ -351,8 +351,44 @@ def AMGX_matrix_attach_coloring(mtx: MatrixHandle, row_coloring,
 
 @_catches()
 def AMGX_matrix_attach_geometry(mtx: MatrixHandle, geox, geoy, geoz=None):
-    mtx.matrix.geometry = tuple(np.asarray(g) for g in
-                                (geox, geoy, geoz) if g is not None)
+    """``amgx_c.h:541-546`` — per-row coordinates.  When they form a
+    regular lexicographic grid, grid dims are derived and feed the GEO
+    selector's structured fast path (amg/structured.py)."""
+    coords = tuple(np.asarray(g, dtype=np.float64) for g in
+                   (geox, geoy, geoz) if g is not None)
+    mtx.matrix.geometry = coords
+    dims = _regular_grid_dims(coords)
+    if dims is not None:
+        mtx.matrix.grid_dims = dims
+
+
+def _regular_grid_dims(coords):
+    """(nz, ny, nx) when the coordinate arrays describe a full regular
+    grid in lexicographic (x fastest, then y, then z) order; None
+    otherwise.  The FULL layout is verified — every axis must equal the
+    exact tile/repeat pattern of its sorted unique values, so serpentine
+    orderings or swapped axis nesting are rejected rather than producing
+    misordered dims."""
+    if not coords:
+        return None
+    n = len(coords[0])
+    uniques, sizes = [], []
+    for axis in coords:
+        u = np.unique(axis)                 # sorted
+        if len(u) == 0 or n % len(u) != 0:
+            return None
+        uniques.append(u)
+        sizes.append(len(u))
+    if int(np.prod(sizes)) != n:
+        return None
+    inner = 1
+    for axis, u, s in zip(coords, uniques, sizes):
+        expect = np.tile(np.repeat(u, inner), n // (inner * s))
+        if not np.array_equal(axis, expect):
+            return None
+        inner *= s
+    dims3 = ([1] * (3 - len(sizes)) + list(reversed(sizes)))
+    return tuple(int(d) for d in dims3)
 
 
 # ------------------------------------------------------------------- vector

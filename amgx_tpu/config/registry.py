@@ -177,7 +177,9 @@ def register_default_parameters():
     R("coarsest_sweeps", int, 2)
     R("cycle_iters", int, 2, "CG/CGF cycle inner iters")
     R("structure_reuse_levels", int, 0)
-    R("error_scaling", int, 0)
+    # allowed values as the reference registers them (core.cu:461-464);
+    # the Vanek modes 4/5 are not registered there either
+    R("error_scaling", int, 0, "", (0, 2, 3))
     R("reuse_scale", int, 0)
     R("scaling_smoother_steps", int, 2)
     R("intensive_smoothing", int, 0)

@@ -60,3 +60,11 @@ _checker = DeterminismChecker()
 
 def determinism_checker() -> DeterminismChecker:
     return _checker
+
+
+#: Tie-break seed used when ``determinism_flag`` is OFF.  The reference's
+#: flag exists because GPU determinism costs extra work; here determinism
+#: is free, so even the "non-deterministic" mode uses one fixed
+#: per-process seed rather than consuming global numpy RNG state — results
+#: never depend on what else the process computed.
+SESSION_SEED = 0x5EED

@@ -1,0 +1,68 @@
+"""Async setup task pool.
+
+Reference: ``base/include/thread_manager.h:46-173`` — ``ThreadManager``
+with ``spawn_threads``/``join_threads``/``wait_threads`` running
+``AsyncTask``s so smoother setup overlaps across levels, and the
+``serialize_threads`` debug flag (``core.cu:356``) that forces serial
+execution.
+
+Here the pool overlaps the HOST side of per-level setup (coloring,
+slab packing, diagonal inversion in numpy/scipy, which release the GIL)
+and the async device uploads those setups dispatch.  Tasks must be
+independent — the hierarchy's per-level smoother setups are.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Callable, List, Optional
+
+
+class ThreadManager:
+    """Small task pool mirroring the reference API surface."""
+
+    def __init__(self, max_workers: Optional[int] = None,
+                 serialize: bool = False):
+        self.serialize = bool(serialize)
+        self._max_workers = max_workers
+        self._futures: List[concurrent.futures.Future] = []
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+    # ------------------------------------------------ reference API names
+    def spawn_threads(self) -> None:
+        if not self.serialize and self._pool is None:
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="amgx-setup")
+
+    def push_work(self, task: Callable[[], None]) -> None:
+        """Queue one AsyncTask; runs inline under ``serialize_threads``."""
+        if self.serialize or self._pool is None:
+            task()
+            return
+        self._futures.append(self._pool.submit(task))
+
+    def wait_threads(self) -> None:
+        """Block until every queued task finished; re-raise the first
+        failure (a failed smoother setup must fail the hierarchy setup)."""
+        futures, self._futures = self._futures, []
+        for f in futures:
+            f.result()
+
+    def join_threads(self) -> None:
+        self.wait_threads()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __enter__(self):
+        self.spawn_threads()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self.join_threads()
+        except Exception:
+            if exc == (None, None, None):
+                raise
+        return False

@@ -130,3 +130,44 @@ def test_profiler_markers_populate_hot_paths():
     for marker in ("setup:PCG", "amg_setup", "coarsen_level_0",
                    "setup_smoothers", "setup_coarse_solver", "solve:PCG"):
         assert marker in report, (marker, report)
+
+
+def test_thread_manager_overlapped_smoother_setup():
+    """ThreadManager analog (thread_manager.h:46-173): parallel and
+    serialized (serialize_threads=1) smoother setup produce identical
+    hierarchies and solves."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson7pt
+    A = sp.csr_matrix(poisson7pt(10, 10, 10))
+    b = np.ones(A.shape[0])
+    base = ("config_version=2, solver(out)=PCG, out:max_iters=80, "
+            "out:monitor_residual=1, out:tolerance=1e-8, "
+            "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+            "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:max_iters=1, "
+            "amg:smoother(sm)=MULTICOLOR_GS, sm:max_iters=1, "
+            "amg:presweeps=1, amg:postsweeps=1, amg:min_coarse_rows=32, "
+            "amg:coarse_solver=DENSE_LU_SOLVER")
+    xs = []
+    for flag in ("0", "1"):
+        cfg = amgx.AMGConfig(base + f", serialize_threads={flag}")
+        slv = amgx.create_solver(cfg)
+        slv.setup(amgx.Matrix(A))
+        res = slv.solve(b)
+        assert res.status == amgx.SolveStatus.SUCCESS
+        xs.append(np.asarray(res.x))
+    np.testing.assert_allclose(xs[0], xs[1], rtol=1e-12, atol=1e-13)
+
+
+def test_thread_manager_propagates_failures():
+    import pytest
+    from amgx_tpu.utils.thread_manager import ThreadManager
+
+    def boom():
+        raise RuntimeError("task failed")
+
+    tm = ThreadManager()
+    tm.spawn_threads()
+    tm.push_work(boom)
+    with pytest.raises(RuntimeError):
+        tm.wait_threads()
+    tm.join_threads()

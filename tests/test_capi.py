@@ -270,3 +270,25 @@ def test_upload_distributed_external_diag():
     rc, out = c.AMGX_vector_download(vx)
     relres = np.linalg.norm(b - A @ out) / np.linalg.norm(b)
     assert relres < 1e-7
+
+
+def test_attach_geometry_enables_geo_fast_path():
+    """AMGX_matrix_attach_geometry: regular-grid coordinates set the
+    grid dims the GEO selector's structured path consumes."""
+    import scipy.sparse as sp
+    from amgx_tpu.io import poisson7pt
+    from amgx_tpu import capi as c
+    nx, ny, nz = 6, 5, 4
+    A = sp.csr_matrix(poisson7pt(nx, ny, nz))
+    rc, cfg = c.AMGX_config_create("config_version=2, solver(s)=PCG")
+    rc, rsrc = c.AMGX_resources_create_simple(cfg)
+    rc, mtx = c.AMGX_matrix_create(rsrc, "dDDI")
+    rc = c.AMGX_matrix_upload_all(mtx, A.shape[0], A.nnz, 1, 1, A.indptr,
+                                  A.indices, A.data)
+    z, y, x = np.meshgrid(np.arange(nz), np.arange(ny), np.arange(nx),
+                          indexing="ij")
+    rc = c.AMGX_matrix_attach_geometry(
+        mtx, x.ravel().astype(float), y.ravel().astype(float),
+        z.ravel().astype(float))
+    assert rc == 0
+    assert mtx.matrix.grid_dims == (nz, ny, nx)

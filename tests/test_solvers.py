@@ -30,10 +30,12 @@ def test_krylov_poisson_convergence(name, iters):
     if name in ("PCG", "PCGF", "PBICGSTAB", "FGMRES"):
         extra = ", s:preconditioner(p)=BLOCK_JACOBI, p:max_iters=3"
     if name == "CHEBYSHEV":
-        # user-supplied spectral interval (mode 2) — interval-based methods
-        # need λmin to actually reach the target (cheb_solver.cu:105-112)
-        extra = (", s:chebyshev_lambda_estimate_mode=2, "
-                 "s:cheby_max_lambda=8.0, s:cheby_min_lambda=0.06")
+        # user-supplied spectral interval: mode 3 WITH a preconditioner
+        # is the reference's user-λ path (cheb_solver.cu:225-238);
+        # interval-based methods need λmin to actually reach the target
+        extra = (", s:preconditioner(p)=BLOCK_JACOBI, p:max_iters=1, "
+                 "s:chebyshev_lambda_estimate_mode=3, "
+                 "s:cheby_max_lambda=2.1, s:cheby_min_lambda=0.01")
     res, _ = _solve(BASE % (name, iters) + extra, A, b)
     x = np.asarray(res.x)
     relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
