@@ -55,6 +55,29 @@ def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     return m
 
 
+def _narrow_dia(cur: Matrix, arrs):
+    """Mixed precision: coarse GRIDS live in the device dtype — they are
+    preconditioner data (outer refinement owns final accuracy, the
+    reference's dDFI split); narrowing before the Galerkin halves its
+    bandwidth and makes every coarse pack a zero-copy view."""
+    dd = np.dtype(cur.device_dtype) if cur.device_dtype is not None \
+        else None
+    if dd is not None and dd.itemsize < arrs[1].dtype.itemsize:
+        return (arrs[0], arrs[1].astype(dd))
+    return arrs
+
+
+def _child_matrix_dia(parent: Matrix, offsets, vals) -> Matrix:
+    """DIA-native hierarchy child: the coarse operator stays in diagonal
+    form end to end (device pack, further coarsening, smoother diag) and
+    its scipy view assembles lazily only if a consumer asks — this is what
+    keeps setup O(one pass over the fine operator)."""
+    m = Matrix.from_dia(offsets, vals)
+    m.device_dtype = parent.device_dtype
+    m.placement = parent.placement
+    return m
+
+
 class AMGHierarchy:
     def __init__(self, cfg: AMGConfig, scope: str):
         self.cfg = cfg
@@ -160,16 +183,22 @@ class AMGHierarchy:
                 agg, nc = data
                 Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
                 lvl = AggregationLevel(cur, i, agg, nc)
+                nxt = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             elif kind == "pairwise":
                 n_f, = data
-                Ac_host, _ = self._pairwise_numeric(cur.scalar_csr(), n_f)
+                offs_c, vals_c = self._pairwise_numeric(
+                    _narrow_dia(cur, cur.dia_cache()))
                 lvl = PairwiseLevel(cur, i, n_f)
+                nxt = _child_matrix_dia(cur, offs_c, vals_c)
             elif kind == "structured":
                 dims, = data
-                offs, vals = dia_arrays(cur.scalar_csr())
+                offs, vals = _narrow_dia(cur, cur.dia_cache())
                 offs3 = decompose_offsets(offs, dims)
-                Ac_host, cdims = self._structured_numeric(offs3, vals, dims)
+                flat, vals_c, cdims = self._structured_numeric(
+                    offs3, vals, dims)
                 lvl = StructuredLevel(cur, i, dims, cdims)
+                nxt = _child_matrix_dia(cur, flat, vals_c)
+                nxt.grid_dims = cdims
             else:
                 P_host, = data
                 R_host = sp.csr_matrix(P_host.T)
@@ -177,11 +206,10 @@ class AMGHierarchy:
                 lvl = ClassicalLevel(cur, i,
                                      _child_matrix(cur, P_host).device(),
                                      _child_matrix(cur, R_host).device())
+                nxt = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             self.levels.append(lvl)
             self._structure.append(struct)
-            cur = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
-            if kind == "structured":
-                cur.grid_dims = lvl.cdims
+            cur = nxt
         # rebuild any remaining levels fresh from the reused prefix
         cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
@@ -282,13 +310,13 @@ class AMGHierarchy:
         representation (caller retries with a matching selector).
         ``max_diags`` matches ``pack_device``'s ``dia_max_diags`` so every
         level this path produces really is packed gather-free."""
-        Asc = cur.scalar_csr()
-        n = Asc.shape[0]
+        n = cur.n_block_rows
         if n < 2:
             return None, None, None   # stop coarsening here
-        arrs = dia_arrays(Asc, max_diags=max_diags)
+        arrs = cur.dia_cache(max_diags)
         if arrs is None:
             return _PAIRWISE_FALLBACK
+        arrs = _narrow_dia(cur, arrs)
         # isotropic 2×2×2 cells when the grid geometry is known/inferable
         # (geo_selector.cu analog); falls back to 1D index pairing
         dims = getattr(cur, "grid_dims", None)
@@ -305,36 +333,32 @@ class AMGHierarchy:
             if offs3 is not None:
                 out = self._structured_numeric(offs3, vals, dims)
                 if out is not None:
-                    Ac_host, cdims = out
+                    flat, vals_c, cdims = out
                     level = StructuredLevel(cur, idx, dims, cdims)
-                    Ac = _child_matrix(cur, Ac_host)
+                    Ac = _child_matrix_dia(cur, flat, vals_c)
                     Ac.grid_dims = cdims
                     return level, Ac, ("structured", (dims,))
-        Ac_host, lvl_n = self._pairwise_numeric(Asc, n, arrs)
+        offs_c, vals_c = self._pairwise_numeric(arrs)
         level = PairwiseLevel(cur, idx, n)
-        Ac = _child_matrix(cur, Ac_host)
+        Ac = _child_matrix_dia(cur, offs_c, vals_c)
         return level, Ac, ("pairwise", (n,))
 
     @staticmethod
     def _structured_numeric(offs3, vals, dims):
         """Numeric pipeline for the grid-structured path; None when the
-        coarse grid would not shrink (all dims already 1)."""
+        coarse grid would not shrink (all dims already 1).  Returns the
+        coarse operator in DIA form (flat offsets, vals, cdims)."""
         cdims = coarse_dims(dims)
         if int(np.prod(cdims)) >= int(np.prod(dims)):
             return None
-        flat, vals_c, cdims = structured_galerkin(offs3, vals, dims)
-        return dia_to_scipy(flat, vals_c, int(np.prod(cdims))), cdims
+        return structured_galerkin(offs3, vals, dims)
 
     @staticmethod
-    def _pairwise_numeric(Asc, n_f: int, arrs=None):
+    def _pairwise_numeric(arrs):
         """Shared numeric pipeline (fresh + structure-reuse paths):
-        diagonal arrays → pairwise Galerkin → scipy coarse matrix."""
-        if arrs is None:
-            arrs = dia_arrays(Asc)
+        diagonal arrays → pairwise Galerkin, DIA in / DIA out."""
         offs, vals = arrs
-        offs_c, vals_c = pairwise_galerkin_dia(offs, vals)
-        nc = (n_f + 1) // 2
-        return dia_to_scipy(offs_c, vals_c, nc), n_f
+        return pairwise_galerkin_dia(offs, vals)
 
     @staticmethod
     def _rank_blocks(cur: Matrix, offsets: np.ndarray):
@@ -455,7 +479,15 @@ class AMGHierarchy:
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
+        from ..core.matrix import batch_upload_dia
         from ..utils.thread_manager import ThreadManager
+
+        # ONE device_put for every DIA level's (vals, diag, dinv) — the
+        # per-level upload latency through a remote-TPU tunnel otherwise
+        # dominates hierarchy setup (reference: the hierarchy lives on
+        # device from the start, amg.cu:177-450)
+        with cpu_profiler("hierarchy_upload"):
+            batch_upload_dia([lvl.A for lvl in self.levels] + [coarsest])
 
         def smoother_task(lvl):
             def run():

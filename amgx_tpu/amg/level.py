@@ -30,9 +30,27 @@ class AMGLevel:
 
     def __init__(self, A: Matrix, level_index: int):
         self.A = A
-        self.Ad = A.device()
+        #: device pack slot — populated lazily (first ``Ad`` access) or in
+        #: bulk by the hierarchy's batched upload (one device_put for ALL
+        #: levels: each transfer pays ~0.3 s fixed latency through a
+        #: remote-TPU tunnel).  DeviceBindings discovers/binds ``_Ad``;
+        #: the property reads it, so traced code sees the bound tracer.
+        self._Ad = None
         self.level_index = level_index
         self.smoother = None
+
+    @property
+    def Ad(self):
+        if self._Ad is None:
+            from jax._src.core import trace_state_clean
+            v = self.A.device()
+            if not trace_state_clean():
+                # under a trace ``A._device`` holds a bound tracer —
+                # return it for this trace but do NOT cache it: a tracer
+                # stored past the trace poisons every later retrace
+                return v
+            self._Ad = v
+        return self._Ad
 
     # traced ops --------------------------------------------------------
     def restrict_residual(self, r: jax.Array) -> jax.Array:
@@ -148,7 +166,11 @@ class StructuredLevel(AMGLevel):
             ix = np.zeros((cx, px), dtype=np.float32)
             ix[np.arange(cx), 2 * np.arange(cx)] = 1.0
             ix[np.arange(cx), 2 * np.arange(cx) + 1] = 1.0
-            self._interleave_x = jnp.asarray(ix, dtype=self.Ad.dtype)
+            # dtype from the HOST handle: touching self.Ad here would
+            # force a per-level eager upload and defeat the hierarchy's
+            # batched device_put
+            dt = np.dtype(A.device_dtype or A.dtype)
+            self._interleave_x = jnp.asarray(ix, dtype=dt)
         else:
             self._interleave_x = None
 

@@ -57,18 +57,27 @@ def pairwise_galerkin_dia(offsets, vals: np.ndarray):
     return offs_c, vals_c
 
 
-def dia_to_scipy(offsets, vals: np.ndarray, n: int) -> sp.csr_matrix:
-    """Row-aligned diagonals → scipy CSR (scipy dia_matrix is
-    column-aligned: data[k, j] = A[j - d, j], so shift accordingly)."""
+def dia_to_scipy(offsets, vals: np.ndarray, n: int,
+                 n_cols: int = None) -> sp.csr_matrix:
+    """Row-aligned diagonals → scipy CSR, built directly with vectorised
+    numpy (scipy's generic ``dia_matrix.tocsr`` is ~20× slower at the
+    256³ Poisson).  Offsets are ascending, so within each row the column
+    order i+d is already sorted; explicit zeros are dropped (matching a
+    CSR assembly of the same operator).  ``n_cols`` supports rectangular
+    row-aligned operators (default square)."""
     nd = len(offsets)
-    data = np.zeros((nd, n), dtype=vals.dtype)
-    for k, d in enumerate(offsets):
-        if d >= 0:
-            data[k, d:] = vals[k, : n - d] if d else vals[k]
-        else:
-            data[k, : n + d] = vals[k, -d:]
-    m = sp.dia_matrix((data, np.asarray(offsets)), shape=(n, n))
-    csr = m.tocsr()
-    csr.eliminate_zeros()
-    csr.sort_indices()
+    m = int(n_cols) if n_cols is not None else n
+    if nd == 0:
+        return sp.csr_matrix((n, m), dtype=vals.dtype)
+    idx_t = np.int32 if max(n, m) < 2**31 - 1 else np.int64
+    offs = np.asarray(offsets, dtype=idx_t)
+    rows = np.arange(n, dtype=idx_t)
+    cols = rows[:, None] + offs[None, :]              # (n, nd)
+    vt = vals.T                                       # (n, nd) view
+    keep = (vt != 0) & (cols >= 0) & (cols < m)
+    ptr_t = np.int32 if n * nd < 2**31 - 1 else np.int64
+    indptr = np.zeros(n + 1, dtype=ptr_t)
+    np.cumsum(keep.sum(axis=1, dtype=ptr_t), out=indptr[1:])
+    csr = sp.csr_matrix((vt[keep], cols[keep], indptr), shape=(n, m))
+    csr.has_sorted_indices = True
     return csr

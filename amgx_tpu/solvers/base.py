@@ -432,9 +432,25 @@ class Solver:
         if hasattr(self, "_refine_lo"):
             return
         vals64 = self._host_pack_vals64()
+        # chunked exactness scan with early exit: integer-valued stencils
+        # (the common benchmark operators) are exactly representable in
+        # f32, and detecting that must not cost four full passes over a
+        # ~1 GB fine-level array
+        flat = vals64.reshape(-1)
+        exact = True
+        step = 1 << 22
+        for s in range(0, flat.size, step):
+            c = flat[s:s + step]
+            if not np.array_equal(c.astype(np.float32).astype(np.float64),
+                                  c):
+                exact = False
+                break
+        if exact:
+            self._refine_lo = None
+            return
         lo = (vals64 - vals64.astype(np.float32).astype(np.float64)) \
             .astype(np.float32)
-        self._refine_lo = jnp.asarray(lo) if np.any(lo) else None
+        self._refine_lo = jnp.asarray(lo)
 
     def _host_pack_vals64(self) -> np.ndarray:
         """The device pack's ``vals`` layout rebuilt on host in f64
@@ -443,9 +459,12 @@ class Solver:
         import scipy.sparse as sp
         from ..core.matrix import dia_arrays, ell_layout
         if Ad.fmt == "dia":
-            offs, vals = dia_arrays(sp.csr_matrix(host))
+            arrs = self.A.dia_cache() if isinstance(self.A, Matrix) \
+                else None
+            offs, vals = arrs if arrs is not None else \
+                dia_arrays(sp.csr_matrix(host))
             assert tuple(offs) == tuple(Ad.dia_offsets)
-            return vals.astype(np.float64)
+            return vals.astype(np.float64, copy=False)
         b = Ad.block_dim
         if b == 1:
             csr = sp.csr_matrix(host)

@@ -67,6 +67,9 @@ def setup_dinv(slv) -> jax.Array:
         d = Ad.diag
         return jnp.where(d != 0, 1.0 / jnp.where(d == 0, 1.0, d), 0.0)
     if A is not None:
+        cached = getattr(A, "_dinv_dev", None)
+        if cached is not None and cached[0] == Ad.dtype:
+            return cached[1]      # rode the hierarchy's batched upload
         return _invert_block_diag(host_block_diag(A).astype(Ad.dtype))
     return _invert_block_diag(np.asarray(Ad.diag))
 
@@ -76,7 +79,7 @@ def host_block_diag(A) -> np.ndarray:
     readback (slow through a remote-TPU tunnel) during setup."""
     b = A.block_dim
     if b == 1:
-        return A.scalar_csr().diagonal()
+        return A.host_diag()
     bsr = A.host if isinstance(A.host, sp.bsr_matrix) else sp.bsr_matrix(
         A.host, blocksize=(b, b))
     bsr.sort_indices()
