@@ -55,6 +55,19 @@ def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     return m
 
 
+def _require_dia(cur: Matrix):
+    """DIA arrays for a structure-reuse refresh; a clear error when the
+    refreshed matrix no longer admits the recorded DIA structure (e.g. a
+    block or rectangular matrix handed to resetup)."""
+    arrs = cur.dia_cache()
+    if arrs is None:
+        raise BadConfigurationError(
+            "resetup: recorded hierarchy structure is DIA-based but the "
+            "refreshed matrix has no diagonal decomposition — call "
+            "setup() for a structural rebuild")
+    return arrs
+
+
 def _narrow_dia(cur: Matrix, arrs):
     """Mixed precision: coarse GRIDS live in the device dtype — they are
     preconditioner data (outer refinement owns final accuracy, the
@@ -187,12 +200,12 @@ class AMGHierarchy:
             elif kind == "pairwise":
                 n_f, = data
                 offs_c, vals_c = self._pairwise_numeric(
-                    _narrow_dia(cur, cur.dia_cache()))
+                    _narrow_dia(cur, _require_dia(cur)))
                 lvl = PairwiseLevel(cur, i, n_f)
                 nxt = _child_matrix_dia(cur, offs_c, vals_c)
             elif kind == "structured":
                 dims, = data
-                offs, vals = _narrow_dia(cur, cur.dia_cache())
+                offs, vals = _narrow_dia(cur, _require_dia(cur))
                 offs3 = decompose_offsets(offs, dims)
                 flat, vals_c, cdims = self._structured_numeric(
                     offs3, vals, dims)

@@ -35,9 +35,10 @@ from ..errors import BadParametersError
 
 @functools.partial(
     jax.tree_util.register_dataclass,
-    data_fields=["cols", "vals", "diag", "row_ids"],
+    data_fields=["cols", "vals", "diag", "row_ids", "win_blocks",
+                 "win_codes", "win_vals"],
     meta_fields=["n_rows", "n_cols", "block_dim", "fmt", "ell_width",
-                 "dia_offsets"],
+                 "dia_offsets", "win_tile"],
 )
 @dataclasses.dataclass(frozen=True)
 class DeviceMatrix:
@@ -64,6 +65,13 @@ class DeviceMatrix:
     fmt: str
     ell_width: int
     dia_offsets: tuple = ()
+    #: windowed-ELL metadata (ops/pallas_ell.py): per-row-tile column-block
+    #: ids (n_tiles, B) and per-entry window codes (n_pad, K); None when
+    #: the matrix exceeds the window budget
+    win_blocks: Optional[jax.Array] = None
+    win_codes: Optional[jax.Array] = None
+    win_vals: Optional[jax.Array] = None
+    win_tile: int = 0
 
     @property
     def n(self) -> int:
@@ -221,9 +229,13 @@ class Matrix:
         self._dia_checked_max = 0
         self._dinv_dev = None
         # generators (io/poisson.py) attach their analytic diagonal
-        # decomposition — setup then never re-extracts it from CSR
+        # decomposition — setup then never re-extracts it from CSR.  The
+        # attach is only adopted if it still matches the CSR values (the
+        # caller may have mutated a.data since generation); a sampled
+        # spot-check catches that without paying a full extraction.
         dia = getattr(a, "_amgx_dia", None)
-        if dia is not None and self.block_dim == 1:
+        if dia is not None and self.block_dim == 1 and \
+                _dia_attach_matches(self._host, dia):
             self._dia = dia
             self._dia_checked_max = 10**9
         gd = getattr(a, "_amgx_grid_dims", None)
@@ -479,9 +491,28 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
         ell_vals = np.zeros((n_rows, k) + block_shape, dtype=dtype)
         cols[for_rows, pos_in_row] = indices
         ell_vals[for_rows, pos_in_row] = vals
+        # windowed-ELL metadata for the gather-free Pallas SpMV
+        # (ops/pallas_ell.py); None when some row tile's columns span too
+        # many 128-blocks (kernel falls back to the XLA gather path)
+        win = None
+        if b == 1 and np.dtype(dtype) == np.float32 and k <= 32:
+            from ..ops.pallas_ell import ell_window_pack, win_vals_pack
+            win = ell_window_pack(cols)
+        import jax as _jax
+        if win is not None:
+            block_ids, codes, tile = win
+            wvals = win_vals_pack(ell_vals, tile)
+            dcols, dvals, ddiag, dblk, dcodes, dwvals = _jax.device_put(
+                [cols, ell_vals, diag, block_ids, codes, wvals])
+            return DeviceMatrix(
+                cols=dcols, vals=dvals, diag=ddiag, row_ids=None,
+                n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="ell",
+                ell_width=k, win_blocks=dblk, win_codes=dcodes,
+                win_vals=dwvals, win_tile=tile)
+        dcols, dvals, ddiag = _jax.device_put([cols, ell_vals, diag])
         return DeviceMatrix(
-            cols=jnp.asarray(cols), vals=jnp.asarray(ell_vals),
-            diag=jnp.asarray(diag), row_ids=None,
+            cols=dcols, vals=dvals,
+            diag=ddiag, row_ids=None,
             n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="ell", ell_width=k)
     return DeviceMatrix(
         cols=jnp.asarray(indices.astype(np.int32)),
@@ -489,6 +520,31 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
         diag=jnp.asarray(diag),
         row_ids=jnp.asarray(for_rows.astype(np.int32)),
         n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="csr", ell_width=0)
+
+
+def _dia_attach_matches(csr, dia, samples: int = 256) -> bool:
+    """Spot-check an attached DIA decomposition against the CSR values.
+
+    Samples ``samples`` stored entries spread over the matrix and
+    compares A[r, c] from the diagonal arrays with csr.data — O(samples)
+    regardless of nnz, catching post-generation mutations of the CSR
+    (e.g. ``A.data *= 2``) that would otherwise make setup silently use
+    stale values."""
+    if not isinstance(csr, sp.csr_matrix) or csr.nnz == 0:
+        return True
+    offsets, vals = dia
+    if vals.shape[1] != csr.shape[0]:
+        return False
+    off_pos = {int(o): k for k, o in enumerate(offsets)}
+    idx = np.linspace(0, csr.nnz - 1, min(samples, csr.nnz)).astype(
+        np.int64)
+    rows = np.searchsorted(csr.indptr, idx, side="right") - 1
+    cols = csr.indices[idx]
+    for e, r, c in zip(idx, rows, cols):
+        k = off_pos.get(int(c) - int(r))
+        if k is None or vals[k, r] != csr.data[e]:
+            return False
+    return True
 
 
 def _dia_diag_row(offsets, vals32: np.ndarray) -> np.ndarray:
@@ -507,17 +563,24 @@ def _pack_dia_arrays(offsets, vals: np.ndarray, n_cols: int, dtype,
     tunnel each transfer pays ~0.3 s fixed latency, so per-array puts
     dominated hierarchy upload time."""
     import jax
-    n = vals.shape[1]
     vals32 = vals.astype(dtype, copy=False)
     diag = _dia_diag_row(offsets, vals32)
     if device is not None:
         dvals, ddiag = jax.device_put([vals32, diag], device)
     else:
         dvals, ddiag = jax.device_put([vals32, diag])
+    return _dia_device_matrix(offsets, dvals, ddiag, n_cols)
+
+
+def _dia_device_matrix(offsets, dvals, ddiag,
+                       n_cols=None) -> DeviceMatrix:
+    """The DIA DeviceMatrix around already-uploaded arrays — the single
+    constructor shared by the per-matrix and batched upload paths."""
     return DeviceMatrix(
-        cols=None, vals=dvals, diag=ddiag,
-        row_ids=None, n_rows=n, n_cols=int(n_cols), block_dim=1,
-        fmt="dia", ell_width=len(offsets),
+        cols=None, vals=dvals, diag=ddiag, row_ids=None,
+        n_rows=dvals.shape[1],
+        n_cols=int(n_cols if n_cols is not None else dvals.shape[1]),
+        block_dim=1, fmt="dia", ell_width=len(offsets),
         dia_offsets=tuple(int(o) for o in offsets))
 
 
@@ -558,11 +621,7 @@ def batch_upload_dia(mats) -> None:
             else jax.device_put(flat)
         for (m, offs, dtype, *_), dv, dd, di in zip(
                 group, dev[0::3], dev[1::3], dev[2::3]):
-            m._device = DeviceMatrix(
-                cols=None, vals=dv, diag=dd, row_ids=None,
-                n_rows=dv.shape[1], n_cols=dv.shape[1], block_dim=1,
-                fmt="dia", ell_width=len(offs),
-                dia_offsets=tuple(int(o) for o in offs))
+            m._device = _dia_device_matrix(offs, dv, dd)
             m._device_dtype = dtype
             m._dinv_dev = (dtype, di)
 

@@ -1,0 +1,213 @@
+"""Pallas TPU kernel: windowed-ELL SpMV for general (unstructured) matrices.
+
+The ELL SpMV needs ``x[cols]`` — a random gather.  XLA lowers TPU gathers
+to a scalar loop (~0.2 GFLOPS measured on the 7-pt Poisson; the VPU has no
+gather hardware) and Mosaic has no general in-kernel gather either.  This
+kernel removes the gather by construction:
+
+* rows are tiled (``T`` rows per grid step); at pack time each tile
+  records the distinct 128-wide **column blocks** its entries touch
+  (≤ ``B`` of them — bandwidth-local matrices such as RCM-ordered meshes
+  and AMG hierarchies qualify) and each entry's column becomes a *window
+  code* ``slot·128 + lane`` into that tile's window,
+* the kernel DMAs the tile's B column blocks of x from HBM into a VMEM
+  window — the only "gather" left is at 512-byte block granularity,
+  which is just B dynamic-slice copies,
+* the per-entry window read is expressed gather-free as a **lane one-hot
+  matmul** ``window · onehot(lane)`` on the MXU ((B, 128) @ (128, T·K) —
+  the systolic array picks each entry's lane from every block at once;
+  the window rides as a manual bf16×3 split so three default-precision
+  passes reproduce the f32 product, since the 0/1 one-hot operand is
+  exact in bf16), a (B, T·K) slot one-hot selects the right block, and
+  the per-row K-reduction is K static lane slices (entries are packed
+  column-major per tile).
+
+Everything stays in native 2D layouts — per-entry arrays are packed
+pre-flattened as (1, N·K) rows on host because Mosaic cannot relayout
+(T, K) → (1, T·K) in-kernel ("unsupported shape cast").
+
+Reference analog: the warp-specialised CSR vector kernels of
+``base/src/multiply.cu:94-196`` / ``generic_spmv_csr.h`` — same contract
+(any sparsity), different hardware mapping (one-hot MXU contraction
+instead of warp-per-row gathers).  f64 and block matrices stay on the XLA
+path.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_spmv import _INTERPRET
+
+#: max distinct column blocks per tile (window = B·128 x-elements)
+_MAX_BLOCKS = 16
+#: per-entry work budget: T·K ≤ this (bounds the (128, T·K) one-hot in
+#: VMEM; the K-reduction is slice-based so nothing is quadratic in T)
+_FLAT_BUDGET = 2048
+
+
+def _tile_rows(K: int) -> int:
+    """Rows per grid step: T·K must be a multiple of 128 (Mosaic lane
+    tiling) and T a multiple of 8; largest such T within the work budget
+    (at least the minimal legal tile)."""
+    from math import gcd
+    t0 = 128 // gcd(K, 128)
+    t0 = t0 * 8 // gcd(t0, 8)          # lcm(t0, 8)
+    return t0 * max(1, min(512, _FLAT_BUDGET // K) // t0)
+
+
+def ell_window_pack(cols: np.ndarray,
+                    max_blocks: int = _MAX_BLOCKS
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    """Build (block_ids (n_tiles, B), codes (1, n_pad·K), tile) on host,
+    or None when some row tile touches more than ``max_blocks`` column
+    blocks.
+
+    ``codes`` hold ``slot·128 + col%128`` in per-tile column-major
+    (k·T + t) order; padding entries keep code 0 (their value is 0,
+    contributing nothing).
+    """
+    n, K = cols.shape
+    tile = _tile_rows(K)
+    n_tiles = -(-n // tile)
+    n_pad = n_tiles * tile
+    cols_p = np.zeros((n_pad, K), dtype=np.int64)
+    cols_p[:n] = cols
+    # column-major within each tile (position k·T + t): the kernel's
+    # per-row K-reduction is then K contiguous (1, T) lane slices — no
+    # summing matmul needed
+    cols_t = cols_p.reshape(n_tiles, tile, K).transpose(0, 2, 1)
+    blk = (cols_t // 128).reshape(n_tiles, tile * K)
+    lane = (cols_t % 128).astype(np.int32).reshape(n_tiles, tile * K)
+    ublocks = [np.unique(row) for row in blk]
+    B = max(len(u) for u in ublocks)
+    if B > max_blocks:
+        return None
+    B = -(-B // 8) * 8          # sublane-aligned window (MXU operand)
+    block_ids = np.zeros((n_tiles, B), dtype=np.int32)
+    codes = np.empty((n_tiles, tile * K), dtype=np.int32)
+    for t, u in enumerate(ublocks):
+        block_ids[t, : len(u)] = u
+        slot = np.searchsorted(u, blk[t]).astype(np.int32)
+        codes[t] = slot * 128 + lane[t]
+    return block_ids, codes.reshape(1, n_pad * K), tile
+
+
+def ell_window_supported(Ad) -> bool:
+    return (Ad.win_codes is not None and Ad.block_dim == 1
+            and jnp.dtype(Ad.dtype) == jnp.float32
+            and (jax.default_backend() == "tpu" or _INTERPRET))
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _ell_window_call(block_ids, codes, vals_flat, x2, T: int, meta):
+    n_tiles, B, K = meta
+    TK = T * K
+
+    def kernel(blk_ref, x_ref, codes_ref, vals_ref, y_ref, xw, sem):
+        i = pl.program_id(0)
+        # start every window-block copy, then drain: the B DMAs overlap
+        # (they share one semaphore; each wait consumes one completion)
+        cps = [pltpu.make_async_copy(
+                   x_ref.at[pl.ds(blk_ref[i * B + j], 1), :],
+                   xw.at[pl.ds(j, 1), :], sem)
+               for j in range(B)]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+        codes_t = codes_ref[...]                        # (1, T·K) int32
+        slot = jax.lax.shift_right_logical(
+            codes_t, jnp.asarray(7, codes_t.dtype))
+        lane = jnp.bitwise_and(codes_t, jnp.asarray(127, codes_t.dtype))
+        # transposed lane one-hot, built directly in (128, T·K) layout;
+        # 0/1 is exact in bf16, so the MXU passes below lose nothing on
+        # this operand
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (128, TK), 0)
+        ohT = (lane == iota_l).astype(jnp.bfloat16)     # (128, T·K)
+        # bf16×3 split of the window: one default-precision MXU pass per
+        # component reconstructs the f32 product exactly (the 6-pass
+        # Precision.HIGHEST would split BOTH operands — wasted on a
+        # one-hot)
+        xw_f = xw[...]
+        h1 = xw_f.astype(jnp.bfloat16)
+        r1 = xw_f - h1.astype(jnp.float32)
+        h2 = r1.astype(jnp.bfloat16)
+        h3 = (r1 - h2.astype(jnp.float32)).astype(jnp.bfloat16)
+        dims = (((1,), (0,)), ((), ()))
+        pick = (jax.lax.dot_general(
+                    h1, ohT, dims, preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(
+                    h2, ohT, dims, preferred_element_type=jnp.float32)
+                + jax.lax.dot_general(
+                    h3, ohT, dims, preferred_element_type=jnp.float32))
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, TK), 0)
+        sel = jnp.sum(jnp.where(slot == iota_b, pick, 0.0), axis=0,
+                      keepdims=True)                    # (1, T·K)
+        p = vals_ref[...] * sel                         # (1, T·K)
+        # codes/vals are column-major per tile (position k·T + t): the
+        # per-row K-reduction is K contiguous static lane slices
+        acc = p[:, 0:T]
+        for k in range(1, K):
+            acc = acc + p[:, k * T:(k + 1) * T]
+        y_ref[...] = acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # x2 stays in HBM
+            # literals via jnp.int32: under jax_enable_x64 a Python 0
+            # becomes i64 and Mosaic rejects the mixed-width index tuple
+            pl.BlockSpec((1, TK), lambda i, blk: (jnp.int32(0), i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, TK), lambda i, blk: (jnp.int32(0), i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, T),
+                               lambda i, blk: (jnp.int32(0), i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((B, 128), vals_flat.dtype),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_tiles * T),
+                                       vals_flat.dtype),
+        grid_spec=grid_spec,
+        interpret=_INTERPRET,
+    )(block_ids.reshape(-1), x2, codes, vals_flat)
+
+
+def win_vals_pack(vals: np.ndarray, tile: int) -> np.ndarray:
+    """Values in the kernel's (1, n_pad·K) per-tile column-major layout
+    — packed once on host next to the codes (doing the transpose on
+    device would re-stream A's values every traced SpMV)."""
+    n, K = vals.shape
+    n_tiles = -(-n // tile)
+    n_pad = n_tiles * tile
+    if n_pad != n:
+        vals = np.concatenate(
+            [vals, np.zeros((n_pad - n, K), dtype=vals.dtype)])
+    return np.ascontiguousarray(
+        vals.reshape(n_tiles, tile, K).transpose(0, 2, 1)
+    ).reshape(1, n_pad * K)
+
+
+def ell_window_spmv(Ad, x: jax.Array) -> jax.Array:
+    """y = A @ x via the windowed one-hot kernel (fmt == 'ell')."""
+    n, T, K = Ad.n_rows, Ad.win_tile, Ad.ell_width
+    n_tiles, B = Ad.win_blocks.shape
+    m_pad = -(-Ad.n_cols // 128) * 128
+    x2 = jnp.pad(x, (0, m_pad - Ad.n_cols)).reshape(-1, 128)
+    y = _ell_window_call(Ad.win_blocks, Ad.win_codes, Ad.win_vals, x2, T,
+                         (n_tiles, B, K))
+    return y.reshape(-1)[:n]

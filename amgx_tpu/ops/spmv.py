@@ -47,6 +47,11 @@ def spmv(A, x: jax.Array) -> jax.Array:
     b = A.block_dim
     if A.fmt == "ell":
         if b == 1:
+            from .pallas_ell import ell_window_spmv, ell_window_supported
+            if ell_window_supported(A):
+                # gather-free windowed one-hot kernel (XLA lowers the
+                # x[cols] gather to a scalar loop — ~100× slower)
+                return ell_window_spmv(A, x)
             # cols: (n, K); vals: (n, K); x: (m,)
             return jnp.sum(A.vals * x[A.cols], axis=1)
         xb = x.reshape(A.n_cols, b)

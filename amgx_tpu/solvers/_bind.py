@@ -63,6 +63,15 @@ class DeviceBindings:
             if obj is None or id(obj) in seen:
                 continue
             seen.add(id(obj))
+            if getattr(obj, "_Ad", False) is None:
+                # lazy AMGLevel pack not yet materialised: force it NOW so
+                # it becomes a bound slot — if it materialised after
+                # discovery, a later retrace would read the concrete pack
+                # through the property and bake it in as an XLA constant
+                try:
+                    obj.Ad
+                except Exception:
+                    pass
             for k, v in list(vars(obj).items()):
                 if k.startswith("_solve_fn") or k == "_bindings":
                     continue

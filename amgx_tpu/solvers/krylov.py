@@ -278,27 +278,43 @@ class _GMRESBase(Solver):
     def solve_iteration(self, b, x, state, iter_idx):
         m = self.restart
         j = jnp.mod(iter_idx, m)
+        restart = (j == 0) & (iter_idx > 0)
 
-        # --- restart: recompute true residual and restart the basis
-        def do_restart(args):
-            x, state = args
-            fresh = self.solve_init(b, x)
-            return fresh
+        # --- restart: recompute the true residual and restart the basis.
+        # Only the (n,)-sized pieces ride the branch — rebuilding the whole
+        # (m+1, n) state under a cond made XLA materialise a copy of the
+        # Krylov basis EVERY iteration (measured ~3× the per-iteration
+        # cost at 256³); stale basis rows are instead neutralised by the
+        # row masks on the CGS2 coefficients below.
+        def fresh_v0(_):
+            r = b - spmv(self.Ad, x)
+            beta = blas.nrm2(r)
+            v0 = jnp.where(beta > 0, r / jnp.where(beta == 0, 1, beta), 0.0)
+            return v0, jnp.abs(beta)
 
-        def keep(args):
-            _, state = args
-            return state
+        def keep_v0(_):
+            return state.V[0], state.g[0]
 
-        state = jax.lax.cond((j == 0) & (iter_idx > 0), do_restart, keep,
-                             (x, state))
+        v0, beta = jax.lax.cond(restart, fresh_v0, keep_v0, None)
+        V = state.V.at[0].set(v0)
+        x_base = jnp.where(restart, x, state.x_base)
+        zeros_m = jnp.zeros((m,), V.dtype)
+        g = jnp.where(restart, jnp.zeros((m + 1,), V.dtype).at[0].set(beta),
+                      state.g)
+        cs = jnp.where(restart, zeros_m, state.cs)
+        sn = jnp.where(restart, zeros_m, state.sn)
+        state = state._replace(V=V, g=g, cs=cs, sn=sn, x_base=x_base)
 
-        # --- Arnoldi step with CGS2 orthogonalisation
+        # --- Arnoldi step with CGS2 orthogonalisation; rows > j may hold
+        # stale directions from the previous cycle — mask their
+        # coefficients instead of zeroing the basis storage
+        row_ok = (jnp.arange(m + 1) <= j).astype(V.dtype)
         v_j = state.V[j]
         z_j = self._M(v_j)
         w = spmv(self.Ad, z_j)
-        h1 = state.V @ w            # rows > j are zero ⇒ coefficients zero
+        h1 = (state.V @ w) * row_ok
         w = w - state.V.T @ h1
-        h2 = state.V @ w
+        h2 = (state.V @ w) * row_ok
         w = w - state.V.T @ h2
         hcol = h1 + h2              # (m+1,)
         h_next = blas.nrm2(w)

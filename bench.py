@@ -124,15 +124,19 @@ def main():
         fmt_stats[fmt_name] = round(gf, 2)
 
     # ---------------- FGMRES + aggregation AMG ----------------
+    # restart 6: AMG+CG-cycle preconditioning converges identically with a
+    # short Krylov memory, and FGMRES orthogonalisation traffic scales
+    # with the basis size (measured best total time at 128³ and 256³);
+    # 2+2 sweeps trades slightly costlier cycles for fewer iterations
     cfg = amgx.AMGConfig(
         "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
         "out:monitor_residual=1, out:tolerance=1e-8, "
-        "out:convergence=RELATIVE_INI, out:gmres_n_restart=20, "
+        "out:convergence=RELATIVE_INI, out:gmres_n_restart=6, "
         "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
         "amg:selector=GEO, amg:max_iters=1, amg:max_levels=20, "
         "amg:cycle=CG, amg:cycle_iters=2, "
         "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
-        "amg:presweeps=1, amg:postsweeps=2, amg:min_coarse_rows=32, "
+        "amg:presweeps=2, amg:postsweeps=2, amg:min_coarse_rows=32, "
         "amg:coarse_solver=DENSE_LU_SOLVER")
     case = _run_case(A, m, cfg, dtype)
 

@@ -13,6 +13,7 @@ from amgx_tpu.io import poisson7pt, write_matrix_market
 
 EXAMPLES = [
     ("amgx_capi.py", ["-m", "{mtx}", "-c", "{cfg}"]),
+    ("amgx_mpi_capi.py", ["-m", "{mtx}", "-p", "4"]),
     ("amgx_mpi_capi_agg.py", ["-m", "{mtx}", "-p", "4"]),
     ("amgx_mpi_capi_cla.py", ["-m", "{mtx}", "-p", "4"]),
     ("eigensolver.py", ["-m", "{mtx}"]),
