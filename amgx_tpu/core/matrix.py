@@ -495,7 +495,7 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
         # (ops/pallas_ell.py); None when some row tile's columns span too
         # many 128-blocks (kernel falls back to the XLA gather path)
         win = None
-        if b == 1 and np.dtype(dtype) == np.float32 and k <= 32:
+        if b == 1 and np.dtype(dtype) == np.float32 and k <= 160:
             from ..ops.pallas_ell import ell_window_pack, win_vals_pack
             win = ell_window_pack(cols)
         import jax as _jax

@@ -45,21 +45,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_spmv import _INTERPRET
 
-#: max distinct column blocks per tile (window = B·128 x-elements)
-_MAX_BLOCKS = 16
-#: per-entry work budget: T·K ≤ this (bounds the (128, T·K) one-hot in
-#: VMEM; the K-reduction is slice-based so nothing is quadratic in T)
+#: max distinct column blocks per tile (window = B·128 x-elements);
+#: classical-AMG coarse operators need ~24-36 on the 64³ Poisson
+_MAX_BLOCKS = 40
+#: per-entry work target: T·K stays ≤ this where possible — but T has a
+#: hard floor of 128 (output-block lane legality), so for K > 16 the
+#: actual invariant is T·K ≤ max(_FLAT_BUDGET, 128·K); the VMEM guard in
+#: ell_window_pack is what really bounds the kernel footprint
 _FLAT_BUDGET = 2048
 
 
 def _tile_rows(K: int) -> int:
-    """Rows per grid step: T·K must be a multiple of 128 (Mosaic lane
-    tiling) and T a multiple of 8; largest such T within the work budget
-    (at least the minimal legal tile)."""
-    from math import gcd
-    t0 = 128 // gcd(K, 128)
-    t0 = t0 * 8 // gcd(t0, 8)          # lcm(t0, 8)
-    return t0 * max(1, min(512, _FLAT_BUDGET // K) // t0)
+    """Rows per grid step: T must be a multiple of 128 — the (1, T)
+    output block's lane dim has to be 128-divisible, which also makes
+    T·K lane-legal for the codes/vals blocks.  Largest such T within the
+    work budget (≥ 128; at K=32 the (128, T·K) one-hot is 2 MB VMEM,
+    still comfortable)."""
+    return 128 * max(1, min(512, _FLAT_BUDGET // K) // 128)
 
 
 def ell_window_pack(cols: np.ndarray,
@@ -90,6 +92,12 @@ def ell_window_pack(cols: np.ndarray,
     if B > max_blocks:
         return None
     B = -(-B // 8) * 8          # sublane-aligned window (MXU operand)
+    # VMEM guard (~16 MB/core total): the kernel materialises the
+    # (128, T·K) bf16 one-hot (256·T·K bytes), the (B, T·K) f32 pick
+    # (4·B·T·K), and double-buffered codes/vals blocks (16·T·K) — keep
+    # the sum well under the core's share
+    if tile * K * (272 + 4 * B) > (10 << 20):
+        return None
     block_ids = np.zeros((n_tiles, B), dtype=np.int32)
     codes = np.empty((n_tiles, tile * K), dtype=np.int32)
     for t, u in enumerate(ublocks):

@@ -72,9 +72,10 @@ def test_scattered_falls_back():
 
 
 def test_tile_rows_legal():
-    for K in range(1, 33):
+    # the (1, T) output block's lane dim must be 128-divisible
+    for K in range(1, 161):
         T = pallas_ell._tile_rows(K)
-        assert T % 8 == 0 and (T * K) % 128 == 0
+        assert T % 128 == 0
 
 
 def test_pack_codes_roundtrip():
