@@ -40,9 +40,11 @@ from .partition import Partition, build_partition
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["cols", "vals", "diag", "send_idx", "halo_src",
-                 "bnd_rows", "send_idx2", "halo_src2"],
+                 "bnd_rows", "send_idx2", "halo_src2", "win_blocks",
+                 "win_codes", "win_vals"],
     meta_fields=["n_global", "n_parts", "n_loc", "ell_width", "block_dim",
-                 "axis", "dists", "dists2", "offsets", "mesh"],
+                 "axis", "dists", "dists2", "offsets", "win_tile",
+                 "mesh"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardedMatrix:
@@ -73,6 +75,13 @@ class ShardedMatrix:
     dists: tuple          # ring-1 rank distances (owner − p) mod P
     dists2: tuple         # ring-2 rank distances
     offsets: tuple        # (P+1,) real row offsets per rank
+    #: per-shard windowed-ELL pack (ops/pallas_ell.py) for the interior
+    #: SpMV on TPU backends; None when some shard exceeds the window
+    #: budget (local compute then falls back to the XLA gather)
+    win_blocks: Optional[jax.Array] = None   # (P, n_tiles·B) int32
+    win_codes: Optional[jax.Array] = None    # (P, n_pad·K) int32
+    win_vals: Optional[jax.Array] = None     # (P, n_pad·K)
+    win_tile: int = 0
     #: static (meta) so traced packs keep it — tracers have no .sharding
     mesh: Mesh = None
 
@@ -221,6 +230,32 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         vals[p, r, 0] = 1.0
         diag[p, r] = 1.0
 
+    # per-shard windowed-ELL pack for the TPU interior SpMV (columns
+    # index the [local | halo] extended space — rectangular is fine);
+    # all shards must fit the window budget or none carry it
+    win_blocks = win_codes = win_vals = None
+    win_tile = 0
+    from ..ops.pallas_ell import _INTERPRET
+    mesh_is_tpu = mesh.devices.flat[0].platform == "tpu"
+    if np.dtype(dtype) == np.float32 and K <= 160 and \
+            (mesh_is_tpu or _INTERPRET):
+        from ..ops.pallas_ell import ell_window_pack, win_vals_pack
+        packs = [ell_window_pack(cols[p]) for p in range(n_parts)]
+        if all(pk is not None for pk in packs):
+            win_tile = packs[0][2]
+            Bmax = max(pk[0].shape[1] for pk in packs)
+            nt = packs[0][0].shape[0]
+            wb = np.zeros((n_parts, nt * Bmax), dtype=np.int32)
+            for p, (bids, _, _) in enumerate(packs):
+                padded = np.zeros((nt, Bmax), dtype=np.int32)
+                padded[:, : bids.shape[1]] = bids
+                wb[p] = padded.reshape(-1)
+            win_blocks = wb
+            win_codes = np.stack([pk[1][0] for pk in packs])
+            win_vals = np.stack(
+                [win_vals_pack(vals[p], win_tile)[0]
+                 for p in range(n_parts)])
+
     spec3 = NamedSharding(mesh, P(axis, None, None))
     spec2 = NamedSharding(mesh, P(axis, None))
     spec1 = NamedSharding(mesh, P(axis))
@@ -234,6 +269,13 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         bnd_rows=jax.device_put(part.bnd_rows, spec2),
         send_idx2=jax.device_put(r2.send_idx, spec2),
         halo_src2=jax.device_put(r2.halo_src, spec2),
+        win_blocks=None if win_blocks is None else
+        jax.device_put(win_blocks, spec2),
+        win_codes=None if win_codes is None else
+        jax.device_put(win_codes, spec2),
+        win_vals=None if win_vals is None else
+        jax.device_put(win_vals, spec2),
+        win_tile=win_tile,
         n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
         ell_width=K, block_dim=1, axis=axis,
         dists=part.dists, dists2=r2.dists,
@@ -301,8 +343,34 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     """
     axis = A.axis
     n_parts = A.n_parts
+    from ..ops.pallas_ell import _INTERPRET
+    # gate on the MESH's platform, not the process default backend — a
+    # CPU debug mesh on a TPU host must take the gather path
+    use_win = (A.win_blocks is not None
+               and (A.mesh.devices.flat[0].platform == "tpu"
+                    or _INTERPRET))
 
-    def local(cols, vals, send_idx, halo_src, bnd_rows, xl):
+    def interior_gather(cols, vals, xfull0, _wb, _wc, _wv):
+        return jnp.sum(vals * xfull0[cols], axis=1)
+
+    def interior_win(cols, vals, xfull0, wb, wc, wv):
+        # windowed one-hot Pallas kernel — a per-chip gather would
+        # otherwise throttle every shard (ops/pallas_ell.py)
+        from ..ops.pallas_ell import _ell_window_call
+        n_loc = cols.shape[0]
+        T, K = A.win_tile, A.ell_width
+        n_pad = wc.shape[0] // K
+        n_tiles = n_pad // T
+        B = wb.shape[0] // n_tiles
+        m_pad = -(-xfull0.shape[0] // 128) * 128
+        x2 = jnp.pad(xfull0, (0, m_pad - xfull0.shape[0])) \
+            .reshape(-1, 128)
+        return _ell_window_call(wb, wc[None, :], wv[None, :], x2, T,
+                                (n_tiles, B, K)).reshape(-1)[:n_loc]
+
+    interior = interior_win if use_win else interior_gather
+
+    def local(cols, vals, send_idx, halo_src, bnd_rows, wb, wc, wv, xl):
         cols, vals = cols[0], vals[0]
         send_idx, halo_src, bnd = send_idx[0], halo_src[0], bnd_rows[0]
         n_loc = xl.shape[0]
@@ -312,8 +380,9 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
         hvals = got[halo_src]                               # (H,)
         # interior: halo slots read zero — independent of the exchange
         xfull0 = jnp.concatenate([xl, jnp.zeros((H,), xl.dtype)])
-        y0 = jnp.sum(vals * xfull0[cols], axis=1)
-        # boundary correction: only rows with halo columns
+        y0 = interior(cols, vals, xfull0, wb[0], wc[0], wv[0])
+        # boundary rows get a small gathered correction scattered back
+        # through a trash slot
         rows = jnp.minimum(bnd, n_loc - 1)
         cb = cols[rows]                                     # (Bd, K)
         vb = vals[rows]
@@ -323,12 +392,24 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
         yext = jnp.zeros((n_loc + 1,), xl.dtype).at[bnd].add(corr)
         return y0 + yext[:n_loc]
 
+    # the win arrays always ride the shard_map signature (dummy scalars
+    # when absent) so both paths share one body
+    zeros = jnp.zeros((n_parts, 1), jnp.int32)
+    wb = A.win_blocks if A.win_blocks is not None else zeros
+    wc = A.win_codes if A.win_codes is not None else zeros
+    wv = A.win_vals if A.win_vals is not None else \
+        jnp.zeros((n_parts, 1), A.vals.dtype)
     return jax.shard_map(
         local, mesh=A.mesh,
         in_specs=(P(axis, None, None), P(axis, None, None),
-                  P(axis, None), P(axis, None), P(axis, None), P(axis)),
+                  P(axis, None), P(axis, None), P(axis, None),
+                  P(axis, None), P(axis, None), P(axis, None),
+                  P(axis)),
         out_specs=P(axis),
-    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, x)
+        # the pallas_call's out_shape carries no varying-mesh-axes
+        # annotation — skip the vma check
+        check_vma=False,
+    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, wb, wc, wv, x)
 
 
 def vector_sharding(A: ShardedMatrix) -> NamedSharding:
