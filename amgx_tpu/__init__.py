@@ -15,10 +15,25 @@ Architecture (see SURVEY.md for the reference layer map this mirrors):
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
 
 # fp64 host modes (hDDI) and convergence-parity testing need x64 enabled.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compile cache: the reference ships precompiled kernels,
+# so its setup pays zero JIT cost at run time; caching compiled
+# executables across processes is the XLA equivalent (first-ever run
+# still compiles).  Opt out with AMGX_TPU_COMPILE_CACHE=0.
+_cache_dir = _os.environ.get("AMGX_TPU_COMPILE_CACHE",
+                             _os.path.expanduser("~/.cache/amgx_tpu_xla"))
+if _cache_dir not in ("0", "") and \
+        _jax.config.jax_compilation_cache_dir is None:
+    # never clobber a cache the host application already configured
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 __version__ = "0.1.0"
 #: reference parity target (ReleaseVersion.txt:1)

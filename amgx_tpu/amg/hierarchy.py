@@ -158,6 +158,7 @@ class AMGHierarchy:
         """Run the fresh coarsening loop from ``cur``, appending to
         ``self.levels`` / ``self._structure``; returns the coarsest matrix
         (reference hot setup loop, ``amg.cu:177-450``)."""
+        cur = self._build_dia_device(cur)
         while True:
             n = cur.n_block_rows
             if len(self.levels) + 1 >= self.max_levels:
@@ -188,7 +189,10 @@ class AMGHierarchy:
         old = list(zip(self.levels, self._structure))
         self.levels = []
         self._structure = []
+        consumed, cur = self._reuse_dia_device(cur, old)
         for i, (level, struct) in enumerate(old):
+            if i < consumed:
+                continue
             if 0 < self.structure_reuse_levels <= i:
                 break
             kind, data = struct
@@ -226,6 +230,139 @@ class AMGHierarchy:
         # rebuild any remaining levels fresh from the reused prefix
         cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
+
+    def _dia_plan_inputs(self, cur: Matrix, max_diags: int = 48):
+        """(offsets, host vals, dims-or-None) of a DIA-eligible matrix —
+        THE single home of the structured-vs-pairwise gate (grid-dims
+        attach/inference, offset decomposition, wrap-coupling value
+        check); shared by the device plan, the host ``_coarsen_pairwise``
+        loop, and the device reuse refresh so the three can never
+        drift.  None when ``cur`` has no DIA decomposition."""
+        if cur.block_dim != 1 or cur.n_block_rows < 2:
+            return None
+        arrs = cur.dia_cache(max_diags)
+        if arrs is None:
+            return None
+        offs, vals = arrs       # values only feed the consistency check
+        dims = getattr(cur, "grid_dims", None)
+        n = cur.n_block_rows
+        if dims is not None and int(np.prod(dims)) != n:
+            dims = None
+        if dims is None:
+            dims = infer_grid_dims(offs, n)
+        if dims is not None and max(dims) > 1:
+            offs3 = decompose_offsets(offs, dims)
+            if offs3 is None or \
+                    not stencil_values_consistent(offs3, vals, dims):
+                dims = None      # periodic/wrap stencil: decode is a lie
+        return offs, vals, dims
+
+    def _dia_device_eligible(self, cur: Matrix) -> bool:
+        """Device-derivation gates on top of DIA eligibility: the GEO
+        aggregation path, single-device, no placement pinning (pinned
+        host modes keep the host loop so the pack stays on their
+        device)."""
+        if self.algorithm != "AGGREGATION":
+            return False
+        name = str(self.cfg.get("selector", self.scope))
+        if name not in ("GEO", "PAIRWISE"):
+            return False
+        return cur.dist is None and cur.placement is None
+
+    def _append_dia_levels(self, cur: Matrix, steps, outs) -> Matrix:
+        """Materialise planned DIA levels around the device-derived
+        (vals, diag, dinv) outputs; returns the coarsest matrix."""
+        cur._dinv_dev = (np.dtype(cur.device().dtype), outs[0][1])
+        for st, (vals_c, diag_c, dinv_c) in zip(steps, outs[1:]):
+            idx = len(self.levels)
+            if st.kind == "structured":
+                level = StructuredLevel(cur, idx, st.dims, st.cdims)
+                struct = ("structured", (st.dims,))
+            else:
+                level = PairwiseLevel(cur, idx, st.n)
+                struct = ("pairwise", (st.n,))
+            Ac = Matrix.from_dia_device(st.c_offsets, vals_c, diag_c,
+                                        dinv_c)
+            Ac.placement = cur.placement
+            if st.kind == "structured":
+                Ac.grid_dims = st.cdims
+            self.levels.append(level)
+            self._structure.append(struct)
+            cur = Ac
+        return cur
+
+    def _build_dia_device(self, cur: Matrix) -> Matrix:
+        """Accelerated setup for the structured/pairwise DIA hierarchy:
+        plan every coarsening decision statically from the stencil
+        structure, then derive ALL coarse levels' values + smoother
+        diagonals on the device in one jitted pass (amg/dia_device.py —
+        the reference's on-accelerator setup loop, ``amg.cu:177-450``).
+        Returns the coarsest planned matrix; falls through untouched (the
+        generic host loop takes over) when ``cur`` is not DIA-eligible."""
+        from .dia_device import derive_hierarchy_device, plan_dia_hierarchy
+        if not self._dia_device_eligible(cur):
+            return cur
+        inputs = self._dia_plan_inputs(cur)
+        if inputs is None:
+            return cur
+        offs, vals, dims = inputs
+        steps, _bailed = plan_dia_hierarchy(
+            offs, cur.n_block_rows, dims, self.max_levels,
+            self.min_coarse_rows, self.coarsen_threshold,
+            existing_levels=len(self.levels))
+        if not steps:
+            return cur
+        curd = cur.device()
+        if curd.fmt != "dia":
+            return cur
+        with cpu_profiler("dia_device_derive"):
+            outs = derive_hierarchy_device(steps, offs, curd.vals)
+        return self._append_dia_levels(cur, steps, outs)
+
+    def _reuse_dia_device(self, cur: Matrix, old) -> tuple:
+        """Numeric refresh of a reused structured/pairwise prefix ON
+        DEVICE (one jitted pass, amg/dia_device.py) — the resetup analog
+        of the reference's device-side value-only Galerkin refresh
+        (``csr_multiply.h:100-126``).  Returns (levels consumed, coarsest
+        matrix); (0, cur) falls back to the per-level host refresh."""
+        from .dia_device import derive_hierarchy_device, plan_dia_hierarchy
+        prefix = []
+        for i, (_, struct) in enumerate(old):
+            if 0 < self.structure_reuse_levels <= i:
+                break
+            if struct[0] not in ("structured", "pairwise"):
+                break
+            prefix.append(struct)
+        if not prefix:
+            return 0, cur
+        if not self._dia_device_eligible(cur):
+            return 0, cur
+        inputs = self._dia_plan_inputs(cur)
+        if inputs is None:
+            return 0, cur
+        offs, _, dims = inputs
+        steps, _ = plan_dia_hierarchy(
+            offs, cur.n_block_rows, dims, self.max_levels,
+            self.min_coarse_rows, self.coarsen_threshold)
+        # refresh the LONGEST matching prefix on device; a tail the
+        # recorded (possibly host-built) structure disagrees on falls to
+        # the per-level host refresh below
+        matched = 0
+        for st, (kind, data) in zip(steps, prefix):
+            if st.kind != kind or \
+                    (kind == "structured" and st.dims != tuple(data[0])) \
+                    or (kind == "pairwise" and st.n != data[0]):
+                break
+            matched += 1
+        if matched == 0:
+            return 0, cur
+        steps = steps[:matched]
+        curd = cur.device()
+        if curd.fmt != "dia":
+            return 0, cur
+        with cpu_profiler("dia_device_derive"):
+            outs = derive_hierarchy_device(steps, offs, curd.vals)
+        return len(steps), self._append_dia_levels(cur, steps, outs)
 
     def _coarsen_once(self, cur: Matrix, idx: int):
         if self.algorithm == "AGGREGATION":
@@ -326,23 +463,17 @@ class AMGHierarchy:
         n = cur.n_block_rows
         if n < 2:
             return None, None, None   # stop coarsening here
-        arrs = cur.dia_cache(max_diags)
-        if arrs is None:
+        # shared structured-vs-pairwise gate (2×2×2 cells when the grid
+        # geometry is known/inferable — geo_selector.cu analog — with
+        # wrap-coupling detection; 1D index pairing otherwise)
+        inputs = self._dia_plan_inputs(cur, max_diags)
+        if inputs is None:
             return _PAIRWISE_FALLBACK
-        arrs = _narrow_dia(cur, arrs)
-        # isotropic 2×2×2 cells when the grid geometry is known/inferable
-        # (geo_selector.cu analog); falls back to 1D index pairing
-        dims = getattr(cur, "grid_dims", None)
+        offs_raw, vals_raw, dims = inputs
+        arrs = _narrow_dia(cur, (offs_raw, vals_raw))
         offs, vals = arrs
-        if dims is not None and int(np.prod(dims)) != n:
-            dims = None          # stale/wrong user attach: fall back
-        if dims is None:
-            dims = infer_grid_dims(offs, n)
         if dims is not None and max(dims) > 1:
             offs3 = decompose_offsets(offs, dims)
-            if offs3 is not None and \
-                    not stencil_values_consistent(offs3, vals, dims):
-                offs3 = None     # periodic/wrap stencil: decode is a lie
             if offs3 is not None:
                 out = self._structured_numeric(offs3, vals, dims)
                 if out is not None:

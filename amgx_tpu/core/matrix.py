@@ -261,11 +261,48 @@ class Matrix:
         m._n_dia = (vals.shape[1], int(n_cols or vals.shape[1]))
         return m
 
+    @classmethod
+    def from_dia_device(cls, offsets, dvals, ddiag=None, dinv=None,
+                        n_cols: Optional[int] = None) -> "Matrix":
+        """Build around DEVICE-resident row-aligned DIA arrays.
+
+        The device-side hierarchy derivation (amg/dia_device.py) produces
+        coarse operators directly on the accelerator; wrapping them here
+        means no value ever crosses the device↔host link during setup.
+        The scipy ``host`` view downloads lazily — only consumers that
+        genuinely need host values (dense coarse LU, grid-stats nnz, IO)
+        pay the transfer.
+        """
+        m = cls()
+        m.block_dim = 1
+        m.dtype = np.dtype(dvals.dtype)
+        m.device_dtype = np.dtype(dvals.dtype)
+        offsets = [int(o) for o in offsets]
+        if ddiag is None:
+            ddiag = _dia_device_diag(offsets, dvals)
+        m._device = _dia_device_matrix(offsets, dvals, ddiag, n_cols)
+        m._device_dtype = np.dtype(dvals.dtype)
+        m._n_dia = (dvals.shape[1], int(n_cols or dvals.shape[1]))
+        if dinv is not None:
+            m._dinv_dev = (m._device_dtype, dinv)
+        return m
+
+    def _download_dia(self):
+        """Fetch a device-resident DIA pack back to host (lazy — dense
+        coarse solves, grid stats, and IO are the only consumers)."""
+        d = self._device
+        self._dia = (list(d.dia_offsets), np.asarray(d.vals))
+        self._dia_checked_max = 10**9
+        return self._dia
+
     def dia_cache(self, max_diags: Optional[int] = None):
         """The (offsets, vals) diagonal decomposition, computed at most
         once per matrix; None when it has more than ``max_diags``
         diagonals (negative cache: the check is not repeated for smaller
         budgets)."""
+        if self._dia is None and self._host is None and \
+                self._device is not None and self._device.fmt == "dia":
+            self._download_dia()
         if self._dia is not None:
             offs, _ = self._dia
             if max_diags is not None and len(offs) > max_diags:
@@ -287,6 +324,9 @@ class Matrix:
 
     def host_diag(self) -> np.ndarray:
         """Main (block) diagonal from host data without assembling CSR."""
+        if self._dia is None and self._host is None and self.block_dim == 1 \
+                and self._device is not None and self._device.fmt == "dia":
+            self._download_dia()
         if self._dia is not None and self.block_dim == 1:
             offs, vals = self._dia
             try:
@@ -350,6 +390,9 @@ class Matrix:
     # ------------------------------------------------------------- properties
     @property
     def host(self) -> sp.spmatrix:
+        if self._host is None and self._dia is None and \
+                self._device is not None and self._device.fmt == "dia":
+            self._download_dia()
         if self._host is None and self._dia is not None:
             from ..amg.pairwise import dia_to_scipy
             offs, vals = self._dia
@@ -373,6 +416,8 @@ class Matrix:
     def n_block_rows(self) -> int:
         if self._host is None and self.blocks is not None:
             return int(self.block_offsets[-1]) // self.block_dim
+        if self._host is None and hasattr(self, "_n_dia"):
+            return self._n_dia[0]
         if self._host is None and self._dia is not None:
             return self._dia[1].shape[1]
         return self._host.shape[0] // self.block_dim
@@ -381,15 +426,18 @@ class Matrix:
     def n_block_cols(self) -> int:
         if self._host is None and self.blocks is not None:
             return self.blocks[0].shape[1] // self.block_dim
+        if self._host is None and hasattr(self, "_n_dia"):
+            return self._n_dia[1]
         if self._host is None and self._dia is not None:
-            return getattr(self, "_n_dia", (0, self._dia[1].shape[1]))[1]
+            return self._dia[1].shape[1]
         return self._host.shape[1] // self.block_dim
 
     @property
     def shape(self):
         if self._host is None and self.blocks is not None:
             return (int(self.block_offsets[-1]), self.blocks[0].shape[1])
-        if self._host is None and self._dia is not None:
+        if self._host is None and (self._dia is not None or
+                                   hasattr(self, "_n_dia")):
             return (self.n_block_rows, self.n_block_cols)
         return self._host.shape
 
@@ -398,6 +446,9 @@ class Matrix:
         # number of stored blocks × block area = scalar nnz
         if self._host is None and self.blocks is not None:
             return int(sum(b.nnz for b in self.blocks))
+        if self._host is None and self._dia is None and \
+                self._device is not None and self._device.fmt == "dia":
+            self._download_dia()     # lazy: grid-stats / IO consumers only
         if self._host is None and self._dia is not None:
             # structural count without assembling CSR (explicit stored
             # zeros of the DIA pack are not "stored entries" of a CSR
@@ -555,20 +606,40 @@ def _dia_diag_row(offsets, vals32: np.ndarray) -> np.ndarray:
     return np.zeros(vals32.shape[1], dtype=vals32.dtype)
 
 
+@functools.lru_cache(maxsize=None)
+def _diag_slice_fn(zero_pos):
+    import jax
+    if zero_pos is None:
+        return jax.jit(lambda v: jnp.zeros((v.shape[1],), v.dtype))
+    return jax.jit(lambda v: v[zero_pos])
+
+
+def _dia_device_diag(offsets, dvals):
+    """Main-diagonal row sliced ON DEVICE (no second host array): through
+    a remote-TPU tunnel every uploaded array pays ~0.1 s latency plus its
+    bytes, so deriving the diagonal from the already-uploaded values is
+    strictly cheaper than shipping it."""
+    offsets = [int(o) for o in offsets]
+    zero_pos = offsets.index(0) if 0 in offsets else None
+    return _diag_slice_fn(zero_pos)(dvals)
+
+
 def _pack_dia_arrays(offsets, vals: np.ndarray, n_cols: int, dtype,
                      device=None) -> DeviceMatrix:
     """DIA DeviceMatrix from host diagonal arrays.
 
-    vals + diag ride ONE ``jax.device_put`` call: through a remote-TPU
-    tunnel each transfer pays ~0.3 s fixed latency, so per-array puts
-    dominated hierarchy upload time."""
+    Only ``vals`` crosses the link; the diagonal row is sliced on device
+    (see :func:`_dia_device_diag`).  The pinned-placement path keeps the
+    explicit two-array put — a device-side slice would land on the
+    default backend, not the pinned device."""
     import jax
     vals32 = vals.astype(dtype, copy=False)
-    diag = _dia_diag_row(offsets, vals32)
     if device is not None:
+        diag = _dia_diag_row(offsets, vals32)
         dvals, ddiag = jax.device_put([vals32, diag], device)
     else:
-        dvals, ddiag = jax.device_put([vals32, diag])
+        dvals = jax.device_put(vals32)
+        ddiag = _dia_device_diag(offsets, dvals)
     return _dia_device_matrix(offsets, dvals, ddiag, n_cols)
 
 
