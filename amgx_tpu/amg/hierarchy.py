@@ -221,8 +221,8 @@ class AMGHierarchy:
                 R_host = sp.csr_matrix(P_host.T)
                 Ac_host = sp.csr_matrix(R_host @ cur.scalar_csr() @ P_host)
                 lvl = ClassicalLevel(cur, i,
-                                     _child_matrix(cur, P_host).device(),
-                                     _child_matrix(cur, R_host).device())
+                                     _child_matrix(cur, P_host),
+                                     _child_matrix(cur, R_host))
                 nxt = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             self.levels.append(lvl)
             self._structure.append(struct)
@@ -446,8 +446,8 @@ class AMGHierarchy:
                     _child_matrix(cur, R_pad).device(), None)
                 return level, Ac, ("classical", (P_host,))
             level = ClassicalLevel(
-                cur, idx, _child_matrix(cur, P_host).device(),
-                _child_matrix(cur, R_host).device(), cf_map)
+                cur, idx, _child_matrix(cur, P_host),
+                _child_matrix(cur, R_host), cf_map)
             return level, _child_matrix(cur, Ac_host), ("classical", (P_host,))
         raise BadConfigurationError(f"unknown AMG algorithm "
                                     f"{self.algorithm!r}")
@@ -623,15 +623,21 @@ class AMGHierarchy:
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
-        from ..core.matrix import batch_upload_dia
+        from ..core.matrix import batch_upload
         from ..utils.thread_manager import ThreadManager
 
-        # ONE device_put for every DIA level's (vals, diag, dinv) — the
-        # per-level upload latency through a remote-TPU tunnel otherwise
-        # dominates hierarchy setup (reference: the hierarchy lives on
-        # device from the start, amg.cu:177-450)
+        # ONE arena upload for every level's pack — operators AND
+        # classical P/R transfers — plus DIA inverted diagonals; the
+        # ~0.1 s-per-array tunnel latency otherwise dominates hierarchy
+        # setup (reference: the hierarchy lives on device from the
+        # start, amg.cu:177-450)
         with cpu_profiler("hierarchy_upload"):
-            batch_upload_dia([lvl.A for lvl in self.levels] + [coarsest])
+            mats = []
+            for lvl in self.levels:
+                mats.append(lvl.A)
+                if hasattr(lvl, "transfer_matrices"):
+                    mats.extend(lvl.transfer_matrices())
+            batch_upload(mats + [coarsest])
 
         def smoother_task(lvl):
             def run():

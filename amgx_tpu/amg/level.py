@@ -42,7 +42,7 @@ class AMGLevel:
     @property
     def Ad(self):
         if self._Ad is None:
-            from jax._src.core import trace_state_clean
+            from ..utils.jaxcompat import trace_state_clean
             v = self.A.device()
             if not trace_state_clean():
                 # under a trace ``A._device`` holds a bound tracer —
@@ -208,19 +208,56 @@ class StructuredLevel(AMGLevel):
 
 
 class ClassicalLevel(AMGLevel):
-    """Explicit P/R transfer (classical or energymin)."""
+    """Explicit P/R transfer (classical or energymin).
+
+    ``P``/``R`` may be host ``Matrix`` handles: their device packs then
+    materialise lazily or in the hierarchy's ONE arena upload
+    (``core.matrix.batch_upload``) — per-level eager packs cost ~0.1 s
+    tunnel latency per array, which dominated classical setup."""
 
     kind = "classical"
 
-    def __init__(self, A: Matrix, level_index: int, P: DeviceMatrix,
-                 R: DeviceMatrix, cf_map: Optional[np.ndarray] = None):
+    def __init__(self, A: Matrix, level_index: int,
+                 P: "Matrix | DeviceMatrix", R: "Matrix | DeviceMatrix",
+                 cf_map: Optional[np.ndarray] = None):
         super().__init__(A, level_index)
-        self.P = P
-        self.R = R
-        self.n_coarse = P.n_cols
+        if isinstance(P, Matrix):
+            self._Pm, self._Pd = P, None
+        else:
+            self._Pm, self._Pd = None, P
+        if isinstance(R, Matrix):
+            self._Rm, self._Rd = R, None
+        else:
+            self._Rm, self._Rd = None, R
+        self.n_coarse = (P.n_block_cols if isinstance(P, Matrix)
+                         else P.n_cols)
         if cf_map is not None:
             # expose the C/F split for CF_JACOBI (cf_jacobi_solver.cu)
             A.cf_map = cf_map
+
+    def transfer_matrices(self):
+        """The host Matrix handles of P/R (for the batched upload)."""
+        return [m for m in (self._Pm, self._Rm) if m is not None]
+
+    @property
+    def P(self) -> DeviceMatrix:
+        if self._Pd is None:
+            from ..utils.jaxcompat import trace_state_clean
+            v = self._Pm.device()
+            if not trace_state_clean():
+                return v     # a tracer must never be cached (see Ad)
+            self._Pd = v
+        return self._Pd
+
+    @property
+    def R(self) -> DeviceMatrix:
+        if self._Rd is None:
+            from ..utils.jaxcompat import trace_state_clean
+            v = self._Rm.device()
+            if not trace_state_clean():
+                return v
+            self._Rd = v
+        return self._Rd
 
     def restrict_residual(self, r):
         return spmv(self.R, r)

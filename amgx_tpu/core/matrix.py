@@ -80,11 +80,42 @@ class DeviceMatrix:
 
     @property
     def dtype(self):
-        return self.vals.dtype
+        # diag always exists; a LEAN windowed pack has vals=None (the
+        # kernel layout win_vals carries the values — shipping both
+        # nearly doubled hierarchy upload bytes)
+        return self.diag.dtype
 
     def astype(self, dtype) -> "DeviceMatrix":
         return dataclasses.replace(
-            self, vals=self.vals.astype(dtype), diag=self.diag.astype(dtype))
+            self,
+            vals=None if self.vals is None else self.vals.astype(dtype),
+            diag=self.diag.astype(dtype),
+            win_vals=(None if self.win_vals is None
+                      else self.win_vals.astype(dtype)))
+
+    def ell_vals_view(self):
+        """Row-major (n, K) ELL values — direct, or reconstructed from
+        the windowed layout by reshape/transpose on a lean pack."""
+        if self.vals is not None:
+            return self.vals
+        K, T = self.ell_width, self.win_tile
+        n_tiles = self.win_vals.size // (T * K)
+        v = self.win_vals.reshape(n_tiles, K, T)
+        return jnp.transpose(v, (0, 2, 1)).reshape(-1, K)[:self.n_rows]
+
+    def ell_cols_view(self):
+        """Row-major (n, K) ELL column indices — direct, or decoded from
+        the window codes on a lean pack (col = block_ids[tile, code>>7]
+        ·128 + (code & 127))."""
+        if self.cols is not None:
+            return self.cols
+        K, T = self.ell_width, self.win_tile
+        n_tiles = self.win_blocks.shape[0]
+        codes = self.win_codes.reshape(n_tiles, K * T)
+        blk = jnp.take_along_axis(self.win_blocks, codes >> 7, axis=1)
+        cols_t = blk * 128 + (codes & 127)
+        return jnp.transpose(cols_t.reshape(n_tiles, K, T),
+                             (0, 2, 1)).reshape(-1, K)[:self.n_rows]
 
 
 def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
@@ -92,7 +123,7 @@ def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
     (offsets list, vals (nd, n)) with A[i, i+d_k] = vals[k, i], or None
     when the matrix has more than ``max_diags`` distinct diagonals.
 
-    THE canonical DIA layout — the device pack (:func:`_try_pack_dia`),
+    THE canonical DIA layout — the device pack (:func:`pack_host_arrays`),
     the structured-AMG Galerkin (amg/pairwise.py, amg/structured.py) and
     the refinement residue pack (solvers/base.py) all share it.
 
@@ -498,10 +529,15 @@ class Matrix:
         return self._device
 
 
-def pack_device(host: sp.spmatrix, block_dim: int, dtype,
-                ell_max_width: int = 2048,
-                dia_max_diags: int = 48) -> DeviceMatrix:
-    """Build the frozen device pack from a scipy CSR/BSR matrix.
+def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
+                     ell_max_width: int = 2048,
+                     dia_max_diags: int = 48,
+                     lean_win: bool = False):
+    """The device pack computed HOST-side: (arrays, meta) with no
+    transfer.  Callers choose the transfer strategy — one ``device_put``
+    (:func:`pack_device`) or a whole-hierarchy arena upload
+    (:func:`batch_upload`): through a remote-TPU tunnel every individual
+    array pays ~0.1 s latency, so hierarchies must ship as blobs.
 
     Format selection: DIA when the matrix is square, scalar, and has few
     distinct diagonals (stencil operators — the reference's headline
@@ -509,9 +545,14 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
     """
     b = int(block_dim)
     if b == 1 and host.shape[0] == host.shape[1]:
-        dia_pack = _try_pack_dia(sp.csr_matrix(host), dtype, dia_max_diags)
-        if dia_pack is not None:
-            return dia_pack
+        csr = sp.csr_matrix(host)
+        if csr.shape[0] and csr.nnz:
+            arrs = dia_arrays(csr, max_diags=dia_max_diags)
+            if arrs is not None:
+                offsets, vals = arrs
+                return ({"vals": vals.astype(dtype, copy=False)},
+                        dict(fmt="dia", offsets=offsets,
+                             n_cols=csr.shape[1]))
     if b == 1:
         csr = sp.csr_matrix(host)
         csr.sort_indices()
@@ -537,40 +578,74 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
     on_diag = indices == for_rows
     diag[for_rows[on_diag]] = vals[on_diag]
 
+    meta = dict(n_rows=n_rows, n_cols=n_cols, block_dim=b)
     if k <= ell_max_width:
         cols = np.zeros((n_rows, k), dtype=np.int32)
         ell_vals = np.zeros((n_rows, k) + block_shape, dtype=dtype)
         cols[for_rows, pos_in_row] = indices
         ell_vals[for_rows, pos_in_row] = vals
+        arrays = {"cols": cols, "vals": ell_vals, "diag": diag}
+        meta.update(fmt="ell", ell_width=k)
         # windowed-ELL metadata for the gather-free Pallas SpMV
-        # (ops/pallas_ell.py); None when some row tile's columns span too
-        # many 128-blocks (kernel falls back to the XLA gather path)
-        win = None
+        # (ops/pallas_ell.py); skipped when some row tile's columns span
+        # too many 128-blocks (kernel falls back to the XLA gather path)
+        # — and on non-TPU backends, where the kernel never runs and the
+        # pack would only burn host time and device memory
         if b == 1 and np.dtype(dtype) == np.float32 and k <= 160:
-            from ..ops.pallas_ell import ell_window_pack, win_vals_pack
-            win = ell_window_pack(cols)
-        import jax as _jax
-        if win is not None:
-            block_ids, codes, tile = win
-            wvals = win_vals_pack(ell_vals, tile)
-            dcols, dvals, ddiag, dblk, dcodes, dwvals = _jax.device_put(
-                [cols, ell_vals, diag, block_ids, codes, wvals])
-            return DeviceMatrix(
-                cols=dcols, vals=dvals, diag=ddiag, row_ids=None,
-                n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="ell",
-                ell_width=k, win_blocks=dblk, win_codes=dcodes,
-                win_vals=dwvals, win_tile=tile)
-        dcols, dvals, ddiag = _jax.device_put([cols, ell_vals, diag])
-        return DeviceMatrix(
-            cols=dcols, vals=dvals,
-            diag=ddiag, row_ids=None,
-            n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="ell", ell_width=k)
+            from ..ops.pallas_ell import (_INTERPRET, ell_window_pack,
+                                          win_vals_pack)
+            import jax as _jax
+            if _jax.default_backend() == "tpu" or _INTERPRET:
+                win = ell_window_pack(cols)
+                if win is not None:
+                    block_ids, codes, tile = win
+                    arrays.update(win_blocks=block_ids, win_codes=codes,
+                                  win_vals=win_vals_pack(ell_vals, tile))
+                    meta.update(win_tile=tile)
+                    if lean_win:
+                        # the windowed layout carries the values and the
+                        # codes carry the columns — shipping cols/vals
+                        # too nearly doubles hierarchy upload bytes
+                        del arrays["cols"], arrays["vals"]
+        return arrays, meta
+    meta.update(fmt="csr", ell_width=0)
+    return ({"cols": indices.astype(np.int32), "vals": vals.astype(dtype),
+             "diag": diag, "row_ids": for_rows.astype(np.int32)}, meta)
+
+
+def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
+    """DeviceMatrix around already-transferred arrays (+``meta`` from
+    :func:`pack_host_arrays`)."""
+    if meta["fmt"] == "dia":
+        dvals = arrays["vals"]
+        ddiag = arrays.get("diag")
+        if ddiag is None:
+            ddiag = _dia_device_diag(meta["offsets"], dvals)
+        return _dia_device_matrix(meta["offsets"], dvals, ddiag,
+                                  meta["n_cols"])
     return DeviceMatrix(
-        cols=jnp.asarray(indices.astype(np.int32)),
-        vals=jnp.asarray(vals.astype(dtype)),
-        diag=jnp.asarray(diag),
-        row_ids=jnp.asarray(for_rows.astype(np.int32)),
-        n_rows=n_rows, n_cols=n_cols, block_dim=b, fmt="csr", ell_width=0)
+        cols=arrays.get("cols"), vals=arrays.get("vals"),
+        diag=arrays["diag"],
+        row_ids=arrays.get("row_ids"),
+        n_rows=meta["n_rows"], n_cols=meta["n_cols"],
+        block_dim=meta["block_dim"], fmt=meta["fmt"],
+        ell_width=meta["ell_width"],
+        win_blocks=arrays.get("win_blocks"),
+        win_codes=arrays.get("win_codes"),
+        win_vals=arrays.get("win_vals"),
+        win_tile=meta.get("win_tile", 0))
+
+
+def pack_device(host: sp.spmatrix, block_dim: int, dtype,
+                ell_max_width: int = 2048,
+                dia_max_diags: int = 48) -> DeviceMatrix:
+    """Host pack + ONE ``device_put`` for all of its arrays."""
+    import jax
+    arrays, meta = pack_host_arrays(host, block_dim, dtype,
+                                    ell_max_width, dia_max_diags)
+    keys = sorted(arrays)
+    devs = jax.device_put([arrays[k] for k in keys])
+    return assemble_device_matrix(dict(zip(keys, devs)), meta)
 
 
 def _dia_attach_matches(csr, dia, samples: int = 256) -> bool:
@@ -655,59 +730,86 @@ def _dia_device_matrix(offsets, dvals, ddiag,
         dia_offsets=tuple(int(o) for o in offsets))
 
 
-def batch_upload_dia(mats) -> None:
-    """Upload the device packs of many DIA-eligible matrices in ONE
-    ``jax.device_put`` round trip (plus their inverted diagonals for the
-    Jacobi-family smoothers).
+def arena_upload(array_dicts, device=None):
+    """Ship many named numpy arrays in ONE ``jax.device_put`` call.
 
-    A remote-attached TPU pays ~0.3 s fixed latency per transfer; an AMG
-    hierarchy uploads 2-3 arrays per level, so per-level puts made the
-    hierarchy upload latency-bound.  Matrices that are not DIA-eligible
-    (distributed, blocked, already packed) are skipped — they take their
-    normal path lazily."""
+    Through the remote-TPU tunnel each device_put CALL pays ~0.1-0.3 s
+    round-trip latency (plus congestion-dependent bandwidth), so a
+    classical AMG hierarchy with ~100 pack arrays must cross in a single
+    call — measured 0.7-2 s batched vs ~13 s as per-matrix calls.
+    (A blob-concat + on-device split was tried and is WORSE here: the
+    axon runtime charges ~0.1 s per executable OUTPUT at load time, so a
+    100-output splitter costs more than the batched put it replaces.)
+    Returns one dict of device arrays per input dict."""
     import jax
+
+    from ..utils.profiler import cpu_profiler
+    items = [(i, k, d[k]) for i, d in enumerate(array_dicts)
+             for k in sorted(d)]
+    nb = sum(a.nbytes for _, _, a in items)
+    with cpu_profiler(f"arena_put_{len(items)}arrs_{nb >> 20}MB"):
+        arrs = [a for _, _, a in items]
+        devs = jax.device_put(arrs) if device is None else \
+            jax.device_put(arrs, device)
+    result = [dict() for _ in array_dicts]
+    for (i, k, _a), d in zip(items, devs):
+        result[i][k] = d
+    return result
+
+
+def batch_upload(mats) -> None:
+    """Build + upload the device packs of many matrices in one
+    ``device_put`` round trip (plus inverted diagonals for the
+    Jacobi-family smoothers of DIA levels).
+
+    Matrices that are distributed or already packed are skipped — they
+    take their normal path lazily; placement-pinned matrices batch in
+    their own per-placement group."""
     jobs = []
+    seen = set()
     for m in mats:
-        if m is None or m._device is not None or m.dist is not None:
+        if m is None or id(m) in seen or m._device is not None or \
+                m.dist is not None:
             continue
-        if m.block_dim != 1 or m.n_block_rows != m.n_block_cols:
-            continue
-        dia = m.dia_cache(48)
-        if dia is None or len(dia[0]) == 0:
-            continue
+        seen.add(id(m))
         dtype = np.dtype(m.device_dtype or m.dtype)
-        offs, vals = dia
-        vals32 = vals.astype(dtype, copy=False)
-        diag = _dia_diag_row(offs, vals32)
-        dinv = np.where(diag != 0, 1.0 /
-                        np.where(diag == 0, 1.0, diag), 0.0).astype(dtype)
-        jobs.append((m, offs, dtype, vals32, diag, dinv))
-    # one put per distinct placement (normally a single group)
+        dia = m.dia_cache(48) if (m.block_dim == 1 and
+                                  m.n_block_rows == m.n_block_cols) \
+            else None
+        if dia is not None and len(dia[0]):
+            offs, vals = dia
+            vals32 = vals.astype(dtype, copy=False)
+            diag = _dia_diag_row(offs, vals32)
+            dinv = np.where(diag != 0, 1.0 /
+                            np.where(diag == 0, 1.0, diag),
+                            0.0).astype(dtype)
+            arrays = {"vals": vals32, "diag": diag, "dinv": dinv}
+            meta = dict(fmt="dia", offsets=offs, n_cols=m.n_block_cols)
+        else:
+            if m.host is None:
+                continue
+            # the dia_cache above already proved non-DIA: don't pay the
+            # O(nnz) diagonal scan a second time
+            arrays, meta = pack_host_arrays(m.host, m.block_dim, dtype,
+                                            dia_max_diags=0,
+                                            lean_win=True)
+        jobs.append((m, dtype, arrays, meta))
     by_placement = {}
     for j in jobs:
         by_placement.setdefault(j[0].placement, []).append(j)
     for placement, group in by_placement.items():
-        flat = [a for j in group for a in j[3:]]
-        dev = jax.device_put(flat, placement) if placement is not None \
-            else jax.device_put(flat)
-        for (m, offs, dtype, *_), dv, dd, di in zip(
-                group, dev[0::3], dev[1::3], dev[2::3]):
-            m._device = _dia_device_matrix(offs, dv, dd)
+        outs = arena_upload([arrays for _, _, arrays, _ in group],
+                            device=placement)
+        for (m, dtype, _, meta), darrs in zip(group, outs):
+            dinv = darrs.pop("dinv", None)
+            m._device = assemble_device_matrix(darrs, meta)
             m._device_dtype = dtype
-            m._dinv_dev = (dtype, di)
+            if dinv is not None:
+                m._dinv_dev = (dtype, dinv)
 
 
-def _try_pack_dia(csr: sp.csr_matrix, dtype, max_diags: int
-                  ) -> Optional[DeviceMatrix]:
-    """Pack as row-aligned diagonals if the offset count is small."""
-    n = csr.shape[0]
-    if n == 0 or csr.nnz == 0:
-        return None
-    arrs = dia_arrays(csr, max_diags=max_diags)
-    if arrs is None:
-        return None
-    offsets, vals = arrs
-    return _pack_dia_arrays(offsets, vals, csr.shape[1], dtype)
+#: historical name (round-2 API) — the batch now covers every pack format
+batch_upload_dia = batch_upload
 
 
 def device_matrix_from_csr_arrays(indptr, indices, data, n_cols=None,

@@ -70,6 +70,20 @@ def spmv(A, x: jax.Array) -> jax.Array:
     return y.reshape(-1)
 
 
+def abs_rowsum(A) -> jax.Array:
+    """Σ_j |A[i, j]| per scalar row, from any pack (pad/explicit zeros
+    contribute 0).  Serves the L1-Jacobi diagonal and Chebyshev
+    Gershgorin bound without host work or extra uploads."""
+    import jax.numpy as jnp
+    if A.fmt == "dia":
+        return jnp.sum(jnp.abs(A.vals), axis=0)
+    if A.fmt == "ell":
+        # ell_vals_view reconstructs row-major values on a lean pack
+        return jnp.sum(jnp.abs(A.ell_vals_view()), axis=1)
+    return jax.ops.segment_sum(jnp.abs(A.vals), A.row_ids,
+                               num_segments=A.n_rows)
+
+
 def spmm(A: DeviceMatrix, X: jax.Array) -> jax.Array:
     """Y = A @ X for a block of vectors X (n, m) — used by eigensolvers."""
     return jax.vmap(lambda v: spmv(A, v), in_axes=1, out_axes=1)(X)

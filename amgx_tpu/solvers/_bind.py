@@ -63,15 +63,23 @@ class DeviceBindings:
             if obj is None or id(obj) in seen:
                 continue
             seen.add(id(obj))
-            if getattr(obj, "_Ad", False) is None:
-                # lazy AMGLevel pack not yet materialised: force it NOW so
-                # it becomes a bound slot — if it materialised after
-                # discovery, a later retrace would read the concrete pack
-                # through the property and bake it in as an XLA constant
-                try:
-                    obj.Ad
-                except Exception:
-                    pass
+            for slot, prop in (("_Ad", "Ad"), ("_Pd", "P"), ("_Rd", "R")):
+                if getattr(obj, slot, False) is None:
+                    # lazy level pack not yet materialised: force it NOW
+                    # so it becomes a bound slot — if it materialised
+                    # after discovery, a later retrace would read the
+                    # concrete pack through the property and bake it in
+                    # as an XLA constant.  A pack failure here is
+                    # tolerable only because the matrix handle's own
+                    # ``_device`` slot still gets bound; log it rather
+                    # than vanish.
+                    try:
+                        getattr(obj, prop)
+                    except Exception as e:      # pragma: no cover
+                        import logging
+                        logging.getLogger("amgx_tpu").warning(
+                            "lazy %s materialisation failed during "
+                            "binding discovery: %s", prop, e)
             for k, v in list(vars(obj).items()):
                 if k.startswith("_solve_fn") or k == "_bindings":
                     continue

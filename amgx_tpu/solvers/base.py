@@ -489,7 +489,15 @@ class Solver:
         """Traced f64 SpMV of the exact host operator (XLA emulates f64 on
         TPU — slower than f32 but bit-honest, which is all the refinement
         residual needs)."""
-        Ad64 = self.Ad.astype(jnp.float64)
+        Ad64 = self.Ad
+        if Ad64.fmt == "ell" and Ad64.vals is None:
+            # lean windowed pack: the f64 path needs the gather-form
+            # arrays — rebuild them as traced views (f64 never takes the
+            # f32-only window kernel)
+            Ad64 = dataclasses.replace(
+                Ad64, vals=Ad64.ell_vals_view(), cols=Ad64.ell_cols_view(),
+                win_blocks=None, win_codes=None, win_vals=None)
+        Ad64 = Ad64.astype(jnp.float64)
         if self._refine_lo is not None:
             Ad64 = dataclasses.replace(
                 Ad64, vals=Ad64.vals + self._refine_lo.astype(jnp.float64))

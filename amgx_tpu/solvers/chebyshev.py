@@ -63,6 +63,9 @@ class ChebyshevSolver(_PrecondMixin, Solver):
         if self.A is not None:
             return max(float(np.abs(b).sum(axis=1).max())
                        for b in self.A.blocks)
+        if self.Ad.block_dim == 1 and self.Ad.fmt in ("dia", "ell", "csr"):
+            from ..ops.spmv import abs_rowsum
+            return float(jnp.max(abs_rowsum(self.Ad)))
         return float(jnp.max(jnp.sum(
             jnp.abs(self.Ad.vals),
             axis=tuple(range(1, self.Ad.vals.ndim)))))

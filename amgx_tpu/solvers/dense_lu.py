@@ -59,17 +59,24 @@ class DenseLUSolver(Solver):
 
 def _densify_device(Ad) -> np.ndarray:
     """Densify a DeviceMatrix on host (coarse levels are tiny)."""
-    vals = np.asarray(Ad.vals)
     b = Ad.block_dim
     n = Ad.n_rows * b
     m = Ad.n_cols * b
-    out = np.zeros((n, m), dtype=vals.dtype)
     if Ad.fmt == "dia":
+        vals = np.asarray(Ad.vals)
+        out = np.zeros((n, m), dtype=vals.dtype)
         for k, o in enumerate(Ad.dia_offsets):
             rows = np.arange(max(0, -o), min(n, n - o))
             out[rows, rows + o] = vals[k, rows]
         return out
-    cols = np.asarray(Ad.cols)
+    if Ad.fmt == "ell":
+        # view methods reconstruct the gather-form arrays on lean packs
+        vals = np.asarray(Ad.ell_vals_view())
+        cols = np.asarray(Ad.ell_cols_view())
+    else:
+        vals = np.asarray(Ad.vals)
+        cols = np.asarray(Ad.cols) if Ad.cols is not None else None
+    out = np.zeros((n, m), dtype=vals.dtype)
     if Ad.fmt == "ell":
         for i in range(Ad.n_rows):
             for k in range(cols.shape[1]):

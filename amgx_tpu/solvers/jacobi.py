@@ -11,6 +11,8 @@ reference's fused kernels do (``block_jacobi_solver.cu:1240-1530``).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -56,12 +58,20 @@ def _apply_dinv(dinv: jax.Array, v: jax.Array) -> jax.Array:
                       v.reshape(-1, b)).reshape(-1)
 
 
+@functools.lru_cache(maxsize=1)
+def _scalar_dinv_fn():
+    return jax.jit(lambda d: jnp.where(
+        d != 0, 1.0 / jnp.where(d == 0, 1.0, d), 0.0))
+
+
 def setup_dinv(slv) -> jax.Array:
     """The inverted (block) diagonal for a smoother's setup phase.
 
-    Host path when the host matrix exists (no readback, no per-shape
-    remote compile); sharded path keeps the sharding; device readback is
-    the last resort (device-only setup)."""
+    Scalar packs invert the pack's own diagonal ON DEVICE (zero
+    transfer — through a remote-TPU tunnel a per-level dinv upload costs
+    ~0.1 s latency each); the sharded path keeps the sharding; block
+    matrices factor on host (guarded batched inverse); device readback
+    is the last resort (device-only block setup)."""
     Ad, A = slv.Ad, slv.A
     if Ad.fmt == "sharded-ell":
         d = Ad.diag
@@ -70,6 +80,9 @@ def setup_dinv(slv) -> jax.Array:
         cached = getattr(A, "_dinv_dev", None)
         if cached is not None and cached[0] == Ad.dtype:
             return cached[1]      # rode the hierarchy's batched upload
+    if Ad.block_dim == 1:
+        return _scalar_dinv_fn()(Ad.diag)
+    if A is not None:
         return _invert_block_diag(host_block_diag(A).astype(Ad.dtype))
     return _invert_block_diag(np.asarray(Ad.diag))
 
@@ -119,6 +132,16 @@ class BlockJacobiSolver(Solver):
         return x
 
 
+@functools.lru_cache(maxsize=1)
+def _l1_dinv_fn():
+    from ..ops.spmv import abs_rowsum
+
+    def fn(Ad):
+        absrow = abs_rowsum(Ad)
+        return 1.0 / jnp.where(absrow == 0, 1.0, absrow)
+
+    return jax.jit(fn)
+
 @register_solver("JACOBI_L1")
 class JacobiL1Solver(Solver):
     """L1-Jacobi: D_l1[i] = |a_ii| + Σ_{j≠i}|a_ij| per scalar row
@@ -128,7 +151,11 @@ class JacobiL1Solver(Solver):
     is_smoother = True
 
     def solver_setup(self):
-        if self.A is not None:
+        if self.Ad.block_dim == 1 and self.Ad.fmt in ("dia", "ell", "csr"):
+            # L1 row sums from the pack ON DEVICE (|diag| + Σ|off-diag| =
+            # Σ|row|): zero transfer, and pad/explicit zeros contribute 0
+            self.dinv = _l1_dinv_fn()(self.Ad)
+        elif self.A is not None:
             csr = self.A.scalar_csr()
             absrow = np.asarray(np.abs(csr).sum(axis=1)).ravel()
             diag = csr.diagonal()
@@ -140,21 +167,9 @@ class JacobiL1Solver(Solver):
             else:
                 self.dinv = jnp.asarray(1.0 / d, dtype=self.Ad.dtype)
         else:
-            # device-only fallback: |diag| scaled row sums from the pack
-            vals = self.Ad.vals
-            if self.Ad.block_dim == 1:
-                if self.Ad.fmt == "dia":
-                    absrow = jnp.sum(jnp.abs(vals), axis=0)
-                elif self.Ad.fmt == "ell":
-                    absrow = jnp.sum(jnp.abs(vals), axis=1)
-                else:
-                    absrow = jax.ops.segment_sum(
-                        jnp.abs(vals), self.Ad.row_ids,
-                        num_segments=self.Ad.n_rows)
-                self.dinv = 1.0 / jnp.where(absrow == 0, 1.0, absrow)
-            else:
-                d = jnp.abs(self.Ad.diag).sum(axis=-1).reshape(-1)
-                self.dinv = 1.0 / jnp.where(d == 0, 1.0, d)
+            # device-only block fallback: |diag|-block row sums
+            d = jnp.abs(self.Ad.diag).sum(axis=-1).reshape(-1)
+            self.dinv = 1.0 / jnp.where(d == 0, 1.0, d)
 
     def solve_iteration(self, b, x, state, iter_idx):
         r = b - spmv(self.Ad, x)

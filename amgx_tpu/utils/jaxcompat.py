@@ -1,0 +1,17 @@
+"""Small shims over JAX private APIs.
+
+``trace_state_clean`` guards the lazy device-pack caches: a value
+produced while a trace is active is a tracer and must never be cached
+past the trace.  The symbol is private (``jax._src.core``); if a JAX
+upgrade moves it, the fallback conservatively reports "tracing", which
+disables caching in the lazy properties — correctness is preserved
+because the Matrix handles cache their packs themselves and the binding
+machinery swaps tracers into those slots.
+"""
+from __future__ import annotations
+
+try:
+    from jax._src.core import trace_state_clean
+except ImportError:      # pragma: no cover - depends on the jax version
+    def trace_state_clean() -> bool:
+        return False
