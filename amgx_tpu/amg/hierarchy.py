@@ -392,11 +392,9 @@ class AMGHierarchy:
                 raise BadConfigurationError(
                     "classical AMG requires block_dim=1 (use AGGREGATION "
                     "for block systems), as in the reference defaults")
-            Asc = cur.scalar_csr()
             strength = create_strength(
                 str(self.cfg.get("strength", self.scope)), self.cfg,
                 self.scope)
-            S = strength.compute(Asc)
             sel_name = str(self.cfg.get("selector", self.scope))
             interp_name = str(self.cfg.get("interpolator", self.scope))
             if self.algorithm == "ENERGYMIN":
@@ -413,6 +411,15 @@ class AMGHierarchy:
                 sel_name = asel
                 interp_name = str(self.cfg.get("aggressive_interpolator",
                                                self.scope))
+            if cur.dist is not None:
+                # per-rank distributed classical setup — never assembles
+                # a global matrix (classical_amg_level.cu:240-340)
+                out = self._coarsen_classical_dist(
+                    cur, idx, strength, sel_name, interp_name)
+                if out is not None:
+                    return out
+            Asc = cur.scalar_csr()
+            S = strength.compute(Asc)
             selector = create_cf_selector(sel_name, self.cfg, self.scope)
             cf_map = selector.select(S)
             nc = int(cf_map.sum())
@@ -425,9 +432,11 @@ class AMGHierarchy:
             Ac_host.sum_duplicates()
             Ac_host.sort_indices()
             if cur.dist is not None:
-                # distributed classical: embed P/R into the padded vector
-                # spaces; transfer matmuls run under GSPMD (correctness
-                # path — the hot per-level SpMV still uses the halo pack)
+                # fallback (non-row-local strength, HMIS/RS, MULTIPASS,
+                # consolidation-small grids): embed P/R into the padded
+                # vector spaces; transfer matmuls run under GSPMD
+                # (correctness path — the hot per-level SpMV still uses
+                # the halo pack)
                 from ..distributed.matrix import embed_padded
                 mesh, axis, _, _ = cur.dist
                 curd = cur.device()
@@ -451,6 +460,74 @@ class AMGHierarchy:
             return level, _child_matrix(cur, Ac_host), ("classical", (P_host,))
         raise BadConfigurationError(f"unknown AMG algorithm "
                                     f"{self.algorithm!r}")
+
+    def _coarsen_classical_dist(self, cur: Matrix, idx: int, strength,
+                                sel_name: str, interp_name: str):
+        """Per-rank distributed classical coarsening
+        (amg/classical/distributed.py): per-rank strength + PMIS with
+        exchanged halo C/F states, per-rank P rows through the ring-2
+        extended blocks, per-rank RAP with owner-summed partials, and
+        sharded rectangular P/R packs.  Returns None when the config
+        needs the global fallback (non-row-local strength, HMIS/RS
+        selectors, MULTIPASS interpolation).
+
+        Reference: ``classical_amg_level.cu:240-340`` +
+        ``distributed_arranger.h:223-231``.
+        """
+        if sel_name != "PMIS" or interp_name not in ("D1", "D2"):
+            return None
+        if getattr(type(strength), "config_name", "") not in ("AHAT",
+                                                              "ALL"):
+            return None
+        from ..distributed.matrix import shard_matrix_from_blocks
+        from ..distributed.partition import build_partition_from_blocks
+        from ..utils.determinism import SESSION_SEED
+        from .classical.distributed import (RankExtended,
+                                            interpolate_distributed,
+                                            pmis_distributed,
+                                            rap_distributed,
+                                            strength_distributed)
+        mesh, axis, _, _ = cur.dist
+        curd = cur.device()
+        offsets = np.asarray(curd.offsets)
+        n_parts = curd.n_parts
+        n = int(offsets[-1])
+        blocks = self._rank_blocks(cur, offsets)
+        part = build_partition_from_blocks(blocks, offsets, n_rings=2)
+        exts = [RankExtended(p, blocks, part) for p in range(n_parts)]
+        seed = 7 if bool(self.cfg.get("determinism_flag")) \
+            else SESSION_SEED
+        S_U = strength_distributed(exts, [strength] * n_parts)
+        cf = pmis_distributed(exts, S_U, n, seed)
+        nc = int(cf.sum())
+        if nc == 0 or nc >= n:
+            return None, None, None
+        coarse_num = np.where(cf > 0, np.cumsum(cf) - 1, -1)
+        c_counts = [int(cf[offsets[p]:offsets[p + 1]].sum())
+                    for p in range(n_parts)]
+        c_off = np.concatenate([[0], np.cumsum(c_counts)])
+        interp = create_interpolator(interp_name, self.cfg, self.scope)
+        P_blocks = interpolate_distributed(exts, interp, cf, coarse_num,
+                                           S_U)
+        dtype = np.dtype(blocks[0].dtype)
+        P_blocks = [sp.csr_matrix(Pb.astype(dtype)) for Pb in P_blocks]
+        c_blocks, r_blocks = rap_distributed(blocks, P_blocks, part,
+                                             c_off)
+        nc_loc = max(int(np.max(np.diff(c_off))), 1)
+        Ac = Matrix()
+        Ac.set_distributed_blocks(c_blocks, c_off, mesh, axis=axis)
+        Ac.dist = (mesh, axis, c_off, nc_loc)
+        Ac.device_dtype = cur.device_dtype
+        Ac.placement = cur.placement
+        ddtype = np.dtype(cur.device_dtype or cur.dtype)
+        Pd = shard_matrix_from_blocks(
+            P_blocks, offsets, mesh, axis=axis, dtype=ddtype,
+            n_loc=curd.n_loc, col_offsets=c_off, n_loc_cols=nc_loc)
+        Rd = shard_matrix_from_blocks(
+            r_blocks, c_off, mesh, axis=axis, dtype=ddtype,
+            n_loc=nc_loc, col_offsets=offsets, n_loc_cols=curd.n_loc)
+        level = ClassicalLevel(cur, idx, Pd, Rd, None)
+        return level, Ac, ("classical-dist", (nc,))
 
     def _coarsen_pairwise(self, cur: Matrix, idx: int,
                           max_diags: int = 48):

@@ -44,7 +44,7 @@ from .partition import Partition, build_partition
                  "win_codes", "win_vals"],
     meta_fields=["n_global", "n_parts", "n_loc", "ell_width", "block_dim",
                  "axis", "dists", "dists2", "offsets", "win_tile",
-                 "mesh"],
+                 "mesh", "n_loc_cols", "col_offsets"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardedMatrix:
@@ -84,14 +84,24 @@ class ShardedMatrix:
     win_tile: int = 0
     #: static (meta) so traced packs keep it — tracers have no .sharding
     mesh: Mesh = None
+    #: rectangular operators (classical P/R): the COLUMN space has its
+    #: own partition — halo exchange runs in that space; None ⇒ square
+    n_loc_cols: Optional[int] = None
+    col_offsets: Optional[tuple] = None
 
     @property
     def n(self) -> int:
-        """Padded global size (P · n_loc)."""
+        """Padded global ROW size (P · n_loc)."""
         return self.n_parts * self.n_loc
 
     n_rows = n
-    n_cols = n
+
+    @property
+    def n_cols(self) -> int:
+        """Padded global COLUMN size."""
+        return self.n_parts * (self.n_loc_cols
+                               if self.n_loc_cols is not None
+                               else self.n_loc)
 
     @property
     def dtype(self):
@@ -169,7 +179,9 @@ def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
 
 def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
                              dtype=None, n_loc: Optional[int] = None,
-                             partition: Optional[Partition] = None
+                             partition: Optional[Partition] = None,
+                             col_offsets=None,
+                             n_loc_cols: Optional[int] = None
                              ) -> ShardedMatrix:
     """Pack per-rank row blocks (global column ids) into a ShardedMatrix.
 
@@ -179,23 +191,34 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
     (``distributed_manager.h:1815``): build B2L maps from per-rank data
     (``distributed_arranger.h:85-140``), renumber columns to
     [local | halo] slots, pad shards to equal size with identity rows.
+
+    ``col_offsets``/``n_loc_cols``: rectangular operators (classical P/R)
+    whose column space is partitioned differently — halo maps then live
+    in the column space, padding rows are zero rows, and the diagonal is
+    meaningless (zeros).
     """
     from .partition import build_partition_from_blocks
     blocks = [sp.csr_matrix(b) for b in blocks]
     offsets = np.asarray(offsets)
+    rect = col_offsets is not None
     dtype = np.dtype(dtype or blocks[0].dtype)
     mesh = _auto_mesh(mesh)
     n_parts = mesh.shape[axis]
     if len(blocks) != n_parts:
         raise BadParametersError(
             f"{len(blocks)} row blocks for a {n_parts}-way mesh axis")
-    part = partition or build_partition_from_blocks(blocks, offsets,
-                                                    n_rings=2)
-    if len(part.rings) < 2:
-        raise BadParametersError("shard_matrix requires a 2-ring partition")
+    part = partition or build_partition_from_blocks(
+        blocks, offsets, n_rings=1 if rect else 2,
+        col_offsets=col_offsets)
     if n_loc is not None and n_loc > part.n_loc:
         part = dataclasses.replace(part, n_loc=n_loc)
     n_loc = part.n_loc
+    if rect:
+        col_offsets = np.asarray(col_offsets)
+        nlc = n_loc_cols or int(np.max(np.diff(col_offsets)))
+    else:
+        col_offsets = part.offsets
+        nlc = n_loc
     K = max((int(np.diff(b.indptr).max()) if b.nnz else 1
              for b in blocks), default=1)
 
@@ -204,31 +227,34 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
     diag = np.zeros((n_parts, n_loc), dtype=dtype)
     for p in range(n_parts):
         lo, hi = part.offsets[p], part.offsets[p + 1]
+        clo, chi = col_offsets[p], col_offsets[p + 1]
         nl = hi - lo
         sub = blocks[p]
         sub.sort_indices()
         ext = part.halo_global[p]
         gcols = sub.indices.astype(np.int64)
-        local = (gcols >= lo) & (gcols < hi)
-        lcols = np.where(local, gcols - lo, 0)
+        local = (gcols >= clo) & (gcols < chi)
+        lcols = np.where(local, gcols - clo, 0)
         if len(ext):
             halo_slot = np.searchsorted(ext, gcols)
             halo_slot = np.minimum(halo_slot, len(ext) - 1)
-            lcols = np.where(local, lcols, n_loc + halo_slot)
+            lcols = np.where(local, lcols, nlc + halo_slot)
         deg = np.diff(sub.indptr)
         rr = np.repeat(np.arange(nl), deg)
         pos = np.arange(len(gcols)) - np.repeat(sub.indptr[:-1], deg)
         cols[p, rr, pos] = lcols
         vals[p, rr, pos] = sub.data
-        on_diag = gcols == rr + lo
-        # add (not assign): duplicate diagonal entries are legal CSR
-        # input and the ELL pack sums them too
-        np.add.at(diag[p], rr[on_diag], sub.data[on_diag])
-        # identity padding rows
-        r = np.arange(nl, n_loc)
-        cols[p, r, 0] = r
-        vals[p, r, 0] = 1.0
-        diag[p, r] = 1.0
+        if not rect:
+            on_diag = gcols == rr + lo
+            # add (not assign): duplicate diagonal entries are legal CSR
+            # input and the ELL pack sums them too
+            np.add.at(diag[p], rr[on_diag], sub.data[on_diag])
+            # identity padding rows (zero rows in rectangular packs: a
+            # padded output entry must stay exactly zero)
+            r = np.arange(nl, n_loc)
+            cols[p, r, 0] = r
+            vals[p, r, 0] = 1.0
+            diag[p, r] = 1.0
 
     # per-shard windowed-ELL pack for the TPU interior SpMV (columns
     # index the [local | halo] extended space — rectangular is fine);
@@ -259,7 +285,16 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
     spec3 = NamedSharding(mesh, P(axis, None, None))
     spec2 = NamedSharding(mesh, P(axis, None))
     spec1 = NamedSharding(mesh, P(axis))
-    r2 = part.rings[1]
+    if len(part.rings) > 1:
+        r2 = part.rings[1]
+    else:                     # rectangular packs carry no ring 2
+        from .partition import Ring
+        r2 = Ring(dists=(1,),
+                  send_idx=np.zeros((n_parts, 1), np.int32),
+                  send_count=np.zeros(n_parts, np.int32),
+                  halo_src=np.zeros((n_parts, 1), np.int32),
+                  halo_count=np.zeros(n_parts, np.int32),
+                  halo_global=[np.zeros(0, np.int64)] * n_parts)
     return ShardedMatrix(
         cols=jax.device_put(cols, spec3),
         vals=jax.device_put(vals, spec3),
@@ -279,7 +314,9 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
         ell_width=K, block_dim=1, axis=axis,
         dists=part.dists, dists2=r2.dists,
-        offsets=tuple(int(o) for o in part.offsets), mesh=mesh)
+        offsets=tuple(int(o) for o in part.offsets), mesh=mesh,
+        n_loc_cols=nlc if rect else None,
+        col_offsets=tuple(int(o) for o in col_offsets) if rect else None)
 
 
 # --------------------------------------------------------------------------
@@ -373,7 +410,8 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     def local(cols, vals, send_idx, halo_src, bnd_rows, wb, wc, wv, xl):
         cols, vals = cols[0], vals[0]
         send_idx, halo_src, bnd = send_idx[0], halo_src[0], bnd_rows[0]
-        n_loc = xl.shape[0]
+        n_loc_r = cols.shape[0]       # output (row) shard size
+        n_loc_c = xl.shape[0]         # input (column) shard size
         H = halo_src.shape[0]
         buf = xl[send_idx]                                  # B2L gather
         got = _exchange(buf, A.dists, axis, n_parts)
@@ -383,14 +421,14 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
         y0 = interior(cols, vals, xfull0, wb[0], wc[0], wv[0])
         # boundary rows get a small gathered correction scattered back
         # through a trash slot
-        rows = jnp.minimum(bnd, n_loc - 1)
+        rows = jnp.minimum(bnd, n_loc_r - 1)
         cb = cols[rows]                                     # (Bd, K)
         vb = vals[rows]
-        hb = jnp.where(cb >= n_loc,
-                       vb * hvals[jnp.clip(cb - n_loc, 0, H - 1)], 0.0)
+        hb = jnp.where(cb >= n_loc_c,
+                       vb * hvals[jnp.clip(cb - n_loc_c, 0, H - 1)], 0.0)
         corr = jnp.sum(hb, axis=1)                          # (Bd,)
-        yext = jnp.zeros((n_loc + 1,), xl.dtype).at[bnd].add(corr)
-        return y0 + yext[:n_loc]
+        yext = jnp.zeros((n_loc_r + 1,), xl.dtype).at[bnd].add(corr)
+        return y0 + yext[:n_loc_r]
 
     # the win arrays always ride the shard_map signature (dummy scalars
     # when absent) so both paths share one body

@@ -209,7 +209,9 @@ def build_partition(A: sp.csr_matrix, n_parts: int,
 
 def build_partition_from_blocks(blocks: List[sp.csr_matrix],
                                 offsets: np.ndarray,
-                                n_rings: int = 2) -> Partition:
+                                n_rings: int = 2,
+                                col_offsets: Optional[np.ndarray] = None
+                                ) -> Partition:
     """Build all halo maps from per-rank row blocks (global column ids) —
     the scalable setup contract: no step touches more than one rank's
     block plus its halo rows.
@@ -219,29 +221,42 @@ def build_partition_from_blocks(blocks: List[sp.csr_matrix],
     the ring-2 extension; rows keep their order — padding replaces
     interior-first renumbering because SPMD shards must be equal-sized,
     and the boundary set is carried as an explicit row list instead.
+
+    ``col_offsets``: the COLUMN-space partition when it differs from the
+    row partition — rectangular operators (classical AMG P/R transfers)
+    exchange halos in their column space (reference: the distributed
+    P/restriction views, ``classical_amg_level.cu:240-340``).  Ring 2 is
+    row-space machinery and requires a square partition.
     """
     offsets = np.asarray(offsets)
     n_parts = len(blocks)
-    n = int(offsets[-1])
+    rect = col_offsets is not None
+    col_offsets = offsets if col_offsets is None else \
+        np.asarray(col_offsets)
+    n = int(col_offsets[-1])          # column-space extent (halo space)
     n_loc = int(np.max(np.diff(offsets)))
+    if rect and n_rings >= 2:
+        raise BadParametersError(
+            "ring-2 maps are defined for square partitions only")
 
-    # which rank owns each global row
+    # which rank owns each global COLUMN
     owner = np.zeros(n, dtype=np.int32)
     for p in range(n_parts):
-        owner[offsets[p]:offsets[p + 1]] = p
+        owner[col_offsets[p]:col_offsets[p + 1]] = p
 
     halo1: List[np.ndarray] = []
     neighbors: List[np.ndarray] = []
     bnd_lists: List[np.ndarray] = []
     for p in range(n_parts):
-        lo, hi = offsets[p], offsets[p + 1]
+        lo, hi = col_offsets[p], col_offsets[p + 1]
+        nrows = offsets[p + 1] - offsets[p]
         sub = blocks[p]
         cols = sub.indices
         ext_mask = (cols < lo) | (cols >= hi)
         ext = np.unique(cols[ext_mask])
         halo1.append(ext)
         neighbors.append(np.unique(owner[ext]))
-        rows = np.repeat(np.arange(hi - lo), np.diff(sub.indptr))
+        rows = np.repeat(np.arange(nrows), np.diff(sub.indptr))
         bnd_lists.append(np.unique(rows[ext_mask]))
 
     Bd = max(max((len(b) for b in bnd_lists), default=0), 1)
@@ -251,7 +266,7 @@ def build_partition_from_blocks(blocks: List[sp.csr_matrix],
         bnd_rows[p, :len(bl)] = bl
         bnd_count[p] = len(bl)
 
-    rings = [_build_ring(halo1, owner, offsets, n_parts)]
+    rings = [_build_ring(halo1, owner, col_offsets, n_parts)]
     if n_rings >= 2:
         halo2: List[np.ndarray] = []
         for p in range(n_parts):
@@ -274,8 +289,8 @@ def build_partition_from_blocks(blocks: List[sp.csr_matrix],
         rings.append(_build_ring(halo2, owner, offsets, n_parts))
 
     return Partition(
-        n_global=n, n_parts=n_parts, n_loc=n_loc, offsets=offsets,
-        rings=rings, neighbors=neighbors,
+        n_global=int(offsets[-1]), n_parts=n_parts, n_loc=n_loc,
+        offsets=offsets, rings=rings, neighbors=neighbors,
         bnd_rows=bnd_rows, bnd_count=bnd_count)
 
 

@@ -80,6 +80,10 @@ def abs_rowsum(A) -> jax.Array:
     if A.fmt == "ell":
         # ell_vals_view reconstructs row-major values on a lean pack
         return jnp.sum(jnp.abs(A.ell_vals_view()), axis=1)
+    if A.fmt == "sharded-ell":
+        # (P, n_loc, K) → flat sharded row sums (halo entries belong to
+        # the row, padding rows sum to their identity 1)
+        return jnp.sum(jnp.abs(A.vals), axis=2).reshape(-1)
     return jax.ops.segment_sum(jnp.abs(A.vals), A.row_ids,
                                num_segments=A.n_rows)
 

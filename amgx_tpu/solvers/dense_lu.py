@@ -43,8 +43,9 @@ class DenseLUSolver(Solver):
         # to CPU — fp64 LU must not run on the TPU)
         dense_dev = jnp.asarray(dense)
         try:
+            # diag always exists (lean windowed packs carry vals=None)
             dense_dev = jax.device_put(dense, list(
-                self.Ad.vals.devices())[0])
+                self.Ad.diag.devices())[0])
         except Exception:
             pass
         self._lu, self._piv = jax.scipy.linalg.lu_factor(dense_dev)

@@ -151,9 +151,12 @@ class JacobiL1Solver(Solver):
     is_smoother = True
 
     def solver_setup(self):
-        if self.Ad.block_dim == 1 and self.Ad.fmt in ("dia", "ell", "csr"):
+        if self.Ad.block_dim == 1 and self.Ad.fmt in (
+                "dia", "ell", "csr", "sharded-ell"):
             # L1 row sums from the pack ON DEVICE (|diag| + Σ|off-diag| =
-            # Σ|row|): zero transfer, and pad/explicit zeros contribute 0
+            # Σ|row|): zero transfer, works with or without a host
+            # matrix (blocks-mode distributed levels included), and
+            # pad/explicit zeros contribute 0
             self.dinv = _l1_dinv_fn()(self.Ad)
         elif self.A is not None:
             csr = self.A.scalar_csr()

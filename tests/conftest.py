@@ -13,6 +13,12 @@ import os
 
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                            " --xla_force_host_platform_device_count=8")
+# CPU-tier runs get their own persistent compile cache: entries compiled
+# by the TPU session's CPU client carry different detected machine
+# features and spam AOT-load warnings when reused here
+os.environ.setdefault(
+    "AMGX_TPU_COMPILE_CACHE",
+    os.path.expanduser("~/.cache/amgx_tpu_xla_cpu"))
 
 import jax
 import numpy as np
