@@ -69,7 +69,10 @@ def dia_to_scipy(offsets, vals: np.ndarray, n: int,
     m = int(n_cols) if n_cols is not None else n
     if nd == 0:
         return sp.csr_matrix((n, m), dtype=vals.dtype)
-    idx_t = np.int32 if max(n, m) < 2**31 - 1 else np.int64
+    # cols = rows + offs spans [-(n-1), m-1]: the COMBINED range decides
+    # the dtype (max(n, m) alone can wrap near 2^31 and silently drop
+    # wrapped-negative entries through the cols >= 0 mask)
+    idx_t = np.int32 if (n + m - 1) < 2**31 else np.int64
     offs = np.asarray(offsets, dtype=idx_t)
     rows = np.arange(n, dtype=idx_t)
     cols = rows[:, None] + offs[None, :]              # (n, nd)

@@ -33,10 +33,13 @@ class ColorSlab:
         self.vals = vals        # (nc, K[, b, b])
 
 
-def build_color_slabs(csr, colors, num_colors, dtype):
+def build_color_slabs(csr, colors, num_colors, dtype, device=True):
     """Per-color packed ELL slabs from a scalar CSR matrix
-    (multicolor_dilu_solver.cu per-color kernel data, TPU-packed)."""
+    (multicolor_dilu_solver.cu per-color kernel data, TPU-packed);
+    ``device=False`` keeps host arrays (the distributed packer stacks
+    and re-shards them itself)."""
     from ..core.matrix import ell_layout
+    wrap = jnp.asarray if device else (lambda x: x)
     slabs = []
     for c in range(num_colors):
         rows = np.where(colors == c)[0]
@@ -47,8 +50,8 @@ def build_color_slabs(csr, colors, num_colors, dtype):
         vals = np.zeros((len(rows), k), dtype=dtype)
         cols[for_rows, pos] = sub.indices
         vals[for_rows, pos] = sub.data
-        slabs.append(ColorSlab(jnp.asarray(rows.astype(np.int32)),
-                               jnp.asarray(cols), jnp.asarray(vals)))
+        slabs.append(ColorSlab(wrap(rows.astype(np.int32)),
+                               wrap(cols), wrap(vals)))
     return slabs
 
 
@@ -79,7 +82,8 @@ class _ColoredSmootherBase(Solver):
     """Shared setup: coloring + per-color packed slabs (or masks for the
     sharded fallback) + block-diag inverse."""
 
-    def _setup_colors(self, build_slabs: bool = True):
+    def _setup_colors(self, build_slabs: bool = True,
+                      dist_slabs: bool = True):
         if self.A is not None:
             coloring = color_matrix(self.A, self.cfg, self.scope)
             colors = coloring.colors
@@ -91,6 +95,7 @@ class _ColoredSmootherBase(Solver):
         b = self.Ad.block_dim
         self.color_slabs = None
         self.color_masks = None
+        self.dist_slab_rows = None
         if build_slabs and self.Ad.fmt != "sharded-ell" \
                 and self.A is not None:
             if b == 1:
@@ -104,8 +109,18 @@ class _ColoredSmootherBase(Solver):
                     sp.bsr_matrix(self.A.host, blocksize=(b, b))
                 self.color_slabs = build_color_slabs_block(
                     bsr, colors, self.num_colors, self.Ad.dtype, b)
+        elif build_slabs and dist_slabs \
+                and self.Ad.fmt == "sharded-ell" and b == 1 \
+                and self.A is not None:
+            # distributed per-color slabs: the shard pack's columns are
+            # already in [local | halo] coordinates, so each color's
+            # slab is a row-selection of the shard ELL; the sweep pays
+            # ONE halo exchange and O(nnz_shard) per pass (reference
+            # per-color kernels, multicolor_dilu_solver.cu) instead of
+            # the masked O(num_colors·nnz) with per-color exchanges
+            self.dist_slab_rows = self._stack_dist_color_rows(colors)
         else:
-            # sharded (or device-only) fallback: masked full-width sweeps
+            # device-only (or block-sharded) fallback: masked full-width
             masks = []
             for c in range(self.num_colors):
                 m = colors == c
@@ -119,6 +134,54 @@ class _ColoredSmootherBase(Solver):
                     masks.append(jnp.asarray(m))
             self.color_masks = masks
         self.dinv = setup_dinv(self)
+
+    def _stack_dist_color_rows(self, colors):
+        """(P, Rc) local row ids per color, padded with the trash id
+        ``n_loc`` (the sweep clamps for gathering and scatters pads into
+        a trash slot)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        Ad = self.Ad
+        offs = np.asarray(Ad.offsets)
+        n_parts, n_loc = Ad.n_parts, Ad.n_loc
+        out = []
+        for c in range(self.num_colors):
+            per_rank = [np.flatnonzero(colors[offs[p]:offs[p + 1]] == c)
+                        for p in range(n_parts)]
+            Rc = max(max(len(r) for r in per_rank), 1)
+            rows = np.full((n_parts, Rc), n_loc, dtype=np.int32)
+            for p, r in enumerate(per_rank):
+                rows[p, :len(r)] = r
+            out.append(jax.device_put(
+                rows, NamedSharding(Ad.mesh, P(Ad.axis, None))))
+        return out
+
+
+def _structurally_symmetric(A) -> bool:
+    """Pattern symmetry of a host Matrix (global or per-rank blocks);
+    True when unknown (no host data) — the caller only warns."""
+    import scipy.sparse as sp
+    if A is None or (A.host is None and A.blocks is None):
+        return True          # no host data: unknown — don't warn
+    if A.blocks is None:
+        csr = sp.csr_matrix(A.host)
+        pat = sp.csr_matrix(
+            (np.ones(csr.nnz, np.int8), csr.indices, csr.indptr),
+            shape=csr.shape)
+        return (pat != pat.T).nnz == 0
+    # blocks mode: compare the sorted (i, j) and (j, i) key sets from
+    # per-rank COO indices (index arrays only — no global matrix)
+    n = int(A.block_offsets[-1])
+    keys, rkeys = [], []
+    for p, b in enumerate(A.blocks):
+        coo = b.tocoo()
+        rows = coo.row.astype(np.int64) + int(A.block_offsets[p])
+        cols = coo.col.astype(np.int64)
+        keys.append(rows * n + cols)
+        rkeys.append(cols * n + rows)
+    return bool(np.array_equal(np.sort(np.concatenate(keys)),
+                               np.sort(np.concatenate(rkeys))))
 
 
 def _abs_row_sums_and_diag(A):
@@ -160,8 +223,10 @@ class MulticolorGSSolver(_ColoredSmootherBase):
                 self.dinv = jnp.asarray(vec)
 
     def _color_sweep(self, b, x, order):
+        if getattr(self, "dist_slab_rows", None) is not None:
+            return self._dist_color_sweep(b, x, order)
         if self.color_slabs is None:
-            # masked fallback (sharded / device-only packs)
+            # masked fallback (device-only packs)
             for c in order:
                 r = b - spmv(self.Ad, x)
                 dx = self.relaxation_factor * _apply_dinv(self.dinv, r)
@@ -188,6 +253,52 @@ class MulticolorGSSolver(_ColoredSmootherBase):
                                         r_c)
             x = x.reshape(-1, bd).at[s.rows].add(dx).reshape(-1)
         return x
+
+    def _dist_color_sweep(self, b, x, order):
+        """Distributed color-ordered sweep: ONE halo exchange at sweep
+        start (halo values frozen, local updates visible — the
+        reference's exchange-once-then-per-color-kernels pattern,
+        multicolor_dilu_solver.cu:4167-4209), O(nnz_shard) total."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed.matrix import _exchange
+        A = self.Ad
+        axis, n_parts, n_loc = A.axis, A.n_parts, A.n_loc
+        relax = self.relaxation_factor
+        order = list(order)
+
+        def local(cols, vals, send_idx, halo_src, slab_rows, dinv, bl,
+                  xl):
+            cols, vals = cols[0], vals[0]
+            send_idx, halo_src = send_idx[0], halo_src[0]
+            H = halo_src.shape[0]
+            buf = xl[send_idx]
+            got = _exchange(buf, A.dists, axis, n_parts)
+            hvals = got[halo_src]
+            # [local | frozen halo | trash]
+            xe = jnp.concatenate([xl, hvals,
+                                  jnp.zeros((1,), xl.dtype)])
+            for c in order:
+                rows = slab_rows[c][0]
+                rsafe = jnp.minimum(rows, n_loc - 1)
+                cc = cols[rsafe]                  # (Rc, K)
+                vv = vals[rsafe]
+                r_c = bl[rsafe] - jnp.sum(vv * xe[cc], axis=1)
+                upd = relax * dinv[rsafe] * r_c
+                wr = jnp.where(rows >= n_loc, n_loc + H, rows)
+                xe = xe.at[wr].add(upd)
+            return xe[:n_loc]
+
+        spec2 = P(axis, None)
+        return jax.shard_map(
+            local, mesh=A.mesh,
+            in_specs=(P(axis, None, None), P(axis, None, None),
+                      spec2, spec2, [spec2] * len(self.dist_slab_rows),
+                      P(axis), P(axis), P(axis)),
+            out_specs=P(axis), check_vma=False,
+        )(A.cols, A.vals, A.send_idx, A.halo_src, self.dist_slab_rows,
+          self.dinv, b, x)
 
     def solve_iteration(self, b, x, state, iter_idx):
         x = self._color_sweep(b, x, range(self.num_colors))
@@ -240,7 +351,9 @@ class KaczmarzSolver(_ColoredSmootherBase):
             coloring = algo.color(G)
             self.A.coloring = coloring
         # slab projections are scalar-row based; block packs use masks
-        self._setup_colors(build_slabs=(self.Ad.block_dim == 1))
+        # Kaczmarz's scatter projection keeps the masked sharded path
+        self._setup_colors(build_slabs=(self.Ad.block_dim == 1),
+                           dist_slabs=False)
         # row squared norms + explicit transpose pack for the projections
         if self.A is not None:
             if self.A.host is None and self.A.blocks is not None:
@@ -256,7 +369,18 @@ class KaczmarzSolver(_ColoredSmootherBase):
             if self.Ad.fmt == "sharded-ell":
                 from ..distributed.matrix import shard_vector
                 self.rowinv = shard_vector(self.Ad, vec)
-                self.AdT = self.Ad  # structurally symmetric assumption
+                # distributed transpose pack not built yet: reuse A,
+                # exact only under structural symmetry — WARN loudly
+                # when that assumption is false (the projection then
+                # uses wrong couplings; kaczmarz_solver.cu builds Aᵀ)
+                self.AdT = self.Ad
+                if not _structurally_symmetric(self.A):
+                    import logging
+                    logging.getLogger("amgx_tpu").warning(
+                        "distributed KACZMARZ substitutes A for A^T but "
+                        "this matrix is NOT structurally symmetric — "
+                        "the row projections use wrong couplings and "
+                        "convergence will degrade")
             else:
                 self.rowinv = jnp.asarray(vec)
                 from ..core.matrix import Matrix as _M

@@ -33,6 +33,8 @@ def _tpu_tier(config) -> bool:
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: runs on the real TPU chip (pytest -m tpu)")
+    config.addinivalue_line(
+        "markers", "slow: nightly tier (pytest -m slow)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.
@@ -43,6 +45,18 @@ def pytest_configure(config):
 def pytest_collection_modifyitems(config, items):
     if _tpu_tier(config):
         return
+    # nightly tier: tests marked `slow` (the long tail of the 66
+    # config-solve runs and most example subprocesses) only run when
+    # selected explicitly — the default tier must stay fast enough to
+    # run on every change (reference analog: mode-keyed test scheduling,
+    # testframework.h:56-120).  `pytest -m slow` runs the nightly tier;
+    # `pytest -m "slow or not slow"` runs everything.
+    if not (config.getoption("-m") or "").strip():
+        skip_slow = pytest.mark.skip(
+            reason="nightly tier (run with: pytest -m slow)")
+        for item in items:
+            if "slow" in item.keywords:
+                item.add_marker(skip_slow)
     skip = pytest.mark.skip(reason="TPU tier (run with: pytest -m tpu)")
     for item in items:
         if "tpu" in item.keywords:

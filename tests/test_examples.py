@@ -11,17 +11,21 @@ import pytest
 
 from amgx_tpu.io import poisson7pt, write_matrix_market
 
+#: each entry is (script, args, fast?) — the default tier keeps one
+#: driver per flow family (C-API solve, MPI agg flow, new multi-rank
+#:  driver, IO convert); the rest are the nightly tier (pytest -m slow)
 EXAMPLES = [
-    ("amgx_capi.py", ["-m", "{mtx}", "-c", "{cfg}"]),
-    ("amgx_mpi_capi.py", ["-m", "{mtx}", "-p", "4"]),
-    ("amgx_mpi_capi_agg.py", ["-m", "{mtx}", "-p", "4"]),
-    ("amgx_mpi_capi_cla.py", ["-m", "{mtx}", "-p", "4"]),
-    ("eigensolver.py", ["-m", "{mtx}"]),
-    ("amgx_spmv_test.py", ["-m", "{mtx}", "-r", "3"]),
-    ("convert.py", ["{mtx}", "{out}"]),
-    ("amgx_capi_multi.py", ["-m", "{mtx}", "-t", "2"]),
-    ("amgx_mpi_poisson5pt.py", ["-p", "24", "24", "2", "2"]),
-    ("eigensolver_mpi.py", ["-m", "{mtx}", "-p", "4"]),
+    ("amgx_capi.py", ["-m", "{mtx}", "-c", "{cfg}"], True),
+    ("amgx_mpi_capi.py", ["-m", "{mtx}", "-p", "4"], False),
+    ("amgx_mpi_capi_agg.py", ["-m", "{mtx}", "-p", "4"], True),
+    ("amgx_mpi_capi_cla.py", ["-m", "{mtx}", "-p", "4"], False),
+    ("eigensolver.py", ["-m", "{mtx}"], False),
+    ("amgx_spmv_test.py", ["-m", "{mtx}", "-r", "3"], False),
+    ("convert.py", ["{mtx}", "{out}"], True),
+    ("amgx_capi_multi.py", ["-m", "{mtx}", "-t", "2"], False),
+    ("amgx_mpi_capi_multi.py", ["-m", "{mtx}", "-p", "7"], True),
+    ("amgx_mpi_poisson5pt.py", ["-p", "24", "24", "2", "2"], False),
+    ("eigensolver_mpi.py", ["-m", "{mtx}", "-p", "4"], False),
 ]
 
 
@@ -39,8 +43,11 @@ def system_file(tmp_path_factory):
     return {"mtx": path, "cfg": cfg, "out": str(d / "out.bin")}
 
 
-@pytest.mark.parametrize("script,args", EXAMPLES,
-                         ids=[e[0] for e in EXAMPLES])
+@pytest.mark.parametrize(
+    "script,args",
+    [pytest.param(e[0], e[1], id=e[0],
+                  marks=() if e[2] else (pytest.mark.slow,))
+     for e in EXAMPLES])
 def test_example_runs(script, args, system_file):
     argv = [a.format(**system_file) for a in args]
     code = (
