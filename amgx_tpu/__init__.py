@@ -73,7 +73,10 @@ def create_solver(config, mode: str = "dDDI") -> Solver:
     """Convenience: build the outer solver described by a config
     (JSON dict/string/path or AMGConfig)."""
     cfg = config if isinstance(config, AMGConfig) else AMGConfig(config)
-    return SolverFactory.allocate(cfg, "default", "solver")
+    slv = SolverFactory.allocate(cfg, "default", "solver")
+    #: the OUTERMOST solver owns solve-boundary transforms (RCM reorder)
+    slv._toplevel = True
+    return slv
 
 
 __all__ = [

@@ -94,6 +94,7 @@ class SolverHandle:
         self.mode = parse_mode(mode)
         self.cfg = cfg.cfg
         self.solver = SolverFactory.allocate(self.cfg, "default", "solver")
+        self.solver._toplevel = True    # owns solve-boundary transforms
         self.last_result = None
 
 

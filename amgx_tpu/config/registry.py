@@ -261,6 +261,11 @@ def register_default_parameters():
     R("convergence_analysis", int, 0)
     R("scaling", str, "NONE", "",
       ("NONE", "BINORMALIZATION", "NBINORMALIZATION", "DIAGONAL_SYMMETRIC"))
+    # setup-time bandwidth-reduction reordering (reference analog: the
+    # setup renumbering of matrix.cu:760-813): AUTO rescues matrices
+    # that would otherwise fall off the windowed-kernel budget onto the
+    # TPU gather cliff; RCM forces it; NONE disables
+    R("matrix_reorder", str, "AUTO", "", ("NONE", "RCM", "AUTO"))
     # --- eigensolver params (eigensolvers/src/eigensolvers.cu:44-54)
     R("eig_solver", str, "POWER_ITERATION")
     R("eig_max_iters", int, 100)

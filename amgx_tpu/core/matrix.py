@@ -208,10 +208,10 @@ class Matrix:
                          n_loc=None):
         """Declare this matrix row-distributed over a device mesh
         (the AMGX_matrix_upload_distributed analog: the partition comes
-        from explicit offsets or an equal split)."""
-        if self.block_dim != 1:
-            raise BadParametersError(
-                "distributed matrices currently require block_dim=1")
+        from explicit offsets or an equal split).  Block matrices
+        distribute block-row-wise with b×b values, as the reference's
+        uniform block-CSR distribution (``matrix.h:87-220``); offsets
+        are BLOCK-row offsets."""
         self.dist = (mesh, axis, offsets, n_loc)
         self._device = None
         return self
@@ -501,11 +501,16 @@ class Matrix:
                 self._device = shard_matrix_from_blocks(
                     self.blocks, self.block_offsets, mesh, axis=axis,
                     dtype=dtype, n_loc=n_loc)
-            else:
+            elif self.block_dim == 1:
                 from ..distributed.matrix import shard_matrix
                 self._device = shard_matrix(self.scalar_csr(), mesh,
                                             axis=axis, dtype=dtype,
                                             offsets=offsets, n_loc=n_loc)
+            else:
+                from ..distributed.matrix import shard_block_matrix
+                self._device = shard_block_matrix(
+                    self.host, self.block_dim, mesh, axis=axis,
+                    dtype=dtype, offsets=offsets, n_loc=n_loc)
         else:
             dia = self.dia_cache(48) if self.block_dim == 1 else None
             if dia is not None and (len(dia[0]) == 0 or

@@ -91,10 +91,13 @@ class ShardedMatrix:
 
     @property
     def n(self) -> int:
-        """Padded global ROW size (P · n_loc)."""
-        return self.n_parts * self.n_loc
+        """Padded global scalar size (P · n_loc · b) — vector length."""
+        return self.n_parts * self.n_loc * self.block_dim
 
-    n_rows = n
+    @property
+    def n_rows(self) -> int:
+        """Padded global (block-)row count."""
+        return self.n_parts * self.n_loc
 
     @property
     def n_cols(self) -> int:
@@ -319,6 +322,90 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         col_offsets=tuple(int(o) for o in col_offsets) if rect else None)
 
 
+def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
+                       dtype=None, offsets=None,
+                       n_loc: Optional[int] = None) -> ShardedMatrix:
+    """Pack a BLOCK (b×b) matrix into a ShardedMatrix: vals
+    (P, n_loc, K, b, b), cols over the [local | halo] BLOCK space, flat
+    (P·n_loc·b) vectors — the reference's uniform block-CSR distribution
+    (``matrix.h:87-220``).  The halo machinery runs unchanged on the
+    BLOCK graph (an index CSR whose data point into the bsr blocks)."""
+    from .partition import build_partition_from_blocks, split_row_blocks
+    b = int(block_dim)
+    bsr = host if isinstance(host, sp.bsr_matrix) else sp.bsr_matrix(
+        host, blocksize=(b, b))
+    bsr.sort_indices()
+    dtype = np.dtype(dtype or bsr.dtype)
+    mesh = _auto_mesh(mesh)
+    n_parts = mesh.shape[axis]
+    nb = bsr.shape[0] // b
+    # block-graph index CSR: entry (I, J) stores its block id
+    ind = sp.csr_matrix(
+        (np.arange(len(bsr.indices), dtype=np.int64) + 1, bsr.indices,
+         bsr.indptr), shape=(nb, bsr.shape[1] // b))
+    if offsets is None:
+        nl = -(-nb // n_parts)
+        offsets = np.minimum(np.arange(n_parts + 1) * nl, nb)
+    else:
+        offsets = np.asarray(offsets)
+    ind_blocks = split_row_blocks(ind, offsets)
+    part = build_partition_from_blocks(ind_blocks, offsets, n_rings=2)
+    if n_loc is not None and n_loc > part.n_loc:
+        part = dataclasses.replace(part, n_loc=n_loc)
+    n_loc = part.n_loc
+    K = max((int(np.diff(blk.indptr).max()) if blk.nnz else 1
+             for blk in ind_blocks), default=1)
+
+    cols = np.zeros((n_parts, n_loc, K), dtype=np.int32)
+    vals = np.zeros((n_parts, n_loc, K, b, b), dtype=dtype)
+    diag = np.zeros((n_parts, n_loc, b, b), dtype=dtype)
+    eye = np.eye(b, dtype=dtype)
+    for p in range(n_parts):
+        lo, hi = part.offsets[p], part.offsets[p + 1]
+        nl = hi - lo
+        sub = ind_blocks[p]
+        sub.sort_indices()
+        ext = part.halo_global[p]
+        gcols = sub.indices.astype(np.int64)
+        local = (gcols >= lo) & (gcols < hi)
+        lcols = np.where(local, gcols - lo, 0)
+        if len(ext):
+            halo_slot = np.searchsorted(ext, gcols)
+            halo_slot = np.minimum(halo_slot, len(ext) - 1)
+            lcols = np.where(local, lcols, n_loc + halo_slot)
+        deg = np.diff(sub.indptr)
+        rr = np.repeat(np.arange(nl), deg)
+        pos = np.arange(len(gcols)) - np.repeat(sub.indptr[:-1], deg)
+        cols[p, rr, pos] = lcols
+        vals[p, rr, pos] = bsr.data[sub.data - 1]
+        on_diag = gcols == rr + lo
+        diag[p, rr[on_diag]] += bsr.data[sub.data[on_diag] - 1]
+        # identity padding rows
+        r = np.arange(nl, n_loc)
+        cols[p, r, 0] = r
+        vals[p, r, 0] = eye
+        diag[p, r] = eye
+
+    spec5 = NamedSharding(mesh, P(axis, None, None, None, None))
+    spec3 = NamedSharding(mesh, P(axis, None, None))
+    spec2 = NamedSharding(mesh, P(axis, None))
+    spec1 = NamedSharding(mesh, P(axis))
+    r2 = part.rings[1]
+    return ShardedMatrix(
+        cols=jax.device_put(cols, spec3),
+        vals=jax.device_put(vals, spec5),
+        diag=jax.device_put(diag.reshape(-1, b, b), spec1),
+        send_idx=jax.device_put(part.send_idx, spec2),
+        halo_src=jax.device_put(part.halo_src, spec2),
+        bnd_rows=jax.device_put(part.bnd_rows, spec2),
+        send_idx2=jax.device_put(r2.send_idx, spec2),
+        halo_src2=jax.device_put(r2.halo_src, spec2),
+        n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
+        ell_width=K, block_dim=b, axis=axis,
+        dists=part.dists, dists2=r2.dists,
+        offsets=tuple(int(o) for o in part.offsets), mesh=mesh)
+
+
 # --------------------------------------------------------------------------
 # distributed SpMV
 # --------------------------------------------------------------------------
@@ -332,10 +419,11 @@ def _exchange(buf: jax.Array, dists: tuple, axis: str,
     if n_parts == 1:
         return buf
     if len(dists) >= n_parts - 1:
-        all_bufs = jax.lax.all_gather(buf, axis)            # (P, B)
+        all_bufs = jax.lax.all_gather(buf, axis)        # (P, B[, b])
         i = jax.lax.axis_index(axis)
         order = (i + jnp.asarray(dists, jnp.int32)) % n_parts
-        return all_bufs[order].reshape(-1)
+        # keep trailing block components (b×b packs send (B, b) bufs)
+        return all_bufs[order].reshape((-1,) + buf.shape[1:])
     parts = []
     for d in dists:
         # source s delivers to (s − d) mod P ⇒ rank p receives from p+d
@@ -380,6 +468,8 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     """
     axis = A.axis
     n_parts = A.n_parts
+    if A.block_dim > 1:
+        return _dist_spmv_block(A, x)
     from ..ops.pallas_ell import _INTERPRET
     # gate on the MESH's platform, not the process default backend — a
     # CPU debug mesh on a TPU host must take the gather path
@@ -450,18 +540,55 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, wb, wc, wv, x)
 
 
+def _dist_spmv_block(A: ShardedMatrix, x: jax.Array) -> jax.Array:
+    """Block (b×b) distributed SpMV: same interior/boundary split, halo
+    exchange carries (B, b) block values, contractions are batched
+    einsums (the b×b MXU path)."""
+    axis, n_parts, b = A.axis, A.n_parts, A.block_dim
+
+    def local(cols, vals, send_idx, halo_src, bnd_rows, xl):
+        cols, vals = cols[0], vals[0]
+        send_idx, halo_src, bnd = send_idx[0], halo_src[0], bnd_rows[0]
+        n_loc = cols.shape[0]
+        H = halo_src.shape[0]
+        xb = xl.reshape(n_loc, b)
+        buf = xb[send_idx]                                  # (B, b)
+        got = _exchange(buf, A.dists, axis, n_parts)        # (D·B, b)
+        hvals = got[halo_src]                               # (H, b)
+        xfull0 = jnp.concatenate([xb, jnp.zeros((H, b), xl.dtype)])
+        xg = xfull0[cols]                                   # (n,K,b)
+        y0 = jnp.einsum("nkab,nkb->na", vals, xg,
+                        preferred_element_type=vals.dtype)
+        rows = jnp.minimum(bnd, n_loc - 1)
+        cb = cols[rows]                                     # (Bd, K)
+        vb = vals[rows]                                     # (Bd,K,b,b)
+        hg = hvals[jnp.clip(cb - n_loc, 0, H - 1)]          # (Bd,K,b)
+        hb = jnp.einsum("nkab,nkb->na", vb,
+                        jnp.where((cb >= n_loc)[..., None], hg, 0.0),
+                        preferred_element_type=vals.dtype)
+        yext = jnp.zeros((n_loc + 1, b), xl.dtype).at[bnd].add(hb)
+        return (y0 + yext[:n_loc]).reshape(-1)
+
+    return jax.shard_map(
+        local, mesh=A.mesh,
+        in_specs=(P(axis, None, None), P(axis, None, None, None, None),
+                  P(axis, None), P(axis, None), P(axis, None), P(axis)),
+        out_specs=P(axis),
+    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, x)
+
+
 def vector_sharding(A: ShardedMatrix) -> NamedSharding:
     return NamedSharding(A.mesh, P(A.axis))
 
 
 def shard_vector(A: ShardedMatrix, v) -> jax.Array:
-    """Pad a real-sized global vector to P·n_loc and place it sharded.
+    """Pad a real-sized global vector to P·n_loc·b and place it sharded.
 
-    The padded layout is rank-major: rank p's real rows land at
-    [p·n_loc, p·n_loc + count_p).
+    The padded layout is rank-major: rank p's real (block) rows land at
+    [p·n_loc, p·n_loc + count_p), ×b scalar entries each.
     """
     v = np.asarray(v)
-    n = A.n_parts * A.n_loc
+    n = A.n_parts * A.n_loc * A.block_dim
     if v.shape[0] == n:
         return jax.device_put(v.astype(A.dtype), vector_sharding(A))
     out = np.zeros(n, dtype=A.dtype)
@@ -478,7 +605,12 @@ _padmap_cache = {}
 
 
 def _pad_map_cached(A: ShardedMatrix) -> np.ndarray:
-    key = (A.offsets, A.n_loc)
+    key = (A.offsets, A.n_loc, A.block_dim)
     if key not in _padmap_cache:
-        _padmap_cache[key] = pad_map(np.asarray(A.offsets), A.n_loc)
+        pm = pad_map(np.asarray(A.offsets), A.n_loc)
+        b = A.block_dim
+        if b > 1:
+            # block pad map → scalar entries
+            pm = (pm[:, None] * b + np.arange(b)[None, :]).reshape(-1)
+        _padmap_cache[key] = pm
     return _padmap_cache[key]

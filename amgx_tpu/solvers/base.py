@@ -152,6 +152,7 @@ class Solver:
         optional scaling → solver-specific setup."""
         t0 = time.perf_counter()
         self.scaler = None
+        self._reorder = None
         scaling = str(self.cfg.get("scaling", self.scope))
         if isinstance(A, Matrix):
             if scaling != "NONE" and A.dist is None and A.block_dim == 1:
@@ -164,6 +165,14 @@ class Solver:
                                                 self.scope)
                     self.scaler.setup(A.scalar_csr())
                     A = Matrix(self.scaler.scale_matrix(A.scalar_csr()))
+            if getattr(self, "_toplevel", False):
+                # reordering is OWNED by the outermost solver: only its
+                # solve() has the permute boundary — a nested smoother/
+                # preconditioner permuting its operator would be fed
+                # residuals in the un-permuted level ordering
+                A2 = self._maybe_reorder(A)
+                if A2 is not None:
+                    A = A2
             self.A = A
             with cpu_profiler("matrix_pack_device"):
                 self.Ad = A.device()
@@ -210,6 +219,62 @@ class Solver:
             return self.setup(A)
         finally:
             self._numeric_resetup = False
+
+    def _maybe_reorder(self, A: Matrix) -> Optional[Matrix]:
+        """Setup-time RCM bandwidth reduction — the gather-cliff rescue.
+
+        A matrix that is neither DIA-eligible nor within the windowed
+        kernel's per-tile column-block budget would fall onto XLA's TPU
+        gather lowering (~0.2 GFLOPS, three orders below the window
+        kernel).  AUTO mode permutes such matrices with reverse
+        Cuthill–McKee ONCE at setup when that makes the window fit; the
+        whole solve then runs in permuted space and rhs/solution are
+        converted at the solve boundaries (reference analog: setup-time
+        renumbering, ``matrix.cu:760-813``).  Returns the permuted
+        Matrix, or None to keep ``A``."""
+        mode = str(self.cfg.get("matrix_reorder", self.scope))
+        if mode == "NONE" or not isinstance(A, Matrix) or \
+                A.dist is not None or A.block_dim != 1 or \
+                A.host is None or A.shape[0] != A.shape[1]:
+            return None
+        if mode == "AUTO":
+            from ..ops.pallas_ell import _INTERPRET
+            if not (jax.default_backend() == "tpu" or _INTERPRET):
+                return None
+            dtype = np.dtype(A.device_dtype or A.dtype)
+            if dtype != np.float32 or A.dia_cache(48) is not None:
+                return None
+            csr = A.scalar_csr()
+            from ..core.matrix import ell_layout
+            from ..ops.pallas_ell import ell_window_pack
+            for_rows, pos, k = ell_layout(csr.indptr, csr.indices)
+            if k > 160:
+                return None
+            cols = np.zeros((csr.shape[0], k), dtype=np.int32)
+            cols[for_rows, pos] = csr.indices
+            if ell_window_pack(cols) is not None:
+                return None          # already window-eligible
+        import scipy.sparse as sp
+        from scipy.sparse.csgraph import reverse_cuthill_mckee
+        csr = A.scalar_csr()
+        perm = np.asarray(reverse_cuthill_mckee(csr,
+                                                symmetric_mode=False),
+                          dtype=np.int64)
+        csr_p = csr[perm][:, perm].tocsr()
+        if mode == "AUTO":
+            # adopt only if RCM actually makes the window fit
+            from ..core.matrix import ell_layout
+            from ..ops.pallas_ell import ell_window_pack
+            for_rows, pos, k = ell_layout(csr_p.indptr, csr_p.indices)
+            cols = np.zeros((csr_p.shape[0], k), dtype=np.int32)
+            cols[for_rows, pos] = csr_p.indices
+            if ell_window_pack(cols) is None:
+                return None
+        Ap = Matrix(csr_p)
+        Ap.device_dtype = A.device_dtype
+        Ap.placement = A.placement
+        self._reorder = (perm, np.argsort(perm))
+        return Ap
 
     def solver_setup(self):
         """Override: build device-side data (diag inverse, hierarchy, ...)."""
@@ -275,13 +340,23 @@ class Solver:
         if self.Ad is None:
             raise BadConfigurationError("solve() before setup()")
         dtype = self.Ad.dtype
-        b_in = b
-        x0_in = None if zero_initial_guess else x0
         if self.scaler is not None:
             b = self.scaler.scale_rhs(np.asarray(b, dtype=dtype))
             if x0 is not None and not zero_initial_guess:
                 x0 = self.scaler.scale_initial_guess(
                     np.asarray(x0, dtype=dtype))
+        if self._reorder is not None:
+            # the pack lives in RCM space (see _maybe_reorder): permute
+            # the rhs/guess in AFTER scaling (setup scaled first, then
+            # permuted — the pack is P·S·A·S·Pᵀ) and un-permute the
+            # solution BEFORE unscaling on the way out; norms are
+            # permutation-invariant, so monitoring is unchanged
+            perm, _ = self._reorder
+            b = np.asarray(b)[perm]
+            if x0 is not None and not zero_initial_guess:
+                x0 = np.asarray(x0)[perm]
+        b_in = b
+        x0_in = None if zero_initial_guess else x0
         dist = self.Ad.fmt == "sharded-ell"
 
         floor = self._tolerance_floor(dtype)
@@ -374,6 +449,8 @@ class Solver:
         if dist:
             from ..distributed.matrix import unshard_vector
             x = unshard_vector(self.Ad, x)
+        if self._reorder is not None:
+            x = np.asarray(x)[self._reorder[1]]
         if self.scaler is not None:
             x = self.scaler.unscale_solution(np.asarray(x))
 

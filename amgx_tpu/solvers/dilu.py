@@ -71,6 +71,86 @@ def _scalar_dilu_factor(csr: sp.csr_matrix, colors: np.ndarray):
     return L, U, Einv
 
 
+def _block_dilu_factor(bsr: sp.bsr_matrix, colors: np.ndarray, bd: int):
+    """Block DILU factorisation (the b×b path of
+    ``multicolor_dilu_solver.cu:48-112``): returns (Lb, Ub, Einv) with
+    L/U the strict lower/upper block parts in color-rank order and
+    (n, b, b) inverted E blocks."""
+    bsr = bsr.copy()
+    bsr.sort_indices()
+    n = bsr.shape[0] // bd
+    rows = np.repeat(np.arange(n), np.diff(bsr.indptr))
+    cols_ = bsr.indices
+    lower = colors[cols_] < colors[rows]
+    upper = colors[cols_] > colors[rows]
+    # transpose-aligned blocks: Bt[e] = A_block[j,i]ᵀ-lookup
+    keys = rows.astype(np.int64) * n + cols_
+    tkeys = cols_.astype(np.int64) * n + rows
+    pos = np.searchsorted(keys, tkeys)
+    pos_c = np.minimum(pos, len(keys) - 1)
+    hit = (pos < len(keys)) & (keys[pos_c] == tkeys)
+    Bt = np.zeros_like(bsr.data)
+    Bt[hit] = bsr.data[pos_c[hit]]
+    diagblocks = np.zeros((n, bd, bd))
+    on_diag = cols_ == rows
+    diagblocks[rows[on_diag]] = bsr.data[on_diag]
+    E = np.zeros((n, bd, bd))
+    Einv = np.zeros((n, bd, bd))
+    num_colors = int(colors.max()) + 1 if n else 1
+    for c in range(num_colors):
+        rc = colors == c
+        contrib = np.zeros((n, bd, bd))
+        mask = lower & rc[rows]
+        if mask.any():
+            prod = np.einsum("eab,ebc,ecd->ead", bsr.data[mask],
+                             Einv[cols_[mask]], Bt[mask])
+            np.add.at(contrib, rows[mask], prod)
+        E[rc] = diagblocks[rc] - contrib[rc]
+        # guard singular blocks
+        for i in np.flatnonzero(rc):
+            try:
+                Einv[i] = np.linalg.inv(E[i])
+            except np.linalg.LinAlgError:
+                Einv[i] = np.eye(bd)
+    Lb = sp.bsr_matrix((np.where(lower[:, None, None], bsr.data, 0.0),
+                        cols_.copy(), bsr.indptr.copy()),
+                       shape=bsr.shape)
+    Ub = sp.bsr_matrix((np.where(upper[:, None, None], bsr.data, 0.0),
+                        cols_.copy(), bsr.indptr.copy()),
+                       shape=bsr.shape)
+    return Lb, Ub, Einv
+
+
+def _stack_color_slabs(per_rank, c, n_parts, n_loc, dt, trailing=()):
+    """Stack color ``c``'s per-rank slabs into (P, Rc[, ...]) arrays
+    padded to a common (rows, width); pad rows point at the trash slot
+    ``n_loc``.  ``trailing`` is the value block shape (() scalar,
+    (b, b) block)."""
+    Rc = max(max(np.asarray(s[c].rows).shape[0] for s in per_rank), 1)
+    Kc = max(max(np.asarray(s[c].cols).shape[1] for s in per_rank), 1)
+    rows = np.full((n_parts, Rc), n_loc, dtype=np.int32)
+    cols = np.zeros((n_parts, Rc, Kc), dtype=np.int32)
+    vals = np.zeros((n_parts, Rc, Kc) + trailing, dtype=dt)
+    for p, s in enumerate(per_rank):
+        sc = s[c]
+        r_ = np.asarray(sc.rows)
+        c_ = np.asarray(sc.cols)
+        v_ = np.asarray(sc.vals)
+        rows[p, :r_.shape[0]] = r_
+        cols[p, :r_.shape[0], :c_.shape[1]] = c_
+        vals[p, :r_.shape[0], :c_.shape[1]] = v_
+    return rows, cols, vals
+
+
+def _put_slab_tree(tree, mesh, axis):
+    """Shard stacked slab arrays over the mesh axis (leading dim)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, NamedSharding(
+            mesh, P(axis, *([None] * (a.ndim - 1))))), tree)
+
+
 def _transpose_aligned_values(csr: sp.csr_matrix) -> np.ndarray:
     """For each stored entry (i,j) return a_ji (0 when (j,i) not stored)."""
     n = csr.shape[0]
@@ -99,7 +179,10 @@ class MulticolorDILUSolver(Solver):
         b = self.A.block_dim
         dist = self.Ad.fmt == "sharded-ell"
         if dist and b != 1:
-            raise BadConfigurationError("distributed DILU: block_dim=1 only")
+            self._setup_dist_slabs_block(colors)
+            self.block = True
+            self.block_dim = b
+            return
 
         # entry classification in color-rank order
         if b == 1:
@@ -164,37 +247,105 @@ class MulticolorDILUSolver(Solver):
                 Up, cp, self.num_colors, dt, device=False))
             Einv_parts.append(Einv_p)
         self.Einv = shard_vector(Ad, np.concatenate(Einv_parts))
-
-        def stack(per_rank, c):
-            """Stack color c's slabs over ranks, padded to common
-            (rows, width); pad rows go to the trash slot n_loc."""
-            Rc = max(max(s[c].rows.shape[0] for s in per_rank), 1)
-            Kc = max(max(s[c].cols.shape[1] for s in per_rank), 1)
-            rows = np.full((n_parts, Rc), n_loc, dtype=np.int32)
-            cols = np.zeros((n_parts, Rc, Kc), dtype=np.int32)
-            vals = np.zeros((n_parts, Rc, Kc), dtype=dt)
-            for p, s in enumerate(per_rank):
-                sc = s[c]
-                r_, k_ = sc.rows.shape[0], sc.cols.shape[1]
-                rows[p, :r_] = sc.rows
-                cols[p, :r_, :k_] = sc.cols
-                vals[p, :r_, :k_] = sc.vals
-            return rows, cols, vals
-
-        Ls = [stack(per_rank_L, c) for c in range(self.num_colors)]
-        Us = [stack(per_rank_U, c) for c in range(self.num_colors)]
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def put(tree):
-            return jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, NamedSharding(
-                    mesh, P(axis, *([None] * (a.ndim - 1))))), tree)
-
-        self._dist_L, self._dist_U = put(Ls), put(Us)
+        Ls = [_stack_color_slabs(per_rank_L, c, n_parts, n_loc, dt)
+              for c in range(self.num_colors)]
+        Us = [_stack_color_slabs(per_rank_U, c, n_parts, n_loc, dt)
+              for c in range(self.num_colors)]
+        self._dist_L = _put_slab_tree(Ls, mesh, axis)
+        self._dist_U = _put_slab_tree(Us, mesh, axis)
         self.L_slabs = self.U_slabs = None
         self.Ld = self.Ud = None
         self.color_masks = None
+
+    def _setup_dist_slabs_block(self, colors):
+        """Distributed b×b DILU (BASELINE config 4 on the mesh): per-rank
+        local-BLOCK factorisation (``multicolor_dilu_solver.cu:48-112``
+        b×b path, distributed as in :meth:`_setup_dist_slabs`) + stacked
+        per-color block slabs, swept with zero collectives."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from .gs import build_color_slabs_block
+        mesh, axis, _, _ = self.A.dist
+        Ad = self.Ad
+        bd = self.A.block_dim
+        offs = np.asarray(Ad.offsets)          # BLOCK-row offsets
+        n_parts, n_loc = Ad.n_parts, Ad.n_loc
+        dt = Ad.dtype
+        bsr = self.A.host if isinstance(self.A.host, sp.bsr_matrix) \
+            else sp.bsr_matrix(self.A.host, blocksize=(bd, bd))
+        csr_full = sp.csr_matrix(bsr)      # one O(nnz) conversion
+        per_L, per_U, Einv_pads = [], [], []
+        for p in range(n_parts):
+            lo, hi = offs[p], offs[p + 1]
+            sub = sp.bsr_matrix(
+                csr_full[lo * bd:hi * bd, lo * bd:hi * bd],
+                blocksize=(bd, bd))
+            cp = colors[lo:hi]
+            Lp, Up, Einv_p = _block_dilu_factor(sub, cp, bd)
+            per_L.append(build_color_slabs_block(
+                Lp, cp, self.num_colors, dt, bd))
+            per_U.append(build_color_slabs_block(
+                Up, cp, self.num_colors, dt, bd))
+            pad = np.tile(np.eye(bd, dtype=dt), (n_loc, 1, 1))
+            pad[:hi - lo] = Einv_p
+            Einv_pads.append(pad)
+
+        spec1 = NamedSharding(mesh, P(axis))
+        self.Einv = jax.device_put(
+            np.concatenate(Einv_pads).astype(dt), spec1)
+        self._dist_L = _put_slab_tree(
+            [_stack_color_slabs(per_L, c, n_parts, n_loc, dt, (bd, bd))
+             for c in range(self.num_colors)], mesh, axis)
+        self._dist_U = _put_slab_tree(
+            [_stack_color_slabs(per_U, c, n_parts, n_loc, dt, (bd, bd))
+             for c in range(self.num_colors)], mesh, axis)
+        self.L_slabs = self.U_slabs = None
+        self.Ld = self.Ud = None
+        self.color_masks = None
+
+    def _apply_dilu_dist_block(self, r):
+        """Distributed b×b two-sweep DILU apply: one shard_map, no
+        collectives."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        A = self.Ad
+        axis, n_loc, bd = A.axis, A.n_loc, self.block_dim
+
+        def local(Ls, Us, Einv, rl):
+            rb = rl.reshape(n_loc, bd)
+            y = jnp.zeros((n_loc + 1, bd), rl.dtype)   # +1 trash row
+            for c in range(self.num_colors):
+                rows, cols, vals = jax.tree_util.tree_map(
+                    lambda a: a[0], Ls[c])
+                t = jnp.einsum("nkab,nkb->na", vals, y[cols],
+                               preferred_element_type=rl.dtype)
+                rsafe = jnp.minimum(rows, n_loc - 1)
+                rhs = rb[rsafe] - t
+                upd = jnp.einsum("nab,nb->na", Einv[rsafe], rhs,
+                                 preferred_element_type=rl.dtype)
+                y = y.at[rows].set(upd)
+            z = y
+            for c in range(self.num_colors - 1, -1, -1):
+                rows, cols, vals = jax.tree_util.tree_map(
+                    lambda a: a[0], Us[c])
+                t = jnp.einsum("nkab,nkb->na", vals, z[cols],
+                               preferred_element_type=rl.dtype)
+                rsafe = jnp.minimum(rows, n_loc - 1)
+                upd = y[rsafe] - jnp.einsum(
+                    "nab,nb->na", Einv[rsafe], t,
+                    preferred_element_type=rl.dtype)
+                z = z.at[rows].set(upd)
+            return z[:n_loc].reshape(-1)
+
+        spec = lambda a: P(axis, *([None] * (a.ndim - 1)))
+        in_specs = (jax.tree_util.tree_map(spec, self._dist_L),
+                    jax.tree_util.tree_map(spec, self._dist_U),
+                    P(axis), P(axis))
+        return jax.shard_map(
+            local, mesh=A.mesh, in_specs=in_specs, out_specs=P(axis),
+            check_vma=False,
+        )(self._dist_L, self._dist_U, self.Einv, r)
 
     def _apply_dilu_dist(self, r):
         """Distributed two-sweep DILU apply: one shard_map, no
@@ -237,46 +388,7 @@ class MulticolorDILUSolver(Solver):
         bd = self.A.block_dim
         bsr = self.A.host if isinstance(self.A.host, sp.bsr_matrix) else \
             sp.bsr_matrix(self.A.host, blocksize=(bd, bd))
-        bsr.sort_indices()
-        n = bsr.shape[0] // bd
-        rows = np.repeat(np.arange(n), np.diff(bsr.indptr))
-        cols_ = bsr.indices
-        lower = colors[cols_] < colors[rows]
-        upper = colors[cols_] > colors[rows]
-        # transpose-aligned blocks: Bt[e] = A_block[j,i]ᵀ-lookup
-        keys = rows.astype(np.int64) * n + cols_
-        tkeys = cols_.astype(np.int64) * n + rows
-        pos = np.searchsorted(keys, tkeys)
-        pos_c = np.minimum(pos, len(keys) - 1)
-        hit = (pos < len(keys)) & (keys[pos_c] == tkeys)
-        Bt = np.zeros_like(bsr.data)
-        Bt[hit] = bsr.data[pos_c[hit]]
-        diagblocks = np.zeros((n, bd, bd))
-        on_diag = cols_ == rows
-        diagblocks[rows[on_diag]] = bsr.data[on_diag]
-        E = np.zeros((n, bd, bd))
-        Einv = np.zeros((n, bd, bd))
-        for c in range(int(colors.max()) + 1):
-            rc = colors == c
-            contrib = np.zeros((n, bd, bd))
-            mask = lower & rc[rows]
-            if mask.any():
-                prod = np.einsum("eab,ebc,ecd->ead", bsr.data[mask],
-                                 Einv[cols_[mask]], Bt[mask])
-                np.add.at(contrib, rows[mask], prod)
-            E[rc] = diagblocks[rc] - contrib[rc]
-            # guard singular blocks
-            for i in np.flatnonzero(rc):
-                try:
-                    Einv[i] = np.linalg.inv(E[i])
-                except np.linalg.LinAlgError:
-                    Einv[i] = np.eye(bd)
-        Lb = sp.bsr_matrix((np.where(lower[:, None, None], bsr.data, 0.0),
-                            cols_.copy(), bsr.indptr.copy()),
-                           shape=bsr.shape)
-        Ub = sp.bsr_matrix((np.where(upper[:, None, None], bsr.data, 0.0),
-                            cols_.copy(), bsr.indptr.copy()),
-                           shape=bsr.shape)
+        Lb, Ub, Einv = _block_dilu_factor(bsr, colors, bd)
         from .gs import build_color_slabs_block
         self.num_colors = int(colors.max()) + 1
         self.L_slabs = build_color_slabs_block(
@@ -292,7 +404,8 @@ class MulticolorDILUSolver(Solver):
     def _apply_dilu(self, r):
         """z = M⁻¹ r via the two color-ordered sweeps."""
         if getattr(self, "_dist_L", None) is not None:
-            return self._apply_dilu_dist(r)
+            return (self._apply_dilu_dist_block(r) if self.block
+                    else self._apply_dilu_dist(r))
         if getattr(self, "L_slabs", None) is not None:
             # per-color slab sweeps: color c reads only its L/U rows
             if not self.block:

@@ -661,3 +661,71 @@ def test_dist_smoother_setup_from_blocks_only(mesh, monkeypatch,
     relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
     assert relres < 1e-7, (relres, res.iterations)
     assert not assembled or max(assembled) <= n // 4, assembled
+
+
+def test_distributed_block_dilu_4x4(mesh):
+    """BASELINE config 4 on the mesh: 4×4 block system, BiCGStab +
+    multicolor DILU — block-CSR distribution (matrix.h:87-220) with
+    per-rank local-block factorisation (multicolor_dilu_solver.cu:48-112)
+    and zero-collective block slab sweeps."""
+    A4 = sp.kron(poisson7pt(10, 10, 10), sp.identity(4)).tocsr()
+    n = A4.shape[0]
+    b = np.ones(n)
+    cfgs = ("config_version=2, solver(out)=PBICGSTAB, out:max_iters=200, "
+            "out:monitor_residual=1, out:tolerance=1e-8, "
+            "out:convergence=RELATIVE_INI, "
+            "out:preconditioner(pre)=MULTICOLOR_DILU, pre:max_iters=1")
+    slv1 = amgx.create_solver(amgx.AMGConfig(cfgs))
+    slv1.setup(amgx.Matrix(A4, block_dim=4))
+    res1 = slv1.solve(b)
+    x1 = np.asarray(res1.x)
+    relres1 = np.linalg.norm(b - A4 @ x1) / np.linalg.norm(b)
+    assert relres1 < 1e-7
+
+    m2 = amgx.Matrix(A4, block_dim=4)
+    m2.set_distribution(mesh)
+    slv2 = amgx.create_solver(amgx.AMGConfig(cfgs))
+    slv2.setup(m2)
+    Ad = m2.device()
+    assert Ad.block_dim == 4 and Ad.fmt == "sharded-ell"
+    bd_ = shard_vector(Ad, b)
+    res2 = slv2.solve(bd_)
+    x2 = unshard_vector(Ad, np.asarray(res2.x))
+    relres2 = np.linalg.norm(b - A4 @ x2) / np.linalg.norm(b)
+    assert relres2 < 1e-7, (relres2, res2.iterations)
+    # local-block DILU may take a couple extra iterations vs the global
+    # factorisation (the reference's distributed smoother differs the
+    # same way) but must stay in the same ballpark
+    assert int(res2.iterations) <= int(res1.iterations) + 8
+    # sweeps stay collective-free
+    pre = slv2.preconditioner
+    r = shard_vector(Ad, np.ones(n))
+    assert _count_collectives(jax.make_jaxpr(pre._apply_dilu)(r)) == 0
+
+
+def test_distributed_block_spmv_matches_serial(mesh, rng):
+    A0 = sp.csr_matrix(poisson7pt(6, 6, 6))
+    bsr0 = sp.kron(A0, np.ones((4, 4))).tobsr(blocksize=(4, 4))
+    bsr0.data[:] = rng.standard_normal(bsr0.data.shape)
+    from amgx_tpu.distributed.matrix import shard_block_matrix
+    Ad = shard_block_matrix(bsr0, 4, mesh)
+    x = rng.standard_normal(bsr0.shape[0])
+    y = unshard_vector(Ad, jax.jit(lambda v: dist_spmv(Ad, v))(
+        shard_vector(Ad, x)))
+    np.testing.assert_allclose(y, bsr0 @ x, rtol=1e-12)
+
+
+def test_distributed_block_spmv_all_gather_path(rng):
+    """2-rank chain: the dense-link all_gather fallback must keep the
+    (B, b) block components of the exchange buffers."""
+    A0 = sp.csr_matrix(poisson7pt(6, 6, 6))
+    bsr0 = sp.kron(A0, np.ones((4, 4))).tobsr(blocksize=(4, 4))
+    bsr0.data[:] = rng.standard_normal(bsr0.data.shape)
+    from amgx_tpu.distributed.matrix import shard_block_matrix
+    mesh2 = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("p",))
+    Ad = shard_block_matrix(bsr0, 4, mesh2)
+    assert len(Ad.dists) >= Ad.n_parts - 1    # all_gather fallback
+    x = rng.standard_normal(bsr0.shape[0])
+    y = unshard_vector(Ad, jax.jit(lambda v: dist_spmv(Ad, v))(
+        shard_vector(Ad, x)))
+    np.testing.assert_allclose(y, bsr0 @ x, rtol=1e-12)

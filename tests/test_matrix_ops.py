@@ -136,3 +136,84 @@ def test_dia_not_selected_for_scattered(rng):
     assert d.fmt != "dia"  # too many distinct offsets
     x = rng.standard_normal(300)
     np.testing.assert_allclose(np.asarray(spmv(d, x)), A @ x, rtol=1e-11)
+
+
+def test_rcm_rescue_restores_window_budget():
+    """A randomly permuted Poisson misses the windowed-kernel budget;
+    reverse Cuthill–McKee at setup restores it (the gather-cliff rescue,
+    solvers/base._maybe_reorder; reference analog: setup renumbering,
+    matrix.cu:760-813)."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import reverse_cuthill_mckee
+
+    from amgx_tpu.core.matrix import ell_layout
+    from amgx_tpu.io import poisson7pt
+    from amgx_tpu.ops.pallas_ell import ell_window_pack
+
+    rng = np.random.default_rng(0)
+    A0 = sp.csr_matrix(poisson7pt(20, 20, 20))
+    perm = rng.permutation(A0.shape[0])
+    Ap = A0[perm][:, perm].tocsr()
+
+    def win_ok(csr):
+        fr, pos, k = ell_layout(csr.indptr, csr.indices)
+        cols = np.zeros((csr.shape[0], k), np.int32)
+        cols[fr, pos] = csr.indices
+        return ell_window_pack(cols) is not None
+
+    assert not win_ok(Ap)
+    rcm = np.asarray(reverse_cuthill_mckee(Ap, symmetric_mode=False))
+    assert win_ok(Ap[rcm][:, rcm].tocsr())
+
+
+def test_forced_rcm_reorder_solve_returns_original_ordering():
+    """matrix_reorder=RCM: the solve runs in permuted space but rhs and
+    solution cross the boundary in the CALLER's ordering."""
+    import amgx_tpu as amgx
+    import scipy.sparse as sp
+
+    from amgx_tpu.io import poisson7pt
+
+    rng = np.random.default_rng(3)
+    A0 = sp.csr_matrix(poisson7pt(12, 12, 12))
+    perm = rng.permutation(A0.shape[0])
+    Ap = A0[perm][:, perm].tocsr()
+    b = rng.standard_normal(Ap.shape[0])
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(s)=PCG, s:preconditioner(p)=BLOCK_JACOBI, "
+        "p:max_iters=3, s:max_iters=400, s:monitor_residual=1, "
+        "s:tolerance=1e-10, s:convergence=RELATIVE_INI, "
+        "s:matrix_reorder=RCM")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(Ap))
+    assert slv._reorder is not None
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    relres = np.linalg.norm(b - Ap @ x) / np.linalg.norm(b)
+    assert relres < 1e-8, relres
+    # and matches the unreordered solve
+    slv2 = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver(s)=PCG, s:preconditioner(p)=BLOCK_JACOBI, "
+        "p:max_iters=3, s:max_iters=400, s:monitor_residual=1, "
+        "s:tolerance=1e-10, s:convergence=RELATIVE_INI, "
+        "s:matrix_reorder=NONE"))
+    slv2.setup(amgx.Matrix(Ap))
+    x2 = np.asarray(slv2.solve(b).x)
+    np.testing.assert_allclose(x, x2, rtol=1e-6, atol=1e-9)
+
+
+def test_auto_reorder_not_applied_on_cpu_or_banded():
+    """AUTO reordering never fires where it has nothing to rescue: CPU
+    backends (no window kernel) and already-window/DIA-eligible
+    operators."""
+    import amgx_tpu as amgx
+
+    from amgx_tpu.io import poisson7pt
+
+    A = poisson7pt(10, 10, 10)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(s)=PCG, s:max_iters=5, "
+        "s:monitor_residual=1")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    assert slv._reorder is None
