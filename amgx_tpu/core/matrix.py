@@ -111,7 +111,7 @@ class DeviceMatrix:
             return self.cols
         K, T = self.ell_width, self.win_tile
         n_tiles = self.win_blocks.shape[0]
-        codes = self.win_codes.reshape(n_tiles, K * T)
+        codes = self.win_codes.astype(jnp.int32).reshape(n_tiles, K * T)
         blk = jnp.take_along_axis(self.win_blocks, codes >> 7, axis=1)
         cols_t = blk * 128 + (codes & 127)
         return jnp.transpose(cols_t.reshape(n_tiles, K, T),

@@ -79,7 +79,9 @@ class ShardedMatrix:
     #: SpMV on TPU backends; None when some shard exceeds the window
     #: budget (local compute then falls back to the XLA gather)
     win_blocks: Optional[jax.Array] = None   # (P, n_tiles·B) int32
-    win_codes: Optional[jax.Array] = None    # (P, n_pad·K) int32
+    #: int16 (codes < 5120 by construction — halves transfer bytes);
+    #: _ell_window_call widens to int32 at trace time for the kernel
+    win_codes: Optional[jax.Array] = None    # (P, n_pad·K) int16
     win_vals: Optional[jax.Array] = None     # (P, n_pad·K)
     win_tile: int = 0
     #: static (meta) so traced packs keep it — tracers have no .sharding

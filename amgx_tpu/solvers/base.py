@@ -112,6 +112,20 @@ class SolverFactory:
 # --------------------------------------------------------------------------
 # Solver base
 # --------------------------------------------------------------------------
+def _window_fits(csr) -> "Optional[bool]":
+    """Does a CSR matrix fit the windowed-kernel budget?  True/False, or
+    None when it is outside the kernel's row-width envelope entirely
+    (K > 160 — no reordering can rescue that)."""
+    from ..core.matrix import ell_layout
+    from ..ops.pallas_ell import ell_window_pack
+    for_rows, pos, k = ell_layout(csr.indptr, csr.indices)
+    if k > 160:
+        return None
+    cols = np.zeros((csr.shape[0], k), dtype=np.int32)
+    cols[for_rows, pos] = csr.indices
+    return ell_window_pack(cols) is not None
+
+
 class Solver:
     """Base solver: common parameter handling + generic solve driver.
 
@@ -244,32 +258,16 @@ class Solver:
             dtype = np.dtype(A.device_dtype or A.dtype)
             if dtype != np.float32 or A.dia_cache(48) is not None:
                 return None
-            csr = A.scalar_csr()
-            from ..core.matrix import ell_layout
-            from ..ops.pallas_ell import ell_window_pack
-            for_rows, pos, k = ell_layout(csr.indptr, csr.indices)
-            if k > 160:
-                return None
-            cols = np.zeros((csr.shape[0], k), dtype=np.int32)
-            cols[for_rows, pos] = csr.indices
-            if ell_window_pack(cols) is not None:
-                return None          # already window-eligible
-        import scipy.sparse as sp
+            if _window_fits(A.scalar_csr()) is not False:
+                return None     # already window-eligible (or too wide)
         from scipy.sparse.csgraph import reverse_cuthill_mckee
         csr = A.scalar_csr()
         perm = np.asarray(reverse_cuthill_mckee(csr,
                                                 symmetric_mode=False),
                           dtype=np.int64)
         csr_p = csr[perm][:, perm].tocsr()
-        if mode == "AUTO":
-            # adopt only if RCM actually makes the window fit
-            from ..core.matrix import ell_layout
-            from ..ops.pallas_ell import ell_window_pack
-            for_rows, pos, k = ell_layout(csr_p.indptr, csr_p.indices)
-            cols = np.zeros((csr_p.shape[0], k), dtype=np.int32)
-            cols[for_rows, pos] = csr_p.indices
-            if ell_window_pack(cols) is None:
-                return None
+        if mode == "AUTO" and _window_fits(csr_p) is not True:
+            return None          # RCM didn't make the window fit
         Ap = Matrix(csr_p)
         Ap.device_dtype = A.device_dtype
         Ap.placement = A.placement

@@ -284,9 +284,9 @@ class MulticolorDILUSolver(Solver):
             cp = colors[lo:hi]
             Lp, Up, Einv_p = _block_dilu_factor(sub, cp, bd)
             per_L.append(build_color_slabs_block(
-                Lp, cp, self.num_colors, dt, bd))
+                Lp, cp, self.num_colors, dt, bd, device=False))
             per_U.append(build_color_slabs_block(
-                Up, cp, self.num_colors, dt, bd))
+                Up, cp, self.num_colors, dt, bd, device=False))
             pad = np.tile(np.eye(bd, dtype=dt), (n_loc, 1, 1))
             pad[:hi - lo] = Einv_p
             Einv_pads.append(pad)

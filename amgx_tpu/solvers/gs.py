@@ -55,11 +55,14 @@ def build_color_slabs(csr, colors, num_colors, dtype, device=True):
     return slabs
 
 
-def build_color_slabs_block(bsr, colors, num_colors, dtype, bd):
+def build_color_slabs_block(bsr, colors, num_colors, dtype, bd,
+                            device=True):
     """Per-color packed block-ELL slabs from a BSR matrix: cols are BLOCK
-    columns, vals (nc, K, b, b)."""
+    columns, vals (nc, K, b, b); ``device=False`` keeps host arrays (the
+    distributed packer stacks and re-shards them itself)."""
     import scipy.sparse as sp
     from ..core.matrix import ell_layout
+    wrap = jnp.asarray if device else (lambda x: x)
     bsr.sort_indices()
     ind = sp.csr_matrix(
         (np.arange(len(bsr.indices)), bsr.indices, bsr.indptr),
@@ -73,8 +76,8 @@ def build_color_slabs_block(bsr, colors, num_colors, dtype, bd):
         vals = np.zeros((len(rows), k, bd, bd), dtype=dtype)
         cols[for_rows, pos] = sub.indices
         vals[for_rows, pos] = bsr.data[sub.data]
-        slabs.append(ColorSlab(jnp.asarray(rows.astype(np.int32)),
-                               jnp.asarray(cols), jnp.asarray(vals)))
+        slabs.append(ColorSlab(wrap(rows.astype(np.int32)),
+                               wrap(cols), wrap(vals)))
     return slabs
 
 
