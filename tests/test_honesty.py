@@ -89,3 +89,47 @@ def test_final_norm_is_true_residual():
     true_nrm = np.linalg.norm(b - A @ np.asarray(res.x))
     assert np.max(np.abs(res.residual_norm - true_nrm)) <= \
         1e-6 * max(true_nrm, 1e-30) + 1e-12
+
+
+def test_refinement_on_lean_windowed_pack(monkeypatch):
+    """Mixed-precision refinement must work when the device pack is a
+    LEAN windowed ELL (vals/cols dropped from the transfer): the traced
+    f64 SpMV rebuilds the gather-form arrays from the kernel layout
+    (DeviceMatrix.ell_vals_view/ell_cols_view)."""
+    import scipy.sparse as sp
+
+    import amgx_tpu as amgx
+    from amgx_tpu.core.matrix import batch_upload
+    from amgx_tpu.ops import pallas_ell
+
+    monkeypatch.setattr(pallas_ell, "_INTERPRET", True)
+    rng = np.random.default_rng(5)
+    n = 512
+    # banded matrix with >48 diagonals: not DIA-eligible, window-local
+    offs = np.unique(np.concatenate([
+        rng.integers(-60, 61, size=60), [0]]))
+    mats = [sp.diags(rng.standard_normal(n - abs(int(o))) * 0.05, int(o),
+                     shape=(n, n)) for o in offs if o != 0]
+    A = (sp.identity(n) * 4.0 + sum(mats)).tocsr()
+    A = sp.csr_matrix(A + A.T)        # SPD-ish, structurally symmetric
+    m = amgx.Matrix(A)
+    m.device_dtype = np.float32
+    batch_upload([m])
+    Ad = m.device()
+    assert Ad.fmt == "ell" and Ad.win_codes is not None
+    assert Ad.vals is None and Ad.cols is None     # lean transfer
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(s)=FGMRES, s:max_iters=500, "
+        "s:gmres_n_restart=30, s:monitor_residual=1, s:tolerance=1e-11, "
+        "s:convergence=RELATIVE_INI")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    assert slv.Ad is Ad
+    b = np.ones(n)
+    res = slv.solve(b)
+    x = np.asarray(res.x, np.float64)
+    relres = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    # 1e-11 is far below the f32 floor: only honest f64 refinement over
+    # the reconstructed operator can get here
+    assert relres < 1e-10, (relres, int(res.iterations), int(res.status))
+    assert res.status == amgx.SolveStatus.SUCCESS
