@@ -571,10 +571,8 @@ class Solver:
         out[for_rows, pos] = data
         return out
 
-    def _spmv_wide(self, x64):
-        """Traced f64 SpMV of the exact host operator (XLA emulates f64 on
-        TPU — slower than f32 but bit-honest, which is all the refinement
-        residual needs)."""
+    def _wide_pack(self):
+        """The traced f64 device pack of the exact host operator."""
         Ad64 = self.Ad
         if Ad64.fmt == "ell" and Ad64.vals is None:
             # lean windowed pack: the f64 path needs the gather-form
@@ -587,7 +585,16 @@ class Solver:
         if self._refine_lo is not None:
             Ad64 = dataclasses.replace(
                 Ad64, vals=Ad64.vals + self._refine_lo.astype(jnp.float64))
-        return spmv(Ad64, x64)
+        return Ad64
+
+    def _spmv_wide(self, x64, Ad64=None):
+        """Traced f64 SpMV of the exact host operator (XLA emulates f64 on
+        TPU — slower than f32 but bit-honest, which is all the refinement
+        residual needs).  Pass a precomputed ``Ad64`` when calling inside
+        a loop: XLA does not reliably hoist the ~2×vals widening out of
+        ``while`` bodies, and at 256³ that is ~1 GB of rematerialisation
+        per refinement pass."""
+        return spmv(self._wide_pack() if Ad64 is None else Ad64, x64)
 
     def _solve_refined(self, b, x0):
         """Mixed-precision iterative refinement, entirely on device: inner
@@ -650,9 +657,12 @@ class Solver:
             return w if lo is None else w + lo.astype(f64)
 
         def refined_fn(b_hi, b_lo, x_hi, x_lo, tol, it_limit):
+            # widen the operator ONCE, outside the while body (see
+            # _spmv_wide: XLA won't hoist the ~2×vals materialisation)
+            Ad64 = self._wide_pack()
             b64 = widen(b_hi, b_lo)
             x64 = jnp.zeros_like(b64) if x_hi is None else widen(x_hi, x_lo)
-            r64 = b64 - self._spmv_wide(x64)
+            r64 = b64 - self._spmv_wide(x64, Ad64)
             nrm_ini = norm64(r64)
             m = nrm_ini.shape[0]
             hist = jnp.zeros((max_iters + 1, m), dtype)
@@ -673,7 +683,7 @@ class Solver:
                     rb, jnp.zeros_like(rb),
                     jnp.asarray(inner_tol, dtype), it_limit - it_tot)
                 x64n = x64 + scale * dx.astype(f64)
-                r64n = b64 - self._spmv_wide(x64n)
+                r64n = b64 - self._spmv_wide(x64n, Ad64)
                 nrm_n = norm64(r64n)
                 if keep_history:
                     # place h_in rows 1..it (scaled) at hist rows
