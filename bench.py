@@ -262,41 +262,62 @@ def main():
     big = {}
     extra_cases = {}
     if on_tpu and n_side == 128 and len(sys.argv) <= 1:
-        A2 = poisson7pt(256, 256, 256)
-        m2 = amgx.Matrix(A2)
-        m2.device_dtype = np.float32
-        big = _run_case(A2, m2, cfg, dtype)
-        del A2, m2
+        # a transient tunnel/worker hiccup in one extra case must not
+        # take down the headline JSON line
+        def guarded(label, fn):
+            try:
+                return fn()
+            except Exception as e:
+                import traceback
+                print(f"[bench] {label} failed: {e}", file=sys.stderr)
+                traceback.print_exc()     # distinguish real regressions
+                return {"error": str(e)[:200]}
+
+        def case_256():
+            A2 = poisson7pt(256, 256, 256)
+            m2 = amgx.Matrix(A2)
+            m2.device_dtype = np.float32
+            return _run_case(A2, m2, cfg, dtype)
+
+        big = guarded("poisson256", case_256)
 
         # BASELINE config 2: PCG + classical AMG (PMIS/D2, reference's
         # interp_max_elements=4 truncation, AMG_CLASSICAL_PMIS.json) —
         # coarse operators ride the windowed-ELL kernel
-        A3 = poisson7pt(64, 64, 64)
-        m3 = amgx.Matrix(A3)
-        m3.device_dtype = np.float32
-        cla = amgx.AMGConfig(
-            "config_version=2, solver(out)=PCG, out:max_iters=100, "
-            "out:monitor_residual=1, out:tolerance=1e-8, "
-            "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
-            "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
-            "amg:interpolator=D2, amg:max_iters=1, "
-            "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
-            "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
-            "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
-            "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER")
-        extra_cases["pcg_classical64"] = _run_case(A3, m3, cla, dtype)
+        def case_cla():
+            A3 = poisson7pt(64, 64, 64)
+            m3 = amgx.Matrix(A3)
+            m3.device_dtype = np.float32
+            cla = amgx.AMGConfig(
+                "config_version=2, solver(out)=PCG, out:max_iters=100, "
+                "out:monitor_residual=1, out:tolerance=1e-8, "
+                "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+                "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+                "amg:interpolator=D2, amg:max_iters=1, "
+                "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+                "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+                "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+                "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER")
+            return _run_case(A3, m3, cla, dtype)
+
+        extra_cases["pcg_classical64"] = guarded("pcg_classical64",
+                                                 case_cla)
 
         # BASELINE config 4 analog: block 4×4 system, BiCGStab + DILU
-        import scipy.sparse as sp
-        A4 = sp.kron(poisson7pt(16, 16, 16), sp.identity(4)).tocsr()
-        m4 = amgx.Matrix(A4, block_dim=4)
-        m4.device_dtype = np.float32
-        blk = amgx.AMGConfig(
-            "config_version=2, solver(out)=PBICGSTAB, out:max_iters=200, "
-            "out:monitor_residual=1, out:tolerance=1e-8, "
-            "out:convergence=RELATIVE_INI, "
-            "out:preconditioner(pre)=MULTICOLOR_DILU, pre:max_iters=1")
-        extra_cases["bicgstab_dilu_4x4"] = _run_case(A4, m4, blk, dtype)
+        def case_blk():
+            import scipy.sparse as sp
+            A4 = sp.kron(poisson7pt(16, 16, 16), sp.identity(4)).tocsr()
+            m4 = amgx.Matrix(A4, block_dim=4)
+            m4.device_dtype = np.float32
+            blk = amgx.AMGConfig(
+                "config_version=2, solver(out)=PBICGSTAB, "
+                "out:max_iters=200, out:monitor_residual=1, "
+                "out:tolerance=1e-8, out:convergence=RELATIVE_INI, "
+                "out:preconditioner(pre)=MULTICOLOR_DILU, pre:max_iters=1")
+            return _run_case(A4, m4, blk, dtype)
+
+        extra_cases["bicgstab_dilu_4x4"] = guarded("bicgstab_dilu_4x4",
+                                                   case_blk)
 
     out = {
         "metric": f"poisson{n_side}_fgmres_agg_amg_solve_s",
