@@ -161,6 +161,45 @@ class _ColoredSmootherBase(Solver):
         return out
 
 
+def _shard_transpose(A, Ad):
+    """Sharded pack of Aᵀ from per-rank row blocks of A: each rank's
+    entries route to their COLUMN owners (send-side, neighbour-wise —
+    the Pᵀ collection pattern of the classical distributed path).
+    Row partition of Aᵀ = column partition of A = the same offsets."""
+    import scipy.sparse as sp
+
+    from ..distributed.matrix import shard_matrix_from_blocks
+    offs = np.asarray(Ad.offsets)
+    n_parts = Ad.n_parts
+    n = int(offs[-1])
+    if A.host is None and A.blocks is not None:
+        blocks = A.blocks
+    else:
+        from ..distributed.partition import split_row_blocks
+        blocks = split_row_blocks(A.scalar_csr(), offs)
+    tri = [([], [], []) for _ in range(n_parts)]
+    for p, blk in enumerate(blocks):
+        coo = sp.coo_matrix(blk)
+        gl_rows = coo.row.astype(np.int64) + offs[p]
+        owner = np.searchsorted(offs, coo.col, side="right") - 1
+        for q in np.unique(owner) if len(coo.col) else []:
+            m = owner == q
+            tri[q][0].append(coo.col[m] - offs[q])   # Aᵀ local rows
+            tri[q][1].append(gl_rows[m])             # Aᵀ global cols
+            tri[q][2].append(coo.data[m])
+    t_blocks = []
+    for q in range(n_parts):
+        rr, cc, vv = tri[q]
+        t_blocks.append(sp.csr_matrix(
+            (np.concatenate(vv) if vv else [],
+             (np.concatenate(rr) if rr else [],
+              np.concatenate(cc) if cc else [])),
+            shape=(int(offs[q + 1] - offs[q]), n)))
+    return shard_matrix_from_blocks(t_blocks, offs, Ad.mesh,
+                                    axis=Ad.axis, dtype=Ad.dtype,
+                                    n_loc=Ad.n_loc)
+
+
 def _structurally_symmetric(A) -> bool:
     """Pattern symmetry of a host Matrix (global or per-rank blocks);
     True when unknown (no host data) — the caller only warns."""
@@ -342,7 +381,15 @@ class KaczmarzSolver(_ColoredSmootherBase):
         # Kaczmarz colors the A·Aᵀ graph: same-color rows must not share
         # ANY column, so simultaneous projections are orthogonal
         # (reference ``kaczmarz_coloring_needed``, core.cu:437)
-        if self.A is not None and self.Ad.fmt != "sharded-ell":
+        if self.A is not None and self.Ad.block_dim == 1 and \
+                (self.Ad.fmt != "sharded-ell" or self.A.blocks is None):
+            # the scalar A·Aᵀ coloring (kaczmarz_coloring_needed) also
+            # serves the sharded path whenever a host view exists (or is
+            # dia-derivable), so the distributed sweep order matches the
+            # single-device one; blocks-mode keeps the default
+            # distance-1 coloring, and BLOCK matrices use the default
+            # block-row coloring (the scalar-row A·Aᵀ colors would not
+            # align with the b×b mask layout)
             import scipy.sparse as sp
             from ..coloring import MatrixColoring, create_coloring
             csr = self.A.scalar_csr()
@@ -372,18 +419,24 @@ class KaczmarzSolver(_ColoredSmootherBase):
             if self.Ad.fmt == "sharded-ell":
                 from ..distributed.matrix import shard_vector
                 self.rowinv = shard_vector(self.Ad, vec)
-                # distributed transpose pack not built yet: reuse A,
-                # exact only under structural symmetry — WARN loudly
-                # when that assumption is false (the projection then
-                # uses wrong couplings; kaczmarz_solver.cu builds Aᵀ)
-                self.AdT = self.Ad
-                if not _structurally_symmetric(self.A):
-                    import logging
-                    logging.getLogger("amgx_tpu").warning(
-                        "distributed KACZMARZ substitutes A for A^T but "
-                        "this matrix is NOT structurally symmetric — "
-                        "the row projections use wrong couplings and "
-                        "convergence will degrade")
+                if self.Ad.block_dim == 1:
+                    # TRUE distributed transpose pack (kaczmarz_solver.cu
+                    # builds Aᵀ): per-rank Aᵀ row blocks are collected
+                    # send-side — each rank routes its entries to their
+                    # column owners (the same neighbour-wise collection
+                    # as classical R), then pack as a ShardedMatrix
+                    self.AdT = _shard_transpose(self.A, self.Ad)
+                else:
+                    # block transpose pack not built yet: reuse A, exact
+                    # only under structural symmetry — warn when false
+                    self.AdT = self.Ad
+                    if not _structurally_symmetric(self.A):
+                        import logging
+                        logging.getLogger("amgx_tpu").warning(
+                            "distributed block KACZMARZ substitutes A "
+                            "for A^T but this matrix is NOT structurally"
+                            " symmetric — projections use wrong "
+                            "couplings and convergence will degrade")
             else:
                 self.rowinv = jnp.asarray(vec)
                 from ..core.matrix import Matrix as _M
