@@ -134,3 +134,45 @@ def test_rectangular_from_dia_host():
     ref[[0, 1, 2], [0, 1, 2]] = [1, 2, 3]
     ref[0, 3], ref[1, 4] = 7, 8
     assert np.array_equal(D, ref)
+
+
+def test_resetup_refreshes_dia_hierarchy_on_device(monkeypatch):
+    """Numeric resetup of a structured/pairwise hierarchy goes through
+    the DEVICE derive pass (amg/dia_device.py), not the per-level host
+    Galerkin — the resetup analog of the reference's device-side
+    value-only refresh (csr_multiply.h:100-126)."""
+    import amgx_tpu as amgx
+    from amgx_tpu.amg import hierarchy as H
+    from amgx_tpu.io import poisson7pt
+
+    A = poisson7pt(10, 10, 10)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=FGMRES, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:max_iters=1, "
+        "amg:structure_reuse_levels=-1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+    m = amgx.Matrix(A)
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    x1 = np.asarray(slv.solve(b).x)
+    assert np.linalg.norm(b - A @ x1) / np.linalg.norm(b) < 1e-7
+
+    # the host numeric paths must NOT run during the device refresh
+    def boom(*a, **k):
+        raise AssertionError("host structured/pairwise numeric ran "
+                             "during resetup")
+
+    monkeypatch.setattr(H.AMGHierarchy, "_structured_numeric",
+                        staticmethod(boom))
+    monkeypatch.setattr(H.AMGHierarchy, "_pairwise_numeric",
+                        staticmethod(boom))
+    m.replace_coefficients(A.data * 2.0)
+    slv.resetup(m)
+    x2 = np.asarray(slv.solve(b).x)
+    A2 = A * 2.0
+    assert np.linalg.norm(b - A2 @ x2) / np.linalg.norm(b) < 1e-7
+    np.testing.assert_allclose(x2, x1 / 2.0, rtol=1e-6)
