@@ -25,7 +25,11 @@ _jax.config.update("jax_enable_x64", True)
 # Persistent XLA compile cache: the reference ships precompiled kernels,
 # so its setup pays zero JIT cost at run time; caching compiled
 # executables across processes is the XLA equivalent (first-ever run
-# still compiles).  Opt out with AMGX_TPU_COMPILE_CACHE=0.
+# still compiles).  NOTE: this mutates global JAX config AT IMPORT TIME
+# (documented in README; JAX creates the directory lazily at the first
+# persisted compile); the guard below never clobbers a cache dir the
+# host application configured before importing amgx_tpu.  Opt out with
+# AMGX_TPU_COMPILE_CACHE=0.
 _cache_dir = _os.environ.get("AMGX_TPU_COMPILE_CACHE",
                              _os.path.expanduser("~/.cache/amgx_tpu_xla"))
 if _cache_dir not in ("0", "") and \

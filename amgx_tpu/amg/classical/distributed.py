@@ -28,7 +28,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from ...distributed.partition import Partition
-from .selectors import COARSE, FINE, UNDECIDED
+from .selectors import COARSE, FINE, UNDECIDED, pmis_tie_breaker
 
 
 class RankExtended:
@@ -131,9 +131,10 @@ def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
         lam[e.universe[:e.n_local]] = cnt[:e.n_local]
         gdeg = np.diff(G_U[p].indptr)
         deg_local[e.universe[:e.n_local]] = gdeg[:e.n_local]
-    h = (np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
-         np.uint64(seed)) % np.uint64(1 << 20)
-    w = lam + h.astype(np.float64) / float(1 << 20)
+    # strictly distinct tie-break (selectors.pmis_tie_breaker): computable
+    # per node from (n, seed), so ranks need no weight exchange and the
+    # result stays bit-identical to the serial selector
+    w = lam + pmis_tie_breaker(n, seed)
 
     state = np.full(n, UNDECIDED, dtype=np.int8)
     state[deg_local == 0] = FINE
@@ -147,6 +148,7 @@ def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
         edges.append((rows[m], G.indices[m]))
 
     while np.any(state == UNDECIDED):
+        n_und_before = int((state == UNDECIDED).sum())
         new_c_all = []
         for p, e in enumerate(exts):
             rows, cols = edges[p]
@@ -176,6 +178,10 @@ def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
             f_hit = jc_U[cols] & (st_U[rows] == UNDECIDED)
             f_nodes = np.unique(rows[f_hit])
             state[uni[f_nodes]] = FINE    # rows are local (< n_local)
+        if int((state == UNDECIDED).sum()) == n_und_before:
+            raise RuntimeError(
+                "distributed PMIS made no progress in a round — "
+                "tie-break weights are not distinct")
     return (state == COARSE).astype(np.int8)
 
 

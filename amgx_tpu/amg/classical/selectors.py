@@ -24,6 +24,29 @@ _selector_registry: Dict[str, type] = {}
 COARSE, FINE, UNDECIDED = 1, 0, -1
 
 
+def pmis_tie_breaker(n: int, seed: int) -> np.ndarray:
+    """Strictly distinct fractional tie-break weights in (0, 1).
+
+    ``frac_i = (perm(i) + 1) / (n + 2)`` with ``perm(i) = (a·i + seed) mod n``
+    an affine bijection of ``[0, n)`` (``a`` chosen coprime to ``n``), so no
+    two nodes ever share a weight.  A hash taken mod 2^k can collide for
+    adjacent equal-lambda nodes, and two tied neighbours then deadlock the
+    two-phase rounds: neither satisfies ``w > max_nb`` and, if no adjacent C
+    point ever appears, the while-UNDECIDED loop spins forever.
+
+    Computable locally per node from ``(n, seed)`` alone, so the distributed
+    PMIS produces bit-identical weights without any exchange.
+    """
+    if n == 0:
+        return np.zeros(0, dtype=np.float64)
+    a = 2654435761  # Knuth multiplier; < 2^32 so a*i fits uint64 exactly
+    while np.gcd(a, n) != 1:
+        a += 1
+    perm = (np.arange(n, dtype=np.uint64) * np.uint64(a)
+            + np.uint64(seed % n)) % np.uint64(n)
+    return (perm.astype(np.float64) + 1.0) / float(n + 2)
+
+
 def register_cf_selector(name):
     def deco(cls):
         _selector_registry[name] = cls
@@ -61,15 +84,14 @@ def _pmis(S: sp.csr_matrix, seed: int = 7) -> np.ndarray:
     # weight = #nodes i influences + deterministic hash in [0,1)
     ST = sp.csr_matrix(S.T)
     lam = np.diff(ST.indptr).astype(np.float64)
-    h = (np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
-         np.uint64(seed)) % np.uint64(1 << 20)
-    w = lam + h.astype(np.float64) / float(1 << 20)
+    w = lam + pmis_tie_breaker(n, seed)
 
     state = np.full(n, UNDECIDED, dtype=np.int8)
     state[deg == 0] = FINE  # isolated nodes: fine (nothing to interpolate)
     # nodes with no influence at all become F immediately (reference PMIS)
     while np.any(state == UNDECIDED):
         und = state == UNDECIDED
+        n_und_before = int(und.sum())
         # i becomes C iff w_i > w_j for all undecided neighbours j
         rows = np.repeat(np.arange(n), deg)
         nb_und = und[rows] & und[indices]
@@ -83,6 +105,10 @@ def _pmis(S: sp.csr_matrix, seed: int = 7) -> np.ndarray:
         new_c_entries = become_c[indices] & (state[rows] == UNDECIDED)
         f_nodes = np.unique(rows[new_c_entries])
         state[f_nodes] = FINE
+        if int((state == UNDECIDED).sum()) == n_und_before:
+            raise RuntimeError(
+                "PMIS made no progress in a round — tie-break weights "
+                "are not distinct (internal invariant violated)")
     return (state == COARSE).astype(np.int8)
 
 

@@ -55,6 +55,23 @@ def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     return m
 
 
+def _drop_zero_diagonals(offs, vals: np.ndarray):
+    """Drop stored all-zero diagonals (the main diagonal always stays).
+
+    They carry no numerics, but their offsets participate in the
+    structured-vs-pairwise gate — a stored zero diagonal whose offset
+    breaks the stencil decode would silently demote a 2×2×2-coarsenable
+    operator to 1D pairing.  Returns ``(offs, vals, keep)`` with ``keep``
+    None when nothing was dropped, else the kept row indices (used to
+    slice the matching rows out of an already-uploaded device pack)."""
+    offs = list(offs)
+    nonzero = (vals != 0).any(axis=1) | (np.asarray(offs) == 0)
+    if nonzero.all():
+        return offs, vals, None
+    keep = np.flatnonzero(nonzero)
+    return [offs[int(k)] for k in keep], vals[keep], keep
+
+
 def _require_dia(cur: Matrix):
     """DIA arrays for a structure-reuse refresh; a clear error when the
     refreshed matrix no longer admits the recorded DIA structure (e.g. a
@@ -65,7 +82,8 @@ def _require_dia(cur: Matrix):
             "resetup: recorded hierarchy structure is DIA-based but the "
             "refreshed matrix has no diagonal decomposition — call "
             "setup() for a structural rebuild")
-    return arrs
+    offs, vals, _ = _drop_zero_diagonals(*arrs)
+    return offs, vals
 
 
 def _narrow_dia(cur: Matrix, arrs):
@@ -211,6 +229,15 @@ class AMGHierarchy:
                 dims, = data
                 offs, vals = _narrow_dia(cur, _require_dia(cur))
                 offs3 = decompose_offsets(offs, dims)
+                if offs3 is None or \
+                        not stencil_values_consistent(offs3, vals, dims):
+                    # a value-only refresh can light up a previously
+                    # all-zero diagonal the recorded decode never saw
+                    raise BadConfigurationError(
+                        "resetup: refreshed values no longer admit the "
+                        "recorded structured stencil (a diagonal that was "
+                        "all-zero at setup became coupled) — call setup() "
+                        "for a structural rebuild")
                 flat, vals_c, cdims = self._structured_numeric(
                     offs3, vals, dims)
                 lvl = StructuredLevel(cur, i, dims, cdims)
@@ -243,7 +270,10 @@ class AMGHierarchy:
         arrs = cur.dia_cache(max_diags)
         if arrs is None:
             return None
-        offs, vals = arrs       # values only feed the consistency check
+        # gate on the NARROWED diagonal set (stored all-zero diagonals
+        # dropped) so the plan, the host loop, and the resetup refresh
+        # (_require_dia narrows the same way) can never disagree
+        offs, vals, keep = _drop_zero_diagonals(*arrs)
         dims = getattr(cur, "grid_dims", None)
         n = cur.n_block_rows
         if dims is not None and int(np.prod(dims)) != n:
@@ -255,7 +285,7 @@ class AMGHierarchy:
             if offs3 is None or \
                     not stencil_values_consistent(offs3, vals, dims):
                 dims = None      # periodic/wrap stencil: decode is a lie
-        return offs, vals, dims
+        return offs, vals, dims, keep
 
     def _dia_device_eligible(self, cur: Matrix) -> bool:
         """Device-derivation gates on top of DIA eligibility: the GEO
@@ -305,7 +335,7 @@ class AMGHierarchy:
         inputs = self._dia_plan_inputs(cur)
         if inputs is None:
             return cur
-        offs, vals, dims = inputs
+        offs, vals, dims, keep = inputs
         steps, _bailed = plan_dia_hierarchy(
             offs, cur.n_block_rows, dims, self.max_levels,
             self.min_coarse_rows, self.coarsen_threshold,
@@ -315,8 +345,9 @@ class AMGHierarchy:
         curd = cur.device()
         if curd.fmt != "dia":
             return cur
+        dvals = curd.vals if keep is None else curd.vals[keep]
         with cpu_profiler("dia_device_derive"):
-            outs = derive_hierarchy_device(steps, offs, curd.vals)
+            outs = derive_hierarchy_device(steps, offs, dvals)
         return self._append_dia_levels(cur, steps, outs)
 
     def _reuse_dia_device(self, cur: Matrix, old) -> tuple:
@@ -340,7 +371,7 @@ class AMGHierarchy:
         inputs = self._dia_plan_inputs(cur)
         if inputs is None:
             return 0, cur
-        offs, _, dims = inputs
+        offs, _, dims, keep = inputs
         steps, _ = plan_dia_hierarchy(
             offs, cur.n_block_rows, dims, self.max_levels,
             self.min_coarse_rows, self.coarsen_threshold)
@@ -360,8 +391,9 @@ class AMGHierarchy:
         curd = cur.device()
         if curd.fmt != "dia":
             return 0, cur
+        dvals = curd.vals if keep is None else curd.vals[keep]
         with cpu_profiler("dia_device_derive"):
-            outs = derive_hierarchy_device(steps, offs, curd.vals)
+            outs = derive_hierarchy_device(steps, offs, dvals)
         return len(steps), self._append_dia_levels(cur, steps, outs)
 
     def _coarsen_once(self, cur: Matrix, idx: int):
@@ -546,7 +578,7 @@ class AMGHierarchy:
         inputs = self._dia_plan_inputs(cur, max_diags)
         if inputs is None:
             return _PAIRWISE_FALLBACK
-        offs_raw, vals_raw, dims = inputs
+        offs_raw, vals_raw, dims, _keep = inputs
         arrs = _narrow_dia(cur, (offs_raw, vals_raw))
         offs, vals = arrs
         if dims is not None and max(dims) > 1:

@@ -176,3 +176,75 @@ def test_resetup_refreshes_dia_hierarchy_on_device(monkeypatch):
     A2 = A * 2.0
     assert np.linalg.norm(b - A2 @ x2) / np.linalg.norm(b) < 1e-7
     np.testing.assert_allclose(x2, x1 / 2.0, rtol=1e-6)
+
+
+def test_zero_diagonal_does_not_demote_structured_coarsening():
+    """A stored all-zero diagonal whose offset breaks the stencil decode
+    (offset 4 is decode-ambiguous on an 8-grid) must be narrowed away
+    before the structured-vs-pairwise gate — round-3 ADVICE: it carries
+    no numerics, so it must not demote 2x2x2 coarsening to 1D pairing."""
+    from amgx_tpu.io import poisson7pt
+
+    A = poisson7pt(8, 8, 8)
+    n = A.shape[0]
+    offs, vals = A._amgx_dia
+    A._amgx_dia = (list(offs[:4]) + [4] + list(offs[4:]),
+                   np.insert(vals, 4, np.zeros(n), axis=0))
+    slv = amgx.create_solver(amgx.AMGConfig(
+        CFG_GEO.replace("amg:min_coarse_rows=32",
+                        "amg:min_coarse_rows=16")))
+    slv.setup(amgx.Matrix(A))
+    kinds = [s[0] for s in slv.preconditioner.hierarchy._structure]
+    assert kinds and kinds[0] == "structured", kinds
+    b = np.ones(n)
+    res = slv.solve(b)
+    assert _relres(A, res) < 1e-7
+
+
+def test_resetup_rejects_zero_diagonal_lighting_up():
+    """Value-only resetup that turns a narrowed-away zero diagonal
+    nonzero no longer matches the recorded structured decode: the reuse
+    path must raise a clear error, not crash or silently skip the wrap
+    check."""
+    from amgx_tpu.amg.pairwise import dia_to_scipy
+    from amgx_tpu.errors import AMGXError
+    from amgx_tpu.io import poisson7pt
+
+    A = poisson7pt(8, 8, 8)
+    n = A.shape[0]
+    offs, vals = A._amgx_dia
+    offs2 = list(offs[:4]) + [4] + list(offs[4:])
+    vals2 = np.insert(vals, 4, np.zeros(n), axis=0)
+    A._amgx_dia = (offs2, vals2)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        CFG_GEO + ", amg:structure_reuse_levels=-1"))
+    slv.setup(amgx.Matrix(A))
+    vals3 = vals2.copy()
+    vals3[4, 100:110] = -0.25
+    A3 = dia_to_scipy(offs2, vals3, n)
+    A3._amgx_dia = (offs2, vals3)
+    with pytest.raises(AMGXError):
+        slv.resetup(amgx.Matrix(A3))
+
+
+def test_pmis_makes_progress_on_uniform_ring():
+    """Every node of a ring graph has equal lambda — the old mod-2^20
+    hash could hand adjacent nodes identical weights and deadlock the
+    two-phase rounds; the bijective tie-breaker must always finish with
+    a maximal independent set."""
+    from amgx_tpu.amg.classical.selectors import _pmis
+
+    n = 4096
+    i = np.arange(n)
+    S = sp.csr_matrix(
+        (np.ones(2 * n), (np.r_[i, i], np.r_[(i + 1) % n, (i - 1) % n])),
+        shape=(n, n))
+    cf = _pmis(S, seed=7)
+    c = np.flatnonzero(cf)
+    assert len(c) > 0
+    # independent: no two adjacent C points
+    assert not np.any(cf[(c + 1) % n])
+    assert not np.any(cf[(c - 1) % n])
+    # maximal: every F point has a C neighbour
+    f = np.flatnonzero(cf == 0)
+    assert np.all(cf[(f + 1) % n] | cf[(f - 1) % n])
