@@ -1,0 +1,294 @@
+"""Device-side classical AMG setup for DIA (stencil) fine levels.
+
+Reference: the reference runs the WHOLE classical setup loop on the
+accelerator — strength, C/F selection, interpolation
+(``core/src/classical/classical_amg_level.cu:240-340``) and the Galerkin
+product via device hash SpGEMM (``base/src/csr_multiply.h:100-126``).
+
+TPU redesign: on the FINE level (which dominates setup time) the
+operator is a stencil in row-aligned DIA form, so every neighbour access
+in every classical algorithm is a STATICALLY SHIFTED SLICE — no gather,
+no sparse pattern, nothing the MXU/VPU can't stream:
+
+* AHAT/ALL strength: row-local max/compare over the (nd, n) value rows
+  (``strength/ahat.cu`` formula, including the max_row_sum weakening);
+* PMIS: the same synchronous two-phase rounds as
+  ``selectors._pmis`` — neighbour maxima over the symmetrised strength
+  graph are ``nd`` shifted slices; the strictly-distinct tie-break
+  weights are the SAME ``pmis_tie_breaker`` values, so CPU-precision
+  runs reproduce the host selector bit for bit;
+* D2: the substituted operator Â = A − A_Fs + A_Fs·W is a DIA×DIA
+  product — its offsets are pairwise sums of stencil offsets, each
+  output diagonal a handful of shifted multiply-adds;
+* D1 on Â + truncate_and_scale: row-local sums by sign, then a
+  ``jax.lax.top_k`` over the ≤ nd̂ coarse candidates per row (ties break
+  toward lower index = ascending offset, matching the host's stable
+  lexsort by CSR column order).
+
+ONE jitted executable computes cf + the truncated P rows; the host
+downloads (n·(1+Kp·2)) small arrays, assembles scipy P, and continues
+the (cheap) coarse levels as before.  Entries are "present" iff their
+stored DIA value is nonzero — identical semantics to the
+``dia_to_scipy`` assembly the host path would see.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def _shift(x, d: int, fill=0):
+    """y[i] = x[i+d] with ``fill`` outside — the DIA neighbour read."""
+    import jax.numpy as jnp
+    if d == 0:
+        return x
+    f = jnp.full((abs(d),), fill, x.dtype)
+    return jnp.concatenate([x[d:], f]) if d > 0 else \
+        jnp.concatenate([f, x[:d]])
+
+
+def ahat_plan(offs: Sequence[int]) -> Tuple[Tuple[int, ...], list]:
+    """Static Â structure: output offsets (union of stencil offsets and
+    pairwise off-diagonal sums) and, per output offset, the (k1, k2)
+    index pairs with offs[k1] + offs[k2] == e."""
+    offs = [int(o) for o in offs]
+    offd = [k for k, o in enumerate(offs) if o != 0]
+    sums = {}
+    for k1 in offd:
+        for k2 in offd:
+            sums.setdefault(offs[k1] + offs[k2], []).append((k1, k2))
+    out = sorted(set(offs) | set(sums))
+    return tuple(out), [sums.get(e, []) for e in out]
+
+
+@functools.lru_cache(maxsize=32)
+def _fine_fn(offs: Tuple[int, ...], n: int, theta: float,
+             max_row_sum: float, strength_all: bool, interp_d2: bool,
+             trunc_factor: float, max_elements: int, dtype_str: str,
+             seed: int):
+    """The jitted fine-level classical setup program (see module doc)."""
+    import jax
+    import jax.numpy as jnp
+
+    # the PMIS tie-break permutation (selectors.pmis_tie_breaker) is
+    # computed ON DEVICE — int64 is exact for a·i < 2^50, and a 2 MB
+    # fraction upload through the tunnel would cost more than the rest
+    # of the program
+    a_mult = 2654435761
+    while np.gcd(a_mult, n) != 1:
+        a_mult += 1
+
+    offs = [int(o) for o in offs]
+    nd = len(offs)
+    k0 = offs.index(0)
+    offd = [k for k in range(nd) if k != k0]
+    kneg = {o: k for k, o in enumerate(offs)}      # offset -> row index
+    dt = jnp.dtype(dtype_str)
+    hat_offs, hat_pairs = ahat_plan(offs) if interp_d2 \
+        else (tuple(offs), [[] for _ in offs])
+    nh = len(hat_offs)
+    h0 = hat_offs.index(0)
+    Kp = max_elements if max_elements > 0 else nh - 1
+
+    def strength(vals):
+        diag = vals[k0]
+        sgn = jnp.sign(diag)
+        sgn = jnp.where(sgn == 0, jnp.asarray(1.0, dt), sgn)
+        present = [vals[k] != 0 for k in range(nd)]
+        if strength_all:
+            return [present[k] if k != k0 else jnp.zeros_like(present[k])
+                    for k in range(nd)]
+        ninf = jnp.asarray(-jnp.inf, dt)
+        meas = [jnp.where(present[k], -vals[k] * sgn, ninf) for k in offd]
+        meas_abs = [jnp.where(present[k], jnp.abs(vals[k]), ninf)
+                    for k in offd]
+        rowmax = functools.reduce(jnp.maximum, meas)
+        no_neg = ~(rowmax > 0)
+        rowmax_abs = functools.reduce(jnp.maximum, meas_abs)
+        rowmax_f = jnp.where(no_neg, rowmax_abs, rowmax)
+        strong = {}
+        for j, k in enumerate(offd):
+            mf = jnp.where(no_neg, meas_abs[j], meas[j])
+            strong[k] = (mf >= theta * rowmax_f) & (mf > 0)
+        if max_row_sum < 1.0 + 1e-12:
+            rs = sum(vals[k] for k in range(nd))
+            dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
+            weak = jnp.abs(rs / dsafe) > max_row_sum
+            strong = {k: s & ~weak for k, s in strong.items()}
+        return [strong.get(k, jnp.zeros(n, dtype=bool))
+                for k in range(nd)]
+
+    def pmis(S):
+        i64 = jnp.arange(n, dtype=jnp.int64)
+        perm = (i64 * a_mult + (seed % n)) % n
+        frac = (perm.astype(jnp.float64) + 1.0) / float(n + 2)
+        # symmetrised graph row masks: G_d = S_d | shift(S_{-d}, d)
+        G = []
+        for k in range(nd):
+            if k == k0:
+                G.append(jnp.zeros(n, dtype=bool))
+                continue
+            g = S[k]
+            ko = kneg.get(-offs[k])
+            if ko is not None:
+                g = g | _shift(S[ko], offs[k], False)
+            G.append(g)
+        # lam[j] = #rows strongly depending on j = Σ_k shift(S_k, -off_k)
+        lam = sum(_shift(S[k].astype(jnp.float64), -offs[k])
+                  for k in offd)
+        w = lam + frac                      # strictly distinct (f64)
+        deg = sum(G[k].astype(jnp.int32) for k in offd)
+        state0 = jnp.where(deg == 0, 0, -1).astype(jnp.int32)
+
+        def round_(state):
+            und = state == -1
+            ninf = jnp.asarray(-jnp.inf, jnp.float64)
+            max_nb = functools.reduce(jnp.maximum, [
+                jnp.where(und & G[k] & _shift(und, offs[k], False),
+                          _shift(w, offs[k], ninf), ninf)
+                for k in offd])
+            become_c = und & ((max_nb == -jnp.inf) | (w > max_nb))
+            state = jnp.where(become_c, 1, state)
+            just_c = become_c
+            near_c = functools.reduce(jnp.logical_or, [
+                G[k] & _shift(just_c, offs[k], False) for k in offd])
+            return jnp.where((state == -1) & near_c, 0, state)
+
+        state = jax.lax.while_loop(
+            lambda s: jnp.any(s == -1), lambda s: round_(s), state0)
+        return state == 1
+
+    def ahat(vals, S, cf):
+        """Â rows (nh, n): A − A_Fs + A_Fs·W (D2) or A itself (D1)."""
+        cf_sh = {k: _shift(cf, offs[k], False) for k in range(nd)}
+        if not interp_d2:
+            return [vals[k] for k in range(nd)], cf_sh
+        zero = jnp.zeros(n, dtype=dt)
+        A_fs = {k: jnp.where(S[k] & ~cf_sh[k], vals[k], zero)
+                for k in offd}
+        in_ck = {k: S[k] & cf_sh[k] for k in offd}
+        sum_ck = sum(jnp.where(in_ck[k], vals[k], zero) for k in offd)
+        cksafe = jnp.where(sum_ck == 0, jnp.asarray(1.0, dt), sum_ck)
+        W = {k: jnp.where(in_ck[k], vals[k] / cksafe, zero)
+             for k in offd}
+        rows = []
+        for e_i, e in enumerate(hat_offs):
+            acc = zero
+            if e in kneg:
+                k = kneg[e]
+                acc = vals[k] - (A_fs[k] if k in A_fs else zero)
+            for (k1, k2) in hat_pairs[e_i]:
+                acc = acc + A_fs[k1] * _shift(W[k2], offs[k1])
+            rows.append(acc)
+        cf_hat = {e_i: _shift(cf, hat_offs[e_i], False)
+                  for e_i in range(nh)}
+        return rows, cf_hat
+
+    def d1_weights(hat, cf_sh, cf):
+        """Direct interpolation on Â with ALL strength (every stored
+        entry strong — matching interpolators.D2's host composition)."""
+        zero = jnp.zeros(n, dtype=dt)
+        diag = hat[h0]
+        dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
+        ho = [e_i for e_i in range(nh) if e_i != h0]
+        neg = {e_i: hat[e_i] < 0 for e_i in ho}
+        pos = {e_i: hat[e_i] > 0 for e_i in ho}
+        in_ci = {e_i: (hat[e_i] != 0) & cf_sh[e_i] for e_i in ho}
+        s_all_neg = sum(jnp.where(neg[e], hat[e], zero) for e in ho)
+        s_all_pos = sum(jnp.where(pos[e], hat[e], zero) for e in ho)
+        s_c_neg = sum(jnp.where(in_ci[e] & neg[e], hat[e], zero)
+                      for e in ho)
+        s_c_pos = sum(jnp.where(in_ci[e] & pos[e], hat[e], zero)
+                      for e in ho)
+        one = jnp.asarray(1.0, dt)
+        alpha = jnp.where(s_c_neg != 0,
+                          s_all_neg / jnp.where(s_c_neg == 0, one,
+                                                s_c_neg), zero)
+        beta = jnp.where(s_c_pos != 0,
+                         s_all_pos / jnp.where(s_c_pos == 0, one,
+                                               s_c_pos), zero)
+        f_row = ~cf
+        ws = []
+        for e_i in ho:
+            coef = jnp.where(neg[e_i], alpha, beta)
+            w = -coef * hat[e_i] / dsafe
+            ws.append(jnp.where(in_ci[e_i] & f_row, w, zero))
+        return ws, ho
+
+    def truncate(ws):
+        """truncate_and_scale parity: drop small entries, keep the
+        ``max_elements`` largest per row, rescale to preserve row sums."""
+        W = jnp.stack(ws, axis=1)                     # (n, nh-1)
+        absw = jnp.abs(W)
+        old_sum = jnp.sum(W, axis=1)
+        keep = W != 0
+        if trunc_factor < 1.0:
+            rowmax = jnp.max(absw, axis=1)
+            keep &= absw >= trunc_factor * rowmax[:, None]
+        if max_elements > 0:
+            # rank by |w| descending, ties to lower index (= ascending
+            # offset — the host lexsort's stable order)
+            topv, topi = jax.lax.top_k(jnp.where(keep, absw, -1.0),
+                                       min(Kp, W.shape[1]))
+            kv = jnp.take_along_axis(W, topi, axis=1)
+            kv = jnp.where(topv > 0, kv, 0.0)
+        else:
+            kv, topi = jnp.where(keep, W, 0.0), \
+                jnp.broadcast_to(jnp.arange(W.shape[1]), W.shape)
+        new_sum = jnp.sum(kv, axis=1)
+        scale = jnp.where(new_sum != 0,
+                          old_sum / jnp.where(new_sum == 0, 1.0,
+                                              new_sum), 1.0)
+        return kv * scale[:, None], topi
+
+    def run(vals):
+        S = strength(vals)
+        cf = pmis(S)
+        hat, cf_sh = ahat(vals, S, cf)
+        ws, ho = d1_weights(hat, cf_sh, cf)
+        pv, pi = truncate(ws)
+        # int8 index outputs: the host download crosses a ~10-100 MB/s
+        # tunnel (pv keeps the compute dtype — f32 on chip, f64 in CPU
+        # parity tests)
+        return cf.astype(jnp.int8), pv, pi.astype(jnp.int8)
+
+    import jax
+    return jax.jit(run), hat_offs, Kp
+
+
+def classical_fine_device(offs: Sequence[int], dvals, n: int,
+                          theta: float, max_row_sum: float,
+                          strength_all: bool, interp_d2: bool,
+                          trunc_factor: float, max_elements: int,
+                          seed: int = 7):
+    """Run the device fine-level classical setup; returns host-side
+    ``(cf_map int8 (n,), P scipy csr)``."""
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+
+    fn, hat_offs, Kp = _fine_fn(
+        tuple(int(o) for o in offs), n, float(theta), float(max_row_sum),
+        bool(strength_all), bool(interp_d2), float(trunc_factor),
+        int(max_elements), jnp.dtype(dvals.dtype).str, int(seed))
+    cf_d, pv_d, pi_d = fn(dvals)
+    cf, pv, pi = jax.device_get((cf_d, pv_d, pi_d))
+    cnum = np.cumsum(cf) - 1
+    nc = int(cf.sum())
+    ho = [e_i for e_i in range(len(hat_offs))
+          if hat_offs[e_i] != 0]
+    off_of_slot = np.asarray([hat_offs[e] for e in ho], dtype=np.int64)
+    rows = np.repeat(np.arange(n, dtype=np.int64), pv.shape[1])
+    dest = rows + off_of_slot[pi.reshape(-1)]
+    vals = pv.reshape(-1)
+    live = vals != 0
+    rows, dest, vals = rows[live], dest[live], vals[live]
+    Pi = np.concatenate([rows, np.flatnonzero(cf)])
+    Pj = np.concatenate([cnum[dest], cnum[np.flatnonzero(cf)]])
+    Pv = np.concatenate([vals.astype(np.float64), np.ones(nc)])
+    P = sp.csr_matrix((Pv, (Pi, Pj)), shape=(n, nc))
+    P.sum_duplicates()
+    P.sort_indices()
+    return cf, P

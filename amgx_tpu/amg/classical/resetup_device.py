@@ -1,0 +1,309 @@
+"""Device-numeric classical resetup: value-only Galerkin refresh.
+
+Reference: the reference's setup keeps the whole hierarchy on the
+accelerator, so ``AMGX_solver_resetup`` with reused structure refreshes
+the Galerkin products with its device SpGEMM
+(``base/include/csr_multiply.h:100-126`` — the numeric phase reuses the
+symbolic structure).
+
+TPU redesign (host-symbolic / device-numeric):
+
+* at SETUP time (gated on ``structure_reuse_levels != 0``) each
+  classical level records a :class:`LevelPlan` — the frozen P values,
+  the Aᴾ and R·Aᴾ triple lists (flat ``out[t_out] += a[t_a]·b[t_b]``
+  schedules), the coarse pattern, and gather maps from coarse CSR value
+  order into the level's device-pack value slots (built with an
+  index-probe pack so ANY pack layout maps exactly);
+* at RESETUP time the refreshed fine values flow DOWN the hierarchy as
+  two ``jax.ops.segment_sum`` contractions per level — no scipy Galerkin
+  runs, and only the tiny coarsest matrix is ever downloaded (for the
+  dense coarse factorisation).  The plan index arrays upload once, on
+  the first resetup, and stay device-resident.
+
+P values stay FROZEN across value-only resetups (the recorded-structure
+contract the host replay path also honors); a changed-sparsity refresh
+falls back to the host path via the caller's gates.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+
+def _range_concat(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """[starts[0]..+counts[0], starts[1]..+counts[1], ...] flattened."""
+    csum = np.concatenate([[0], np.cumsum(counts)])
+    return (np.arange(csum[-1], dtype=np.int64)
+            - np.repeat(csum[:-1], counts)
+            + np.repeat(starts.astype(np.int64), counts))
+
+
+def _spgemm_triples(Aptr, Aind, Bptr, Bind, n_rows: int, n_cols_B: int):
+    """Symbolic product C = A·B as a triple schedule: returns
+    (tA, tB, t_out, C_indptr, C_indices) with
+    ``C.data[t_out[q]] += A.data[tA[q]] * B.data[tB[q]]``."""
+    rowlenB = np.diff(Bptr)
+    cnt = rowlenB[Aind]
+    tA = np.repeat(np.arange(len(Aind), dtype=np.int64), cnt)
+    tB = _range_concat(Bptr[Aind], cnt)
+    i_of = np.repeat(
+        np.repeat(np.arange(n_rows, dtype=np.int64), np.diff(Aptr)), cnt)
+    j_of = Bind[tB].astype(np.int64)
+    key = i_of * n_cols_B + j_of
+    ukey, inv = np.unique(key, return_inverse=True)
+    C_rows = (ukey // n_cols_B).astype(np.int64)
+    C_indices = (ukey % n_cols_B).astype(np.int32)
+    C_indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(C_rows, minlength=n_rows))]
+    ).astype(np.int64)
+    return (tA, tB, inv.astype(np.int64), C_indptr, C_indices)
+
+
+def _pack_value_maps(Ac: sp.csr_matrix, dtype):
+    """Gather maps from CSR value order into every value-carrying array
+    of the level's device pack, via an index-probe pack: pack the matrix
+    with data = entry-index+1 and read the placements back.  Exact for
+    nnz < 2^24 (f32 integers).  Returns (meta, {name: flat_map}) with
+    -1 marking padding slots."""
+    from ...core.matrix import pack_host_arrays
+    probe = Ac.copy()
+    probe.data = (np.arange(Ac.nnz) + 1).astype(np.float64)
+    # mirror core.matrix.batch_upload's pack parameters exactly —
+    # INCLUDING the dtype: the win/shift layouts only engage for f32
+    # packs, and the template was built at the hierarchy's device dtype
+    dia = None
+    if Ac.shape[0] == Ac.shape[1]:
+        from ...core.matrix import dia_arrays
+        dia = dia_arrays(probe, max_diags=48)
+    if dia is not None and len(dia[0]):
+        offs, vals = dia
+        maps = {"vals": np.rint(vals).astype(np.int64) - 1}
+        diag_probe = np.zeros(Ac.shape[0])
+        zpos = list(offs).index(0) if 0 in list(offs) else None
+        if zpos is not None:
+            diag_probe = vals[zpos]
+        maps["diag"] = np.rint(diag_probe).astype(np.int64) - 1
+        meta = dict(fmt="dia", offsets=[int(o) for o in offs],
+                    n_cols=Ac.shape[1])
+        return meta, maps
+    arrays, meta = pack_host_arrays(probe, 1, dtype,
+                                    dia_max_diags=0, lean_win=True)
+    if meta.get("fmt") == "dense":
+        # the device pack is the DENSIFIED matrix: map in its (n, m)
+        # layout (padding slots -1)
+        n, m = probe.shape
+        dmap = np.full((n, m), -1, dtype=np.int64)
+        rows = np.repeat(np.arange(n), np.diff(probe.indptr))
+        dmap[rows, probe.indices] = np.arange(probe.nnz)
+        diag_map = np.full(n, -1, dtype=np.int64)
+        dd = np.rint(np.asarray(arrays["diag"], dtype=np.float64)
+                     ).astype(np.int64) - 1
+        diag_map[:] = dd
+        return meta, {"vals": dmap, "diag": diag_map}
+    maps = {}
+    for name in ("vals", "win_vals", "diag", "sh_vals"):
+        if arrays.get(name) is not None:
+            maps[name] = np.rint(np.asarray(arrays[name],
+                                            dtype=np.float64)
+                                 ).astype(np.int64) - 1
+    # VALUE-DEPENDENT structure must match the template verbatim (the
+    # shift pack's class layout follows the nonzero set, which differs
+    # between an all-nonzero probe and real values with cancellations);
+    # cols/win_codes/win_blocks are pattern-only and need no check
+    meta["_probe_struct"] = {
+        k: np.asarray(arrays[k]) for k in ("sh_meta",)
+        if arrays.get(k) is not None}
+    return meta, maps
+
+
+@dataclasses.dataclass
+class LevelPlan:
+    """One classical level's device-refresh schedule (host arrays; the
+    device copies upload lazily on first use)."""
+    P_data: np.ndarray            # frozen P values (CSR order)
+    perm_RP: np.ndarray           # R.data = P.data[perm_RP]
+    ap: tuple                     # (tA, tP, t_out, nnz_AP)
+    ac: tuple                     # (tR, tAP, t_out2, nnz_Ac)
+    Ac_indptr: np.ndarray
+    Ac_indices: np.ndarray
+    Ac_shape: tuple
+    pack_meta: dict
+    pack_maps: dict
+    #: the ORIGINAL DeviceMatrix of this coarse level — its structure
+    #: arrays (cols/codes/blocks) are reused verbatim; only the value
+    #: fields are replaced at refresh time
+    template: object = None
+    _dev: Optional[dict] = None
+
+    def device_arrays(self, dtype):
+        import jax
+        import jax.numpy as jnp
+        if self._dev is None:
+            tA, tP, to1, nAP = self.ap
+            tR, tAP, to2, nAc = self.ac
+            small = (lambda a: a.astype(np.int32)
+                     if a.size == 0 or a.max(initial=0) < 2**31
+                     else a)
+            host = dict(P=self.P_data.astype(dtype), perm=small(self.perm_RP),
+                        tA=small(tA), tP=small(tP), to1=small(to1),
+                        tR=small(tR), tAP=small(tAP), to2=small(to2),
+                        **{f"map_{k}": small(np.ravel(v) + 1)
+                           for k, v in self.pack_maps.items()})
+            keys = sorted(host)
+            devs = jax.device_put([host[k] for k in keys])
+            self._dev = dict(zip(keys, devs))
+        return self._dev
+
+
+def build_level_plan(A_csr: sp.csr_matrix, P_csr: sp.csr_matrix,
+                     Ac_csr: sp.csr_matrix, dtype,
+                     template=None) -> Optional[LevelPlan]:
+    """Symbolic schedules for one level; None when the level is out of
+    the probe-exactness budget or the probe pack disagrees with the
+    level's actual device pack layout."""
+    A = sp.csr_matrix(A_csr)
+    A.sort_indices()
+    P = sp.csr_matrix(P_csr)
+    P.sort_indices()
+    n, nc = P.shape
+    if max(A.nnz, P.nnz, Ac_csr.nnz) >= (1 << 24):
+        return None
+    tA, tP, to1, APptr, APind = _spgemm_triples(
+        A.indptr, A.indices, P.indptr, P.indices, n, nc)
+    nnzAP = len(APind)
+    # R = P^T with the data permutation recorded
+    Pprobe = P.copy()
+    Pprobe.data = np.arange(P.nnz).astype(np.float64)
+    R = sp.csr_matrix(Pprobe.T)
+    R.sort_indices()
+    perm_RP = np.rint(R.data).astype(np.int64)
+    tR, tAP, to2, Acptr, Acind = _spgemm_triples(
+        R.indptr, R.indices, APptr, APind, nc, nc)
+    # the schedule's coarse pattern must equal the pattern the setup
+    # actually packed — else the value maps would scatter into the
+    # wrong slots
+    Acs = sp.csr_matrix(Ac_csr)
+    Acs.sort_indices()
+    if not (np.array_equal(Acptr, Acs.indptr.astype(np.int64))
+            and np.array_equal(Acind, Acs.indices.astype(np.int32))):
+        return None
+    meta, maps = _pack_value_maps(Acs, dtype)
+    if template is not None:
+        if meta["fmt"] != template.fmt:
+            return None
+        if meta["fmt"] == "dia" and \
+                tuple(meta["offsets"]) != tuple(template.dia_offsets):
+            return None        # value-dependent offset narrowing diverged
+        for name, hmap in maps.items():
+            arr = getattr(template, name, None)
+            if arr is None or tuple(arr.shape) != tuple(hmap.shape):
+                return None
+        # structure arrays must be IDENTICAL, not just same-shaped: a
+        # value-dependent layout (shift class slots) that merely lands
+        # in the same padded bucket would scatter refreshed values into
+        # wrong slots
+        for name, parr in meta.pop("_probe_struct", {}).items():
+            tarr = getattr(template, name, None)
+            if tarr is None or not np.array_equal(np.asarray(tarr),
+                                                  parr):
+                return None
+    else:
+        meta.pop("_probe_struct", None)
+    return LevelPlan(
+        P_data=np.asarray(P.data), perm_RP=perm_RP,
+        ap=(tA, tP, to1, nnzAP), ac=(tR, tAP, to2, Acs.nnz),
+        Ac_indptr=Acs.indptr.copy(), Ac_indices=Acs.indices.copy(),
+        Ac_shape=Acs.shape, pack_meta=meta, pack_maps=maps,
+        template=template)
+
+
+def fine_dia_to_csr_map(A_csr: sp.csr_matrix, offs) -> np.ndarray:
+    """csr_data[j] = dia_vals.reshape(-1)[map[j]] for a row-aligned DIA
+    pack with diagonal offsets ``offs``."""
+    A = sp.csr_matrix(A_csr)
+    A.sort_indices()
+    n = A.shape[0]
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(A.indptr))
+    d = A.indices.astype(np.int64) - rows
+    offs = np.asarray([int(o) for o in offs], dtype=np.int64)
+    k = np.searchsorted(offs, d)
+    k = np.minimum(k, len(offs) - 1)
+    if not np.all(offs[k] == d):
+        raise ValueError("CSR entry outside the DIA offset set")
+    return (k * n + rows).astype(np.int64)
+
+
+@functools.lru_cache(maxsize=None)
+def _refresh_fn(nAP: int, nAc: int):
+    import jax
+
+    @jax.jit
+    def go(vA, P, perm, tA, tP, to1, tR, tAP, to2):
+        vAP = jax.ops.segment_sum(vA[tA] * P[tP], to1,
+                                  num_segments=nAP)
+        vR = P[perm]
+        return jax.ops.segment_sum(vR[tR] * vAP[tAP], to2,
+                                   num_segments=nAc)
+
+    return go
+
+
+@functools.lru_cache(maxsize=1)
+def _fill_fn():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def fill(vAc, m):
+        ext = jnp.concatenate([jnp.zeros((1,), vAc.dtype), vAc])
+        return ext[m]
+
+    return fill
+
+
+def refresh_level(plan: LevelPlan, vA, dtype):
+    """Device value refresh of one level: returns
+    (vAc (nnz_Ac,), refreshed value arrays per pack field)."""
+    d = plan.device_arrays(dtype)
+    vAc = _refresh_fn(plan.ap[3], plan.ac[3])(
+        vA, d["P"], d["perm"], d["tA"], d["tP"], d["to1"],
+        d["tR"], d["tAP"], d["to2"])
+    fill = _fill_fn()
+    fields = {}
+    for name, hmap in plan.pack_maps.items():
+        fields[name] = fill(vAc, d[f"map_{name}"]).reshape(hmap.shape)
+    return vAc, fields
+
+
+def assemble_refreshed_matrix(plan: LevelPlan, vAc, fields, dtype):
+    """Matrix wrapper around the refreshed level: the ORIGINAL device
+    pack's structure arrays with the value fields replaced; host CSR
+    downloads lazily (the dense coarsest factorisation is the only
+    consumer)."""
+    import jax.numpy as jnp
+
+    from ...core.matrix import Matrix
+    tmpl = plan.template
+    repl = {name: fields[name].astype(tmpl.diag.dtype)
+            for name in ("vals", "win_vals", "diag", "sh_vals")
+            if name in fields and getattr(tmpl, name) is not None}
+    pack = dataclasses.replace(tmpl, **repl)
+    m = Matrix()
+    m.block_dim = 1
+    m.dtype = np.dtype(dtype)
+    m.device_dtype = np.dtype(dtype)
+    m._n_dia = (plan.Ac_shape[0], plan.Ac_shape[1])
+    m._csr_pattern = (plan.Ac_indptr, plan.Ac_indices, plan.Ac_shape)
+    m._csr_vals_dev = vAc
+    m._device = pack
+    m._device_dtype = np.dtype(dtype)
+    if pack.fmt == "dia":
+        diag = pack.diag
+        m._dinv_dev = (np.dtype(dtype),
+                       jnp.where(diag != 0, 1.0 /
+                                 jnp.where(diag == 0, 1.0, diag), 0.0))
+    return m

@@ -55,6 +55,43 @@ def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     return m
 
 
+def _symbolic_pad_galerkin(Ac_host, Asc, P_host) -> sp.csr_matrix:
+    """Expand a numeric Galerkin product to its full SYMBOLIC pattern.
+
+    scipy's SpGEMM prunes exact-cancellation entries; value-only device
+    resetup (classical/resetup_device.py) refreshes values inside a
+    FROZEN structure, so the structural slots must exist even where the
+    current values cancel — else a refreshed coupling would be silently
+    dropped."""
+    def ones(M):
+        M = sp.csr_matrix(M)
+        return sp.csr_matrix((np.ones(M.nnz), M.indices, M.indptr),
+                             shape=M.shape)
+
+    Pb = ones(P_host)
+    patt = sp.csr_matrix(Pb.T @ ones(Asc) @ Pb)
+    patt.sum_duplicates()
+    patt.sort_indices()
+    Ac = sp.csr_matrix(Ac_host)
+    Ac.sum_duplicates()
+    Ac.sort_indices()
+    # fill the numeric values into the symbolic structure (scipy's
+    # sparse "+" prunes zero-valued entries, so a zero-pad add loses
+    # exactly the slots this function exists to keep)
+    nc = patt.shape[1]
+    rows_p = np.repeat(np.arange(patt.shape[0], dtype=np.int64),
+                       np.diff(patt.indptr))
+    rows_a = np.repeat(np.arange(Ac.shape[0], dtype=np.int64),
+                       np.diff(Ac.indptr))
+    key_p = rows_p * nc + patt.indices
+    key_a = rows_a * nc + Ac.indices
+    pos = np.searchsorted(key_p, key_a)
+    data = np.zeros(patt.nnz, dtype=Ac.data.dtype)
+    data[pos] = Ac.data
+    return sp.csr_matrix((data, patt.indices, patt.indptr),
+                         shape=Ac.shape)
+
+
 def _drop_zero_diagonals(offs, vals: np.ndarray):
     """Drop stored all-zero diagonals (the main diagonal always stays).
 
@@ -169,8 +206,60 @@ class AMGHierarchy:
     def _setup_fresh(self, A: Matrix):
         self.levels = []
         self._structure = []
+        self._cla_plans = None
         cur = self._build_levels(A)
         self._setup_smoothers_and_coarse(cur)
+        if self.structure_reuse_levels != 0:
+            with cpu_profiler("classical_resetup_plans"):
+                self._build_classical_plans(A, cur)
+
+    def _build_classical_plans(self, A: Matrix, coarsest: Matrix):
+        """Host-symbolic resetup schedules (classical/resetup_device.py)
+        — built only when the user configured structure reuse, so a
+        later ``AMGX_solver_resetup`` refreshes every Galerkin product
+        ON DEVICE (csr_multiply.h:100-126 numeric-phase analog)."""
+        if A.dist is not None or not self.levels or \
+                not all(s[0] == "classical" for s in self._structure):
+            return
+        if 0 < self.structure_reuse_levels < len(self.levels):
+            # partial reuse re-coarsens a suffix fresh — the device
+            # refresh path can't consume these plans; don't pay the
+            # symbolic build for dead weight
+            return
+        Ad = A.device()
+        if Ad.fmt != "dia":
+            return
+        from .classical.resetup_device import (build_level_plan,
+                                               fine_dia_to_csr_map)
+        dtype = np.dtype(A.device_dtype or A.dtype)
+        try:
+            fine_csr = A.scalar_csr()
+            fine_map = fine_dia_to_csr_map(fine_csr, Ad.dia_offsets)
+        except Exception:
+            return
+        plans = []
+        cur_csr = fine_csr
+        for i, (_, data) in enumerate(self._structure):
+            P_host, = data
+            nxt = self.levels[i + 1].A if i + 1 < len(self.levels) \
+                else coarsest
+            Ac_csr = sp.csr_matrix(nxt.host)
+            plan = build_level_plan(cur_csr, P_host, Ac_csr, dtype,
+                                    template=nxt.device())
+            if plan is None:
+                return
+            plans.append(plan)
+            cur_csr = Ac_csr
+        # boolean mask of the DIA slots the recorded CSR pattern maps —
+        # a resetup value lighting up OUTSIDE it must fall back to the
+        # host replay (the frozen schedule cannot carry the new entry)
+        mask = np.zeros(len(Ad.dia_offsets) * A.n_block_rows, dtype=bool)
+        mask[fine_map] = True
+        self._cla_plans = dict(levels=plans,
+                               fine_offsets=tuple(Ad.dia_offsets),
+                               fine_n=A.n_block_rows,
+                               fine_map=fine_map, fine_map_dev=None,
+                               fine_mask=mask)
 
     def _build_levels(self, cur: Matrix) -> Matrix:
         """Run the fresh coarsening loop from ``cur``, appending to
@@ -207,6 +296,8 @@ class AMGHierarchy:
         old = list(zip(self.levels, self._structure))
         self.levels = []
         self._structure = []
+        if self._reuse_classical_device(cur, old):
+            return
         consumed, cur = self._reuse_dia_device(cur, old)
         for i, (level, struct) in enumerate(old):
             if i < consumed:
@@ -246,7 +337,13 @@ class AMGHierarchy:
             else:
                 P_host, = data
                 R_host = sp.csr_matrix(P_host.T)
-                Ac_host = sp.csr_matrix(R_host @ cur.scalar_csr() @ P_host)
+                Asc_r = cur.scalar_csr()
+                Ac_host = sp.csr_matrix(R_host @ Asc_r @ P_host)
+                if self.algorithm == "CLASSICAL":
+                    # keep the symbolic pattern stable across resetups
+                    # so recorded device plans stay applicable
+                    Ac_host = _symbolic_pad_galerkin(Ac_host, Asc_r,
+                                                     P_host)
                 lvl = ClassicalLevel(cur, i,
                                      _child_matrix(cur, P_host),
                                      _child_matrix(cur, R_host))
@@ -257,6 +354,63 @@ class AMGHierarchy:
         # rebuild any remaining levels fresh from the reused prefix
         cur = self._build_levels(cur)
         self._setup_smoothers_and_coarse(cur)
+        # a fresh-rebuilt suffix may change coarse patterns: recorded
+        # device-resetup plans are only kept when the structure still
+        # matches what they were built for
+        plans = getattr(self, "_cla_plans", None)
+        if plans is not None and (
+                len(self._structure) != len(plans["levels"])
+                or any(s[0] != "classical" for s in self._structure)):
+            self._cla_plans = None
+
+    def _reuse_classical_device(self, cur: Matrix, old) -> bool:
+        """Value-only refresh of a fully-reused classical hierarchy ON
+        DEVICE (classical/resetup_device.py): two segment-sum
+        contractions per level, no host Galerkin.  False → the generic
+        host replay takes over (partial reuse, changed offsets, no
+        recorded plans)."""
+        plans = getattr(self, "_cla_plans", None)
+        if not plans or len(plans["levels"]) != len(old):
+            return False
+        if 0 < self.structure_reuse_levels < len(old):
+            return False          # partial reuse: host replay handles it
+        if any(struct[0] != "classical" for _, struct in old):
+            return False
+        curd = cur.device()
+        if curd.fmt != "dia" or \
+                tuple(curd.dia_offsets) != plans["fine_offsets"] or \
+                cur.n_block_rows != plans["fine_n"]:
+            # same offsets but different n would gather out of range —
+            # and JAX clamps indices silently
+            return False
+        arrs = cur.dia_cache()
+        if arrs is None or np.any(
+                arrs[1].reshape(-1)[~plans["fine_mask"]]):
+            # a value lit up a slot the recorded CSR pattern never
+            # mapped: the frozen schedule can't represent it — the host
+            # replay recomputes patterns and stays correct
+            return False
+        import jax
+        from .classical.resetup_device import (assemble_refreshed_matrix,
+                                               refresh_level)
+        dtype = np.dtype(cur.device_dtype or cur.dtype)
+        if plans["fine_map_dev"] is None:
+            plans["fine_map_dev"] = jax.device_put(
+                plans["fine_map"].astype(np.int32))
+        with cpu_profiler("classical_device_resetup"):
+            vA = curd.vals.reshape(-1)[plans["fine_map_dev"]]
+            for i, (level, struct) in enumerate(old):
+                plan = plans["levels"][i]
+                vAc, fields = refresh_level(plan, vA, dtype)
+                nxt = assemble_refreshed_matrix(plan, vAc, fields, dtype)
+                lvl = ClassicalLevel(cur, i, level.P, level.R,
+                                     getattr(level.A, "cf_map", None))
+                self.levels.append(lvl)
+                self._structure.append(struct)
+                cur = nxt
+                vA = vAc
+        self._setup_smoothers_and_coarse(cur)
+        return True
 
     def _dia_plan_inputs(self, cur: Matrix, max_diags: int = 48):
         """(offsets, host vals, dims-or-None) of a DIA-eligible matrix —
@@ -267,6 +421,25 @@ class AMGHierarchy:
         drift.  None when ``cur`` has no DIA decomposition."""
         if cur.block_dim != 1 or cur.n_block_rows < 2:
             return None
+        n = cur.n_block_rows
+        hint = getattr(cur, "_dia_offsets_hint", None)
+        if hint is not None and getattr(cur, "_stencil_consistent", False):
+            # device-GENERATED stencils (io/device_gen.py) declare their
+            # offsets and consistency analytically — the plan never
+            # materialises host values (vals=None; the device derive
+            # consumes the on-chip pack, the host fallback re-fetches)
+            offs = [int(o) for o in hint]
+            if len(offs) > max_diags:
+                return None
+            dims = getattr(cur, "grid_dims", None)
+            if dims is not None and int(np.prod(dims)) != n:
+                dims = None
+            if dims is None:
+                dims = infer_grid_dims(offs, n)
+            if dims is not None and max(dims) > 1 and \
+                    decompose_offsets(offs, dims) is None:
+                dims = None
+            return offs, None, dims, None
         arrs = cur.dia_cache(max_diags)
         if arrs is None:
             return None
@@ -275,7 +448,6 @@ class AMGHierarchy:
         # (_require_dia narrows the same way) can never disagree
         offs, vals, keep = _drop_zero_diagonals(*arrs)
         dims = getattr(cur, "grid_dims", None)
-        n = cur.n_block_rows
         if dims is not None and int(np.prod(dims)) != n:
             dims = None
         if dims is None:
@@ -396,6 +568,60 @@ class AMGHierarchy:
             outs = derive_hierarchy_device(steps, offs, dvals)
         return len(steps), self._append_dia_levels(cur, steps, outs)
 
+    def _coarsen_classical_device_fine(self, cur: Matrix, idx: int,
+                                       strength, sel_name: str,
+                                       interp_name: str):
+        """Device-side classical coarsening for DIA-eligible levels
+        (classical/device_fine.py); None when any gate fails — the host
+        path is the fallback, not an error."""
+        if sel_name != "PMIS" or interp_name not in ("D1", "D2"):
+            return None
+        sname = getattr(strength, "config_name", "")
+        if sname not in ("AHAT", "ALL"):
+            return None
+        inputs = self._dia_plan_inputs(cur, max_diags=16)
+        if inputs is None:
+            return None
+        offs, _, _, keep = inputs
+        if any(-o not in offs for o in offs):
+            # the device PMIS symmetrises the strength graph via the
+            # opposite-offset rows; a one-sided stencil would lose its
+            # reverse influence edges — host path handles it
+            return None
+        curd = cur.device()
+        if curd.fmt != "dia":
+            return None
+        from .classical.device_fine import ahat_plan, classical_fine_device
+        if interp_name == "D2" and len(ahat_plan(offs)[0]) > 48:
+            return None
+        dvals = curd.vals if keep is None else curd.vals[keep]
+        from ..utils.determinism import SESSION_SEED
+        seed = 7 if bool(self.cfg.get("determinism_flag")) \
+            else SESSION_SEED
+        g = lambda p: self.cfg.get(p, self.scope)
+        with cpu_profiler("classical_fine_device"):
+            cf_map, P_host = classical_fine_device(
+                offs, dvals, cur.n_block_rows,
+                float(g("strength_threshold")), float(g("max_row_sum")),
+                sname == "ALL", interp_name == "D2",
+                float(g("interp_truncation_factor")),
+                int(g("interp_max_elements")), seed)
+        nc = int(cf_map.sum())
+        if nc == 0 or nc >= cur.n_block_rows:
+            return None, None, None
+        Asc = cur.scalar_csr()
+        P_host = P_host.astype(Asc.dtype)
+        R_host = sp.csr_matrix(P_host.T)
+        Ac_host = sp.csr_matrix(R_host @ Asc @ P_host).astype(Asc.dtype)
+        if self.structure_reuse_levels != 0:
+            Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
+        Ac_host.sum_duplicates()
+        Ac_host.sort_indices()
+        level = ClassicalLevel(cur, idx, _child_matrix(cur, P_host),
+                               _child_matrix(cur, R_host), cf_map)
+        return level, _child_matrix(cur, Ac_host), \
+            ("classical", (P_host,))
+
     def _coarsen_once(self, cur: Matrix, idx: int):
         if self.algorithm == "AGGREGATION":
             name = str(self.cfg.get("selector", self.scope))
@@ -450,6 +676,15 @@ class AMGHierarchy:
                     cur, idx, strength, sel_name, interp_name)
                 if out is not None:
                     return out
+            elif self.algorithm == "CLASSICAL":
+                # DIA (stencil) fine levels run strength+PMIS+interp ON
+                # DEVICE in one jitted pass (classical/device_fine.py —
+                # the classical_amg_level.cu:240-340 analog); scattered
+                # coarse levels fall through to the host algorithms
+                out = self._coarsen_classical_device_fine(
+                    cur, idx, strength, sel_name, interp_name)
+                if out is not None:
+                    return out
             Asc = cur.scalar_csr()
             S = strength.compute(Asc)
             selector = create_cf_selector(sel_name, self.cfg, self.scope)
@@ -461,6 +696,9 @@ class AMGHierarchy:
             P_host = interp.compute(Asc, S, cf_map).astype(Asc.dtype)
             R_host = sp.csr_matrix(P_host.T)
             Ac_host = sp.csr_matrix(R_host @ Asc @ P_host).astype(Asc.dtype)
+            if self.algorithm == "CLASSICAL" and \
+                    self.structure_reuse_levels != 0 and cur.dist is None:
+                Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
             Ac_host.sum_duplicates()
             Ac_host.sort_indices()
             if cur.dist is not None:
@@ -579,6 +817,8 @@ class AMGHierarchy:
         if inputs is None:
             return _PAIRWISE_FALLBACK
         offs_raw, vals_raw, dims, _keep = inputs
+        if vals_raw is None:     # hint-gated plan: host path needs values
+            vals_raw = cur.dia_cache(max_diags)[1]
         arrs = _narrow_dia(cur, (offs_raw, vals_raw))
         offs, vals = arrs
         if dims is not None and max(dims) > 1:
@@ -746,7 +986,11 @@ class AMGHierarchy:
                 mats.append(lvl.A)
                 if hasattr(lvl, "transfer_matrices"):
                     mats.extend(lvl.transfer_matrices())
-            batch_upload(mats + [coarsest])
+            # the fine level is the USER's solve matrix: keep its
+            # gather-form cols/vals (mixed-precision refinement needs
+            # them); hierarchy-internal levels ship lean
+            fine_ids = {id(self.levels[0].A)} if self.levels else set()
+            batch_upload(mats + [coarsest], lean_except=fine_ids)
 
         def smoother_task(lvl):
             def run():

@@ -36,9 +36,9 @@ from ..errors import BadParametersError
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["cols", "vals", "diag", "row_ids", "win_blocks",
-                 "win_codes", "win_vals"],
+                 "win_codes", "win_vals", "sh_vals", "sh_meta"],
     meta_fields=["n_rows", "n_cols", "block_dim", "fmt", "ell_width",
-                 "dia_offsets", "win_tile"],
+                 "dia_offsets", "win_tile", "sh_dims"],
 )
 @dataclasses.dataclass(frozen=True)
 class DeviceMatrix:
@@ -72,6 +72,12 @@ class DeviceMatrix:
     win_codes: Optional[jax.Array] = None
     win_vals: Optional[jax.Array] = None
     win_tile: int = 0
+    #: tile-DIA (shift-slice) metadata (ops/pallas_shift.py): per-tile
+    #: class-value rows and window/shift scalars; None when the matrix
+    #: is too scattered for the diff-class budget
+    sh_vals: Optional[jax.Array] = None
+    sh_meta: Optional[jax.Array] = None
+    sh_dims: tuple = ()
 
     @property
     def n(self) -> int:
@@ -91,13 +97,22 @@ class DeviceMatrix:
             vals=None if self.vals is None else self.vals.astype(dtype),
             diag=self.diag.astype(dtype),
             win_vals=(None if self.win_vals is None
-                      else self.win_vals.astype(dtype)))
+                      else self.win_vals.astype(dtype)),
+            sh_vals=(None if self.sh_vals is None
+                     else self.sh_vals.astype(dtype)))
 
     def ell_vals_view(self):
         """Row-major (n, K) ELL values — direct, or reconstructed from
-        the windowed layout by reshape/transpose on a lean pack."""
+        the shift/windowed layout by reshape/transpose on a lean pack
+        (a shift-pack view is Dpad wide: class slots act as ELL slots,
+        padding slots carry zeros)."""
         if self.vals is not None:
             return self.vals
+        if self.sh_vals is not None:
+            T, n_tiles, Dpad, pad, L = self.sh_dims
+            v = self.sh_vals.reshape(n_tiles, Dpad, T)
+            return jnp.transpose(v, (0, 2, 1)).reshape(-1, Dpad)[
+                :self.n_rows]
         K, T = self.ell_width, self.win_tile
         n_tiles = self.win_vals.size // (T * K)
         v = self.win_vals.reshape(n_tiles, K, T)
@@ -105,10 +120,21 @@ class DeviceMatrix:
 
     def ell_cols_view(self):
         """Row-major (n, K) ELL column indices — direct, or decoded from
-        the window codes on a lean pack (col = block_ids[tile, code>>7]
-        ·128 + (code & 127))."""
+        the shift metadata / window codes on a lean pack.  Shift-pack
+        padding slots decode to clipped columns with zero values."""
         if self.cols is not None:
             return self.cols
+        if self.sh_vals is not None:
+            T, n_tiles, Dpad, pad, L = self.sh_dims
+            meta = self.sh_meta.reshape(n_tiles, 2 * Dpad)
+            absp = meta[:, 0::2] * 128 + meta[:, 1::2]   # (n_tiles, Dpad)
+            tiles = jnp.arange(n_tiles, dtype=absp.dtype)
+            d = absp - pad - tiles[:, None] * T          # class diffs
+            rows = jnp.arange(n_tiles * T, dtype=absp.dtype)
+            cols = rows[:, None] + jnp.repeat(d, T, axis=0,
+                                              total_repeat_length=
+                                              n_tiles * T)
+            return jnp.clip(cols, 0, self.n_cols - 1)[:self.n_rows]
         K, T = self.ell_width, self.win_tile
         n_tiles = self.win_blocks.shape[0]
         codes = self.win_codes.astype(jnp.int32).reshape(n_tiles, K * T)
@@ -201,6 +227,10 @@ class Matrix:
         #: view is assembled lazily (only IO / dense coarse solves ask)
         self._dia = None
         self._dia_checked_max = 0
+        #: lazy producer of the analytic (offsets, vals) host diagonals —
+        #: set by device-side generators (io/device_gen.py) so the host
+        #: arrays materialise only for consumers that truly need them
+        self._dia_thunk = None
         if a is not None:
             self.set(a, block_dim=block_dim)
 
@@ -261,6 +291,7 @@ class Matrix:
         self._dia = None
         self._dia_checked_max = 0
         self._dinv_dev = None
+        self._drop_generator_state()
         # generators (io/poisson.py) attach their analytic diagonal
         # decomposition — setup then never re-extracts it from CSR.  The
         # attach is only adopted if it still matches the CSR values (the
@@ -333,6 +364,14 @@ class Matrix:
         once per matrix; None when it has more than ``max_diags``
         diagonals (negative cache: the check is not repeated for smaller
         budgets)."""
+        if self._dia is None and getattr(self, "_dia_thunk", None) \
+                is not None:
+            # device-GENERATED operators (io/device_gen.py) defer the
+            # host analytic arrays until a consumer genuinely needs them
+            # (IO, oracle residuals) — planning runs off the hints
+            self._dia = self._dia_thunk()
+            self._dia_thunk = None
+            self._dia_checked_max = 10**9
         if self._dia is None and self._host is None and \
                 self._device is not None and self._device.fmt == "dia":
             self._download_dia()
@@ -357,6 +396,9 @@ class Matrix:
 
     def host_diag(self) -> np.ndarray:
         """Main (block) diagonal from host data without assembling CSR."""
+        if self._dia is None and self._host is None and \
+                getattr(self, "_dia_thunk", None) is not None:
+            self.dia_cache()
         if self._dia is None and self._host is None and self.block_dim == 1 \
                 and self._device is not None and self._device.fmt == "dia":
             self._download_dia()
@@ -418,11 +460,35 @@ class Matrix:
         self._dia = None
         self._dia_checked_max = 0
         self._dinv_dev = None
+        self._drop_generator_state()
         return self
+
+    def _drop_generator_state(self):
+        """New values invalidate everything a device-side generator
+        declared analytically: the lazy host-array thunk and the
+        planning/refinement hints (a stale ``_vals_f32_exact`` would let
+        refinement skip the rounding-residue scan on non-exact data; a
+        stale thunk would serve the OLD operator's diagonals)."""
+        self._dia_thunk = None
+        for attr in ("_dia_offsets_hint", "_stencil_consistent",
+                     "_vals_f32_exact"):
+            if hasattr(self, attr):
+                delattr(self, attr)
 
     # ------------------------------------------------------------- properties
     @property
     def host(self) -> sp.spmatrix:
+        if self._host is None and \
+                getattr(self, "_csr_pattern", None) is not None:
+            # device-refreshed level (amg/classical/resetup_device.py):
+            # recorded pattern + lazily-downloaded values
+            indptr, indices, shape = self._csr_pattern
+            data = np.asarray(self._csr_vals_dev)
+            self._host = sp.csr_matrix(
+                (data, indices.copy(), indptr.copy()), shape=shape)
+        if self._host is None and self._dia is None and \
+                getattr(self, "_dia_thunk", None) is not None:
+            self.dia_cache()     # analytic thunk beats a device download
         if self._host is None and self._dia is None and \
                 self._device is not None and self._device.fmt == "dia":
             self._download_dia()
@@ -479,6 +545,12 @@ class Matrix:
         # number of stored blocks × block area = scalar nnz
         if self._host is None and self.blocks is not None:
             return int(sum(b.nnz for b in self.blocks))
+        if self._host is None and \
+                getattr(self, "_csr_pattern", None) is not None:
+            return len(self._csr_pattern[1])
+        if self._host is None and self._dia is None and \
+                getattr(self, "_dia_thunk", None) is not None:
+            self.dia_cache()
         if self._host is None and self._dia is None and \
                 self._device is not None and self._device.fmt == "dia":
             self._download_dia()     # lazy: grid-stats / IO consumers only
@@ -536,10 +608,27 @@ class Matrix:
         return self._device
 
 
+#: largest dimension for the dense device fallback (a 3k×3k f32 matrix
+#: is 36 MB HBM and a microseconds-scale MXU matvec)
+_DENSE_MAX = 3072
+
+
+def _dense_pack_enabled() -> bool:
+    """Dense fallback only helps where gathers are catastrophic (TPU);
+    the CPU backend's native gathers are fine.  AMGX_DENSE_PACK=1
+    forces it for the CPU test tier."""
+    import os
+
+    import jax
+    return jax.default_backend() == "tpu" or \
+        os.environ.get("AMGX_DENSE_PACK") == "1"
+
+
 def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
                      ell_max_width: int = 2048,
                      dia_max_diags: int = 48,
-                     lean_win: bool = False):
+                     lean_win: bool = False,
+                     use_shift: bool = True):
     """The device pack computed HOST-side: (arrays, meta) with no
     transfer.  Callers choose the transfer strategy — one ``device_put``
     (:func:`pack_device`) or a whole-hierarchy arena upload
@@ -586,6 +675,13 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
     diag[for_rows[on_diag]] = vals[on_diag]
 
     meta = dict(n_rows=n_rows, n_cols=n_cols, block_dim=b)
+    # small scattered operators that neither structured kernel can carry
+    # become DENSE on device (the MXU eats a ≤3k×3k matvec in
+    # microseconds; the XLA gather fallback costs ~0.13 GFLOPS and
+    # dominated coarse-level smoothing) — the wire still carries the
+    # compact ELL arrays, densified on device at assembly
+    dense_ok = (b == 1 and n_rows <= _DENSE_MAX
+                and n_cols <= _DENSE_MAX)
     if k <= ell_max_width:
         cols = np.zeros((n_rows, k), dtype=np.int32)
         ell_vals = np.zeros((n_rows, k) + block_shape, dtype=dtype)
@@ -598,23 +694,52 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
         # too many 128-blocks (kernel falls back to the XLA gather path)
         # — and on non-TPU backends, where the kernel never runs and the
         # pack would only burn host time and device memory
-        if b == 1 and np.dtype(dtype) == np.float32 and k <= 160:
+        if b == 1 and np.dtype(dtype) == np.float32 and k <= 256:
             from ..ops.pallas_ell import (_INTERPRET, ell_window_pack,
                                           win_vals_pack)
             import jax as _jax
             if _jax.default_backend() == "tpu" or _INTERPRET:
-                win = ell_window_pack(cols)
-                if win is not None:
-                    block_ids, codes, tile = win
-                    arrays.update(win_blocks=block_ids, win_codes=codes,
-                                  win_vals=win_vals_pack(ell_vals, tile))
-                    meta.update(win_tile=tile)
+                # tile-DIA shift kernel first: for locally-banded
+                # matrices it streams at VPU/HBM rates with no per-entry
+                # column data (ops/pallas_shift.py); too-scattered
+                # matrices fall to the windowed one-hot kernel
+                from ..ops.pallas_shift import shift_pack
+                sh = shift_pack(cols, ell_vals, n_cols=n_cols) \
+                    if use_shift else None
+                if sh is not None:
+                    arrays.update(sh_vals=sh["sh_vals"],
+                                  sh_meta=sh["sh_meta"])
+                    meta.update(sh_dims=sh["_meta"])
                     if lean_win:
-                        # the windowed layout carries the values and the
-                        # codes carry the columns — shipping cols/vals
-                        # too nearly doubles hierarchy upload bytes
+                        # the shift layout carries values AND columns
+                        # (class diffs); ell views reconstruct on demand
                         del arrays["cols"], arrays["vals"]
+                else:
+                    win = ell_window_pack(cols)
+                    if win is not None:
+                        block_ids, codes, tile = win
+                        arrays.update(win_blocks=block_ids,
+                                      win_codes=codes,
+                                      win_vals=win_vals_pack(ell_vals,
+                                                             tile))
+                        meta.update(win_tile=tile)
+                        if lean_win:
+                            # the windowed layout carries the values and
+                            # the codes carry the columns — shipping
+                            # cols/vals too nearly doubles hierarchy
+                            # upload bytes
+                            del arrays["cols"], arrays["vals"]
+        if dense_ok and "sh_vals" not in arrays and \
+                "win_codes" not in arrays and _dense_pack_enabled():
+            meta.update(fmt="dense")
         return arrays, meta
+    if dense_ok and _dense_pack_enabled():
+        cols = np.zeros((n_rows, k), dtype=np.int32)
+        ell_vals = np.zeros((n_rows, k) + block_shape, dtype=dtype)
+        cols[for_rows, pos_in_row] = indices
+        ell_vals[for_rows, pos_in_row] = vals
+        meta.update(fmt="dense", ell_width=k)
+        return ({"cols": cols, "vals": ell_vals, "diag": diag}, meta)
     meta.update(fmt="csr", ell_width=0)
     return ({"cols": indices.astype(np.int32), "vals": vals.astype(dtype),
              "diag": diag, "row_ids": for_rows.astype(np.int32)}, meta)
@@ -623,6 +748,18 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
 def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
     """DeviceMatrix around already-transferred arrays (+``meta`` from
     :func:`pack_host_arrays`)."""
+    if meta["fmt"] == "dense":
+        # the wire carried compact ELL arrays; densify ON DEVICE (a
+        # one-time scatter-add beats shipping n×m dense bytes through
+        # the tunnel)
+        cols, vals = arrays["cols"], arrays["vals"]
+        n, m = meta["n_rows"], meta["n_cols"]
+        rows = jnp.broadcast_to(jnp.arange(n)[:, None], cols.shape)
+        dense = jnp.zeros((n, m), dtype=vals.dtype).at[
+            rows.reshape(-1), cols.reshape(-1)].add(vals.reshape(-1))
+        return DeviceMatrix(
+            cols=None, vals=dense, diag=arrays["diag"], row_ids=None,
+            n_rows=n, n_cols=m, block_dim=1, fmt="dense", ell_width=0)
     if meta["fmt"] == "dia":
         dvals = arrays["vals"]
         ddiag = arrays.get("diag")
@@ -640,16 +777,21 @@ def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
         win_blocks=arrays.get("win_blocks"),
         win_codes=arrays.get("win_codes"),
         win_vals=arrays.get("win_vals"),
-        win_tile=meta.get("win_tile", 0))
+        win_tile=meta.get("win_tile", 0),
+        sh_vals=arrays.get("sh_vals"),
+        sh_meta=arrays.get("sh_meta"),
+        sh_dims=meta.get("sh_dims", ()))
 
 
 def pack_device(host: sp.spmatrix, block_dim: int, dtype,
                 ell_max_width: int = 2048,
-                dia_max_diags: int = 48) -> DeviceMatrix:
+                dia_max_diags: int = 48,
+                use_shift: bool = True) -> DeviceMatrix:
     """Host pack + ONE ``device_put`` for all of its arrays."""
     import jax
     arrays, meta = pack_host_arrays(host, block_dim, dtype,
-                                    ell_max_width, dia_max_diags)
+                                    ell_max_width, dia_max_diags,
+                                    use_shift=use_shift)
     keys = sorted(arrays)
     devs = jax.device_put([arrays[k] for k in keys])
     return assemble_device_matrix(dict(zip(keys, devs)), meta)
@@ -771,14 +913,17 @@ def arena_upload(array_dicts, device=None):
     return result
 
 
-def batch_upload(mats) -> None:
+def batch_upload(mats, lean_except=()) -> None:
     """Build + upload the device packs of many matrices in one
     ``device_put`` round trip (plus inverted diagonals for the
     Jacobi-family smoothers of DIA levels).
 
     Matrices that are distributed or already packed are skipped — they
     take their normal path lazily; placement-pinned matrices batch in
-    their own per-placement group."""
+    their own per-placement group.  ``lean_except``: ids of matrices to
+    pack NON-lean — the hierarchy's fine level is the user's solve
+    matrix, and mixed-precision refinement needs its gather-form
+    cols/vals (solvers/base._host_pack_vals64 mirrors that layout)."""
     jobs = []
     seen = set()
     for m in mats:
@@ -804,9 +949,9 @@ def batch_upload(mats) -> None:
                 continue
             # the dia_cache above already proved non-DIA: don't pay the
             # O(nnz) diagonal scan a second time
-            arrays, meta = pack_host_arrays(m.host, m.block_dim, dtype,
-                                            dia_max_diags=0,
-                                            lean_win=True)
+            arrays, meta = pack_host_arrays(
+                m.host, m.block_dim, dtype, dia_max_diags=0,
+                lean_win=id(m) not in lean_except)
         jobs.append((m, dtype, arrays, meta))
     by_placement = {}
     for j in jobs:

@@ -1,9 +1,11 @@
 from .matrix_market import read_matrix_market, write_matrix_market, SystemData
 from .binary import read_binary, write_binary, read_system_auto
-from .poisson import (poisson5pt, poisson7pt, poisson9pt, poisson27pt,
-                      generate_distributed_poisson_7pt)
+from .poisson import (poisson5pt, poisson7pt, poisson7pt_dia, poisson9pt,
+                      poisson27pt, generate_distributed_poisson_7pt)
+from .device_gen import poisson7pt_device
 
 __all__ = ["read_matrix_market", "write_matrix_market", "SystemData",
            "read_binary", "write_binary", "read_system_auto",
-           "poisson5pt", "poisson7pt", "poisson9pt", "poisson27pt",
-           "generate_distributed_poisson_7pt"]
+           "poisson5pt", "poisson7pt", "poisson7pt_dia", "poisson9pt",
+           "poisson27pt", "generate_distributed_poisson_7pt",
+           "poisson7pt_device"]

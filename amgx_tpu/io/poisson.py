@@ -26,6 +26,40 @@ def poisson5pt(nx: int, ny: int) -> sp.csr_matrix:
             sp.kron(_laplace_1d(ny), _eye(nx))).tocsr()
 
 
+def poisson7pt_offsets(nx: int, ny: int, nz: int):
+    """THE canonical 7-pt diagonal order: ``[(flat offset, kept)]``
+    where ``kept`` marks diagonals of non-degenerate axes (a size-1
+    axis has an all-zero coupling row, which the generators drop).
+    Single source of truth for the host CSR generator, the host DIA
+    arrays, and the on-device generator (``io/device_gen.py``) — their
+    row orders MUST agree entry for entry."""
+    return [(-nx * ny, nz > 1), (-nx, ny > 1), (-1, nx > 1), (0, True),
+            (1, nx > 1), (nx, ny > 1), (nx * ny, nz > 1)]
+
+
+def poisson7pt_dia(nx: int, ny: int, nz: int):
+    """Analytic row-aligned DIA arrays of the 3D 7-point Laplacian:
+    ``(offsets, vals)`` with all-zero diagonals of degenerate axes
+    dropped.  Shared by the CSR generator below and the on-device
+    generator (``io/device_gen.py``), which must produce bit-identical
+    values."""
+    n = nx * ny * nz
+    X = np.tile(np.arange(nx), ny * nz)
+    Y = np.tile(np.repeat(np.arange(ny), nx), nz)
+    Z = np.repeat(np.arange(nz), nx * ny)
+    vals = np.empty((7, n), dtype=np.float64)
+    vals[0] = np.where(Z > 0, -1.0, 0.0)
+    vals[1] = np.where(Y > 0, -1.0, 0.0)
+    vals[2] = np.where(X > 0, -1.0, 0.0)
+    vals[3] = 6.0
+    vals[4] = np.where(X < nx - 1, -1.0, 0.0)
+    vals[5] = np.where(Y < ny - 1, -1.0, 0.0)
+    vals[6] = np.where(Z < nz - 1, -1.0, 0.0)
+    spec = poisson7pt_offsets(nx, ny, nz)
+    keep = [k for k, (o, kept) in enumerate(spec) if kept]
+    return [spec[k][0] for k in keep], vals[keep]
+
+
 def poisson7pt(nx: int, ny: int, nz: int) -> sp.csr_matrix:
     """3D 7-point Laplacian on an nx×ny×nz grid — the reference's headline
     benchmark operator (BASELINE.md configs 2-3).
@@ -37,22 +71,7 @@ def poisson7pt(nx: int, ny: int, nz: int) -> sp.csr_matrix:
     partitioned layout): setup consumes the diagonals without ever
     re-extracting them from CSR."""
     n = nx * ny * nz
-    X = np.tile(np.arange(nx), ny * nz)
-    Y = np.tile(np.repeat(np.arange(ny), nx), nz)
-    Z = np.repeat(np.arange(nz), nx * ny)
-    offsets = [-nx * ny, -nx, -1, 0, 1, nx, nx * ny]
-    vals = np.empty((7, n), dtype=np.float64)
-    vals[0] = np.where(Z > 0, -1.0, 0.0)
-    vals[1] = np.where(Y > 0, -1.0, 0.0)
-    vals[2] = np.where(X > 0, -1.0, 0.0)
-    vals[3] = 6.0
-    vals[4] = np.where(X < nx - 1, -1.0, 0.0)
-    vals[5] = np.where(Y < ny - 1, -1.0, 0.0)
-    vals[6] = np.where(Z < nz - 1, -1.0, 0.0)
-    keep = [k for k, o in enumerate(offsets)
-            if o == 0 or np.any(vals[k])]
-    offsets = [offsets[k] for k in keep]
-    vals = vals[keep]
+    offsets, vals = poisson7pt_dia(nx, ny, nz)
     from ..amg.pairwise import dia_to_scipy
     A = dia_to_scipy(offsets, vals, n)
     A._amgx_dia = (offsets, vals)

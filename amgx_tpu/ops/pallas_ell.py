@@ -46,8 +46,10 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_spmv import _INTERPRET
 
 #: max distinct column blocks per tile (window = B·128 x-elements);
-#: classical-AMG coarse operators need ~24-36 on the 64³ Poisson
-_MAX_BLOCKS = 40
+#: classical-AMG coarse operators need ~24-36 on the 64³ Poisson and
+#: ~48-64 on the 128³ mid-hierarchy levels — the VMEM guard below is
+#: the real feasibility gate
+_MAX_BLOCKS = 64
 #: per-entry work target: T·K stays ≤ this where possible — but T has a
 #: hard floor of 128 (output-block lane legality), so for K > 16 the
 #: actual invariant is T·K ≤ max(_FLAT_BUDGET, 128·K); the VMEM guard in
@@ -104,7 +106,7 @@ def ell_window_pack(cols: np.ndarray,
     # (128, T·K) bf16 one-hot (256·T·K bytes), the (B, T·K) f32 pick
     # (4·B·T·K), and double-buffered codes/vals blocks (16·T·K) — keep
     # the sum well under the core's share
-    if tile * K * (272 + 4 * B) > (10 << 20):
+    if tile * K * (272 + 4 * B) > (12 << 20):
         return None
     slot_sorted = np.cumsum(newu, axis=1) - 1          # (n_tiles, T·K)
     slot = np.empty_like(slot_sorted)

@@ -45,8 +45,18 @@ def spmv(A, x: jax.Array) -> jax.Array:
                 xp, (maxo + offs[k],), (maxo + offs[k] + n,))
         return acc
     b = A.block_dim
+    if A.fmt == "dense":
+        # small scattered coarse operator: one MXU matvec (HIGHEST
+        # precision keeps the f32 product exact — the matrices are tiny)
+        return jnp.dot(A.vals, x,
+                       precision=jax.lax.Precision.HIGHEST)
     if A.fmt == "ell":
         if b == 1:
+            from .pallas_shift import shift_spmv, shift_supported
+            if shift_supported(A):
+                # tile-DIA shift kernel: VPU shift-aligned streams, no
+                # per-entry column data (locally-banded matrices)
+                return shift_spmv(A, x)
             from .pallas_ell import ell_window_spmv, ell_window_supported
             if ell_window_supported(A):
                 # gather-free windowed one-hot kernel (XLA lowers the
@@ -77,6 +87,8 @@ def abs_rowsum(A) -> jax.Array:
     import jax.numpy as jnp
     if A.fmt == "dia":
         return jnp.sum(jnp.abs(A.vals), axis=0)
+    if A.fmt == "dense":
+        return jnp.sum(jnp.abs(A.vals), axis=1)
     if A.fmt == "ell":
         # ell_vals_view reconstructs row-major values on a lean pack
         return jnp.sum(jnp.abs(A.ell_vals_view()), axis=1)

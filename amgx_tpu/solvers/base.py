@@ -247,11 +247,21 @@ class Solver:
         renumbering, ``matrix.cu:760-813``).  Returns the permuted
         Matrix, or None to keep ``A``."""
         mode = str(self.cfg.get("matrix_reorder", self.scope))
+        # probe private fields, not the .host property: that would lazily
+        # assemble CSR for DIA-backed matrices (a device-generated 256³
+        # operator never needs a host CSR; AUTO bails on DIA below anyway)
         if mode == "NONE" or not isinstance(A, Matrix) or \
                 A.dist is not None or A.block_dim != 1 or \
-                A.host is None or A.shape[0] != A.shape[1]:
+                (A._host is None and A._dia is None and
+                 getattr(A, "_dia_thunk", None) is None) or \
+                A.shape[0] != A.shape[1]:
             return None
         if mode == "AUTO":
+            if getattr(A, "_dia_offsets_hint", None) is not None:
+                # device-generated stencil: DIA-backed by construction,
+                # AUTO never reorders those — skip without materialising
+                # the host arrays
+                return None
             from ..ops.pallas_ell import _INTERPRET
             if not (jax.default_backend() == "tpu" or _INTERPRET):
                 return None
@@ -366,7 +376,9 @@ class Solver:
                   and not dist and self.scaler is None
                   and self.A is not None
                   and jnp.dtype(dtype) == jnp.float32
-                  and np.dtype(self.A.host.dtype).itemsize >
+                  # Matrix.dtype, not .host.dtype: the property would
+                  # lazily assemble CSR for DIA-backed operators
+                  and np.dtype(self.A.dtype).itemsize >
                   np.dtype(dtype).itemsize)
         if (self.monitor_residual and self.tolerance < floor
                 and not refine):
@@ -517,6 +529,11 @@ class Solver:
         integer-valued stencils (Poisson) — no extra upload then."""
         if hasattr(self, "_refine_lo"):
             return
+        if getattr(self.A, "_vals_f32_exact", False):
+            # device-generated integer-valued stencils declare exactness
+            # analytically — no host values to scan
+            self._refine_lo = None
+            return
         vals64 = self._host_pack_vals64()
         # chunked exactness scan with early exit: integer-valued stencils
         # (the common benchmark operators) are exactly representable in
@@ -541,16 +558,22 @@ class Solver:
     def _host_pack_vals64(self) -> np.ndarray:
         """The device pack's ``vals`` layout rebuilt on host in f64
         (must mirror ``core.matrix.pack_device`` exactly)."""
-        Ad, host = self.Ad, self.A.host
+        Ad = self.Ad
         import scipy.sparse as sp
         from ..core.matrix import dia_arrays, ell_layout
         if Ad.fmt == "dia":
+            # dia_cache first: for DIA-backed matrices (device-generated
+            # operators included) this never assembles the host CSR
             arrs = self.A.dia_cache() if isinstance(self.A, Matrix) \
                 else None
             offs, vals = arrs if arrs is not None else \
-                dia_arrays(sp.csr_matrix(host))
+                dia_arrays(sp.csr_matrix(self.A.host))
             assert tuple(offs) == tuple(Ad.dia_offsets)
             return vals.astype(np.float64, copy=False)
+        host = self.A.host
+        if Ad.fmt == "dense":
+            return np.asarray(sp.csr_matrix(host).todense(),
+                              dtype=np.float64)
         b = Ad.block_dim
         if b == 1:
             csr = sp.csr_matrix(host)

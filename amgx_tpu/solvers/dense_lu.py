@@ -70,6 +70,8 @@ def _densify_device(Ad) -> np.ndarray:
             rows = np.arange(max(0, -o), min(n, n - o))
             out[rows, rows + o] = vals[k, rows]
         return out
+    if Ad.fmt == "dense":
+        return np.asarray(Ad.vals)
     if Ad.fmt == "ell":
         # view methods reconstruct the gather-form arrays on lean packs
         vals = np.asarray(Ad.ell_vals_view())

@@ -19,6 +19,12 @@ from amgx_tpu.ops.spmv import spmv
 @pytest.fixture(autouse=True)
 def _interpret(monkeypatch):
     monkeypatch.setattr(pallas_ell, "_INTERPRET", True)
+    # force the one-hot window pack: these tests cover THAT kernel, and
+    # the tile-DIA shift pack (ops/pallas_shift.py, its own test file)
+    # would otherwise claim every stencil operator first
+    from amgx_tpu.ops import pallas_shift
+    monkeypatch.setattr(pallas_shift, "shift_pack",
+                        lambda *a, **k: None)
 
 
 def _check(A, seed=0, tol=5e-5):
