@@ -28,7 +28,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from ...distributed.partition import Partition
-from .selectors import COARSE, FINE, UNDECIDED, pmis_tie_breaker
+from .selectors import COARSE, FINE, UNDECIDED, tie_break_for
 
 
 class RankExtended:
@@ -87,9 +87,8 @@ class RankExtended:
         base = self.n_local
         for ring in (self._ring1, self._ring2):
             if len(ring):
-                pos = np.searchsorted(ring, gids)
-                pos_c = np.minimum(pos, len(ring) - 1)
-                hit = (~local) & (out < 0) & (ring[pos_c] == gids)
+                pos_c, in_ring = sorted_lookup(ring, gids)
+                hit = (~local) & (out < 0) & in_ring
                 out[hit] = base + pos_c[hit]
             base += len(ring)
         return out
@@ -104,17 +103,71 @@ def strength_distributed(exts: List[RankExtended], strength_objs
             for p in range(len(exts))]
 
 
-def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
-                     n: int, seed: int = 7) -> np.ndarray:
-    """PMIS over per-rank extended blocks, bit-identical to the serial
-    ``selectors._pmis``: the same synchronous two-phase rounds, with halo
-    states/weights read through the universe maps (in-process the
-    exchange is an array read; multi-host it is two neighbour-wise state
-    exchanges per round).
+class HaloExchange:
+    """The halo message schedule: per rank, per NEIGHBOR, which of the
+    neighbour's local entries land in which of this rank's halo slots.
 
-    Returns the global cf map (1 = coarse).
+    In-process, :meth:`refresh` delivers the messages as array reads of
+    the owner's rank-local buffer; multi-host, each ``(q, slots, idx)``
+    triple IS one point-to-point message (``distributed_arranger``'s
+    state/row exchanges).  No participant ever touches an array of
+    global length."""
+
+    def __init__(self, exts: List[RankExtended], offsets: np.ndarray):
+        offsets = np.asarray(offsets)
+        self.plan = []
+        for e in exts:
+            halo = e.universe[e.n_local:]
+            slots = np.arange(e.n_local, e.nU)
+            per = []
+            if len(halo):
+                owner = np.searchsorted(offsets, halo,
+                                        side="right") - 1
+                for q in np.unique(owner):
+                    m = owner == q
+                    per.append((int(q), slots[m],
+                                halo[m] - offsets[q]))
+            self.plan.append(per)
+
+    def refresh(self, locals_: List[np.ndarray],
+                out_U: List[np.ndarray]) -> None:
+        """out_U[p][slot] ← locals_[q][idx] for every scheduled halo
+        slot (one neighbour-wise exchange round)."""
+        for p, per in enumerate(self.plan):
+            for q, slots, idx in per:
+                out_U[p][slots] = locals_[q][idx]
+
+
+def _rank_offsets(exts: List[RankExtended], n: int) -> np.ndarray:
+    return np.asarray([e.lo for e in exts] + [n], dtype=np.int64)
+
+
+def sorted_lookup(keys_sorted: np.ndarray, queries: np.ndarray):
+    """(positions, hit mask) of ``queries`` in a sorted key array —
+    the clamped-searchsorted membership idiom shared by
+    ``RankExtended.to_universe`` and the RAP column remap."""
+    pos = np.searchsorted(keys_sorted, queries)
+    pos = np.minimum(pos, max(len(keys_sorted) - 1, 0))
+    hit = (keys_sorted[pos] == queries) if len(keys_sorted) else \
+        np.zeros(len(queries), dtype=bool)
+    return pos, hit
+
+
+def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
+                     n: int, seed: int = 7) -> List[np.ndarray]:
+    """PMIS over per-rank extended blocks, bit-identical to the serial
+    ``selectors._pmis``: the same synchronous two-phase rounds, with
+    RANK-LOCAL MEMORY ONLY — every array is sized by the rank's
+    [local | ring1 | ring2] universe, and each phase ends with one
+    neighbour-wise halo-state exchange (in-process: an array read of the
+    owner's buffer; multi-host: the ``HaloExchange`` message schedule).
+
+    Returns ``(per-rank LOCAL cf maps (1 = coarse), HaloExchange)`` —
+    the schedule is reused by ``coarse_numbering_distributed``.
     """
     P = len(exts)
+    offs = _rank_offsets(exts, n)
+    ex = HaloExchange(exts, offs)
     G_U = []
     for p in range(P):
         G = (S_U[p] + S_U[p].T).tocsr()
@@ -122,88 +175,120 @@ def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
         G_U.append(G)
 
     # weights: lam_i = #rows strongly depending on i — all such rows sit
-    # within local ∪ ring1, so each owner computes its own lam exactly
-    lam = np.zeros(n, dtype=np.float64)
-    deg_local = np.zeros(n, dtype=np.int64)
+    # within local ∪ ring1, so each owner computes its own lam exactly;
+    # the tie-break fraction is computable per node from (n, seed, gid)
+    # alone, so halo WEIGHTS need one exchange and no global array
+    w_loc, st_loc, edges = [], [], []
     for p, e in enumerate(exts):
         ST = sp.csr_matrix(S_U[p].T)
-        cnt = np.diff(ST.indptr)
-        lam[e.universe[:e.n_local]] = cnt[:e.n_local]
-        gdeg = np.diff(G_U[p].indptr)
-        deg_local[e.universe[:e.n_local]] = gdeg[:e.n_local]
-    # strictly distinct tie-break (selectors.pmis_tie_breaker): computable
-    # per node from (n, seed), so ranks need no weight exchange and the
-    # result stays bit-identical to the serial selector
-    w = lam + pmis_tie_breaker(n, seed)
-
-    state = np.full(n, UNDECIDED, dtype=np.int8)
-    state[deg_local == 0] = FINE
-    # per-rank local edge lists (universe coords)
-    edges = []
-    for p, e in enumerate(exts):
+        cnt = np.diff(ST.indptr)[:e.n_local].astype(np.float64)
+        gids = np.arange(e.lo, e.hi, dtype=np.int64)
+        w_loc.append(cnt + tie_break_for(n, seed, gids))
+        gdeg = np.diff(G_U[p].indptr)[:e.n_local]
+        s0 = np.full(e.n_local, UNDECIDED, dtype=np.int8)
+        s0[gdeg == 0] = FINE
+        st_loc.append(s0)
         G = G_U[p]
-        nl = e.n_local
         rows = np.repeat(np.arange(e.nU), np.diff(G.indptr))
-        m = rows < nl
+        m = rows < e.n_local
         edges.append((rows[m], G.indices[m]))
 
-    while np.any(state == UNDECIDED):
-        n_und_before = int((state == UNDECIDED).sum())
-        new_c_all = []
+    w_U = [np.zeros(e.nU) for e in exts]
+    st_U = [np.full(e.nU, UNDECIDED, dtype=np.int8) for e in exts]
+    for p, e in enumerate(exts):
+        w_U[p][:e.n_local] = w_loc[p]
+        st_U[p][:e.n_local] = st_loc[p]
+    ex.refresh(w_loc, w_U)
+    ex.refresh(st_loc, st_U)
+
+    while True:
+        n_und = sum(int((s == UNDECIDED).sum()) for s in st_loc)
+        if n_und == 0:
+            break
+        # phase 1: C marking — every rank reads the synced pre-round
+        # states; only LOCAL rows are decided
+        become = []
         for p, e in enumerate(exts):
             rows, cols = edges[p]
-            uni = e.universe
-            st_U = state[uni]
-            w_U = w[uni]
-            und_row = st_U[rows] == UNDECIDED
-            und_col = st_U[cols] == UNDECIDED
-            both = und_row & und_col
             nl = e.n_local
+            und_row = st_U[p][rows] == UNDECIDED
+            und_col = st_U[p][cols] == UNDECIDED
+            both = und_row & und_col
             max_nb = np.zeros(nl)
-            np.maximum.at(max_nb, rows[both], w_U[cols[both]])
+            np.maximum.at(max_nb, rows[both], w_U[p][cols[both]])
             has_nb = np.zeros(nl, dtype=bool)
             has_nb[rows[both]] = True
-            und_l = st_U[:nl] == UNDECIDED
-            become_c = und_l & ((~has_nb) | (w_U[:nl] > max_nb))
-            new_c_all.append(uni[:nl][become_c])
-        newc = np.concatenate(new_c_all) if new_c_all else []
-        state[newc] = COARSE              # "exchange" of C updates
-        just_c = np.zeros(n, dtype=bool)
-        just_c[newc] = True
+            und_l = st_U[p][:nl] == UNDECIDED
+            become_c = und_l & ((~has_nb) | (w_loc[p] > max_nb))
+            become.append(become_c)
+        prev_halo = [st_U[p][exts[p].n_local:].copy() for p in range(P)]
         for p, e in enumerate(exts):
+            st_loc[p][become[p]] = COARSE
+            st_U[p][:e.n_local] = st_loc[p]
+        ex.refresh(st_loc, st_U)          # halo-state exchange #1
+        # phase 2: F marking — "became C this round" halos are the diff
+        # against the pre-exchange halo snapshot (no extra message kind)
+        for p, e in enumerate(exts):
+            nl = e.n_local
+            jc = np.zeros(e.nU, dtype=bool)
+            jc[:nl] = become[p]
+            halo_now = st_U[p][nl:]
+            jc[nl:] = (halo_now == COARSE) & (prev_halo[p] != COARSE)
             rows, cols = edges[p]
-            uni = e.universe
-            st_U = state[uni]
-            jc_U = just_c[uni]
-            f_hit = jc_U[cols] & (st_U[rows] == UNDECIDED)
+            f_hit = jc[cols] & (st_U[p][rows] == UNDECIDED)
             f_nodes = np.unique(rows[f_hit])
-            state[uni[f_nodes]] = FINE    # rows are local (< n_local)
-        if int((state == UNDECIDED).sum()) == n_und_before:
+            st_loc[p][f_nodes] = FINE
+            st_U[p][:nl] = st_loc[p]
+        ex.refresh(st_loc, st_U)          # halo-state exchange #2
+        if sum(int((s == UNDECIDED).sum()) for s in st_loc) == n_und:
             raise RuntimeError(
                 "distributed PMIS made no progress in a round — "
                 "tie-break weights are not distinct")
-    return (state == COARSE).astype(np.int8)
+    return [(s == COARSE).astype(np.int8) for s in st_loc], ex
+
+
+def coarse_numbering_distributed(exts: List[RankExtended],
+                                 cf_loc: List[np.ndarray], n: int,
+                                 ex: Optional[HaloExchange] = None):
+    """Rank-contiguous coarse ids from per-rank cf maps: returns
+    (coarse offsets, per-rank cf over the universe, per-rank coarse ids
+    over the universe).  The only global quantity is the P+1 offset
+    vector (an allgather of P scalars)."""
+    counts = [int(c.sum()) for c in cf_loc]
+    c_off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    cnum_loc = []
+    for p, e in enumerate(exts):
+        cn = np.where(cf_loc[p] > 0,
+                      c_off[p] + np.cumsum(cf_loc[p]) - 1, -1)
+        cnum_loc.append(cn.astype(np.int64))
+    if ex is None:
+        ex = HaloExchange(exts, _rank_offsets(exts, n))
+    cf_U = [np.zeros(e.nU, dtype=np.int8) for e in exts]
+    cnum_U = [np.full(e.nU, -1, dtype=np.int64) for e in exts]
+    for p, e in enumerate(exts):
+        cf_U[p][:e.n_local] = cf_loc[p]
+        cnum_U[p][:e.n_local] = cnum_loc[p]
+    ex.refresh(cf_loc, cf_U)
+    ex.refresh(cnum_loc, cnum_U)
+    return c_off, cf_U, cnum_U
 
 
 def interpolate_distributed(exts: List[RankExtended], interp,
-                            cf: np.ndarray, coarse_num: np.ndarray,
-                            S_U: List[sp.csr_matrix]
+                            cf_U: List[np.ndarray],
+                            cnum_U: List[np.ndarray],
+                            S_U: List[sp.csr_matrix], nc: int
                             ) -> List[sp.csr_matrix]:
     """Per-rank P row blocks (global coarse columns): run the serial
     interpolator on each extended system and keep the LOCAL rows — the
     extended block contains exactly the rows a local row's distance-≤2
-    stencil reads (ring-2 columns are the D2 consumer).
-
-    ``coarse_num``: global row id → global coarse id (−1 for F points).
-    """
+    stencil reads (ring-2 columns are the D2 consumer).  All inputs are
+    rank-local universe arrays (``coarse_numbering_distributed``)."""
     P_blocks = []
-    nc = int(cf.sum())
     for p, e in enumerate(exts):
-        cf_U = cf[e.universe]
-        P_U = interp.compute(e.A_U, S_U[p], cf_U)
+        P_U = interp.compute(e.A_U, S_U[p], cf_U[p])
         # universe coarse order -> global coarse ids
-        c_slots = np.flatnonzero(cf_U)
-        gc = coarse_num[e.universe[c_slots]]
+        c_slots = np.flatnonzero(cf_U[p])
+        gc = cnum_U[p][c_slots]
         Pl = sp.csr_matrix(P_U[:e.n_local])
         P_blocks.append(sp.csr_matrix(
             (Pl.data, gc[Pl.indices], Pl.indptr),
@@ -244,15 +329,17 @@ def rap_distributed(blocks, P_blocks: List[sp.csr_matrix],
     for p in range(n_parts):
         lo, hi = offsets[p], offsets[p + 1]
         ring1 = part.rings[0].halo_global[p]
-        # P restricted to [local rows | ring1 rows] in A_p's column space
+        # P restricted to [local rows | ring1 rows] in A_p's column
+        # space; the global-id → kept-position map is a sorted lookup
+        # over the O(local+halo) kept set — never a global-length array
         keep_cols = np.concatenate(
             [np.arange(lo, hi, dtype=np.int64), ring1])
-        colmap = np.full(int(offsets[-1]), -1, dtype=np.int64)
-        colmap[keep_cols] = np.arange(len(keep_cols))
+        order = np.argsort(keep_cols, kind="stable")
+        keep_sorted = keep_cols[order]
         Ap = blocks[p].tocoo()
-        sel = colmap[Ap.col] >= 0
+        pos_c, sel = sorted_lookup(keep_sorted, Ap.col)
         A_loc = sp.csr_matrix(
-            (Ap.data[sel], (Ap.row[sel], colmap[Ap.col[sel]])),
+            (Ap.data[sel], (Ap.row[sel], order[pos_c[sel]])),
             shape=(hi - lo, len(keep_cols)))
         P_rows = sp.vstack([sp.csr_matrix(P_blocks[p]),
                             p_rows_for(ring1)]).tocsr()

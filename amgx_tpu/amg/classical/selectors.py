@@ -37,12 +37,20 @@ def pmis_tie_breaker(n: int, seed: int) -> np.ndarray:
     Computable locally per node from ``(n, seed)`` alone, so the distributed
     PMIS produces bit-identical weights without any exchange.
     """
+    return tie_break_for(n, seed, np.arange(n, dtype=np.int64))
+
+
+def tie_break_for(n: int, seed: int, gids: np.ndarray) -> np.ndarray:
+    """The tie-break fractions of arbitrary global ids — each node's
+    weight is a pure function of ``(n, seed, gid)``, so distributed
+    ranks compute their own slice with NO exchange and stay bit-identical
+    to the serial selector."""
     if n == 0:
         return np.zeros(0, dtype=np.float64)
     a = 2654435761  # Knuth multiplier; < 2^32 so a*i fits uint64 exactly
     while np.gcd(a, n) != 1:
         a += 1
-    perm = (np.arange(n, dtype=np.uint64) * np.uint64(a)
+    perm = (gids.astype(np.uint64) * np.uint64(a)
             + np.uint64(seed % n)) % np.uint64(n)
     return (perm.astype(np.float64) + 1.0) / float(n + 2)
 

@@ -753,6 +753,7 @@ class AMGHierarchy:
         from ..distributed.partition import build_partition_from_blocks
         from ..utils.determinism import SESSION_SEED
         from .classical.distributed import (RankExtended,
+                                            coarse_numbering_distributed,
                                             interpolate_distributed,
                                             pmis_distributed,
                                             rap_distributed,
@@ -768,17 +769,15 @@ class AMGHierarchy:
         seed = 7 if bool(self.cfg.get("determinism_flag")) \
             else SESSION_SEED
         S_U = strength_distributed(exts, [strength] * n_parts)
-        cf = pmis_distributed(exts, S_U, n, seed)
-        nc = int(cf.sum())
+        cf_loc, ex = pmis_distributed(exts, S_U, n, seed)
+        nc = int(sum(int(c.sum()) for c in cf_loc))
         if nc == 0 or nc >= n:
             return None, None, None
-        coarse_num = np.where(cf > 0, np.cumsum(cf) - 1, -1)
-        c_counts = [int(cf[offsets[p]:offsets[p + 1]].sum())
-                    for p in range(n_parts)]
-        c_off = np.concatenate([[0], np.cumsum(c_counts)])
+        c_off, cf_U, cnum_U = coarse_numbering_distributed(exts, cf_loc,
+                                                           n, ex)
         interp = create_interpolator(interp_name, self.cfg, self.scope)
-        P_blocks = interpolate_distributed(exts, interp, cf, coarse_num,
-                                           S_U)
+        P_blocks = interpolate_distributed(exts, interp, cf_U, cnum_U,
+                                           S_U, nc)
         dtype = np.dtype(blocks[0].dtype)
         P_blocks = [sp.csr_matrix(Pb.astype(dtype)) for Pb in P_blocks]
         c_blocks, r_blocks = rap_distributed(blocks, P_blocks, part,
