@@ -154,7 +154,8 @@ def sorted_lookup(keys_sorted: np.ndarray, queries: np.ndarray):
 
 
 def pmis_distributed(exts: List[RankExtended], S_U: List[sp.csr_matrix],
-                     n: int, seed: int = 7) -> List[np.ndarray]:
+                     n: int, seed: int = 7
+                     ) -> Tuple[List[np.ndarray], "HaloExchange"]:
     """PMIS over per-rank extended blocks, bit-identical to the serial
     ``selectors._pmis``: the same synchronous two-phase rounds, with
     RANK-LOCAL MEMORY ONLY — every array is sized by the rank's

@@ -567,6 +567,13 @@ class Matrix:
         if self._device is not None and self._device_dtype == dtype:
             return self._device
         if self.dist is not None:
+            import jax as _jax
+            if np.issubdtype(dtype, np.complexfloating) and \
+                    _jax.default_backend() == "tpu":
+                raise BadParametersError(
+                    "distributed complex modes are not supported on "
+                    "this TPU runtime (no complex lowering); use a "
+                    "host-mode (hZZI/hCCI) single-device solve")
             mesh, axis, offsets, n_loc = self.dist
             if self._host is None and self.blocks is not None:
                 from ..distributed.matrix import shard_matrix_from_blocks
@@ -584,6 +591,18 @@ class Matrix:
                     self.host, self.block_dim, mesh, axis=axis,
                     dtype=dtype, offsets=offsets, n_loc=n_loc)
         else:
+            if self.placement is None and \
+                    np.issubdtype(dtype, np.complexfloating):
+                import jax as _jax
+                if _jax.default_backend() == "tpu":
+                    # this TPU runtime has no complex lowering at all
+                    # (even complex add is UNIMPLEMENTED — probed on
+                    # v5e); complex packs pin to the host backend, the
+                    # same split the hZZI/hCCI modes use by design
+                    from ..modes import _warn_complex_host
+                    _warn_complex_host()
+                    self.placement = _jax.local_devices(
+                        backend="cpu")[0]
             dia = self.dia_cache(48) if self.block_dim == 1 else None
             if dia is not None and (len(dia[0]) == 0 or
                                     self.n_block_rows !=
@@ -598,7 +617,8 @@ class Matrix:
                 # matrix non-DIA — don't pay the O(nnz) scan again
                 self._device = pack_device(self.host, self.block_dim,
                                            dtype, ell_max_width,
-                                           dia_max_diags=0)
+                                           dia_max_diags=0,
+                                           device=self.placement)
             if self.placement is not None and dia is None:
                 import jax
                 dev = self.placement
@@ -786,14 +806,20 @@ def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
 def pack_device(host: sp.spmatrix, block_dim: int, dtype,
                 ell_max_width: int = 2048,
                 dia_max_diags: int = 48,
-                use_shift: bool = True) -> DeviceMatrix:
-    """Host pack + ONE ``device_put`` for all of its arrays."""
+                use_shift: bool = True,
+                device=None) -> DeviceMatrix:
+    """Host pack + ONE ``device_put`` for all of its arrays (onto
+    ``device`` when pinned — staging on the default device first would
+    ship, and for complex dtypes hang, on a backend that cannot hold
+    the data)."""
     import jax
     arrays, meta = pack_host_arrays(host, block_dim, dtype,
                                     ell_max_width, dia_max_diags,
                                     use_shift=use_shift)
     keys = sorted(arrays)
-    devs = jax.device_put([arrays[k] for k in keys])
+    devs = jax.device_put([arrays[k] for k in keys], device) \
+        if device is not None else \
+        jax.device_put([arrays[k] for k in keys])
     return assemble_device_matrix(dict(zip(keys, devs)), meta)
 
 

@@ -129,16 +129,25 @@ def read_matrix_market(path: str) -> SystemData:
         A = A + sp.diags(dvals, shape=(rows, cols))
         A = sp.csr_matrix(A)
 
+    def read_vec(rest):
+        """rhs/solution block: complex systems carry 're im' pairs per
+        entry (same convention as the coordinate entries)."""
+        per = 2 if is_complex else 1
+        if len(rest) < rows * per:
+            raise IOError_(f"{path}: truncated vector block")
+        tok = np.asarray(rest[:rows * per])
+        rest = rest[rows * per:]
+        if is_complex:
+            t = tok.reshape(rows, 2)
+            return (t[:, 0].astype(np.float64)
+                    + 1j * t[:, 1].astype(np.float64)), rest
+        return tok.astype(np.float64), rest
+
     rhs = soln = None
     if has_rhs:
-        if len(rest) < rows:
-            raise IOError_(f"{path}: truncated RHS")
-        rhs = np.asarray(rest[:rows], dtype=np.float64)
-        rest = rest[rows:]
+        rhs, rest = read_vec(rest)
     if has_soln:
-        if len(rest) < rows:
-            raise IOError_(f"{path}: truncated solution")
-        soln = np.asarray(rest[:rows], dtype=np.float64)
+        soln, rest = read_vec(rest)
 
     return SystemData(A=A, rhs=rhs, solution=soln,
                       block_dimx=block_dimx, block_dimy=block_dimy)
@@ -151,7 +160,10 @@ def write_matrix_market(path: str, A: sp.spmatrix,
     """Write a system in the reference's extended MatrixMarket format
     (``MatrixIO::writeSystemMatrixMarket``, base/src/matrix_io.cu)."""
     A = sp.coo_matrix(A)
-    is_complex = np.iscomplexobj(A.data)
+    is_complex = (np.iscomplexobj(A.data)
+                  or (rhs is not None and np.iscomplexobj(rhs))
+                  or (solution is not None
+                      and np.iscomplexobj(solution)))
     field = "complex" if is_complex else "real"
     with open(path, "w") as f:
         f.write(f"%%MatrixMarket matrix coordinate {field} general\n")
@@ -166,12 +178,18 @@ def write_matrix_market(path: str, A: sp.spmatrix,
             f.write("%%AMGX " + " ".join(ext) + "\n")
         f.write(f"{A.shape[0]} {A.shape[1]} {A.nnz}\n")
         if is_complex:
-            for i, j, v in zip(A.row, A.col, A.data):
+            data_c = A.data.astype(np.complex128)
+            for i, j, v in zip(A.row, A.col, data_c):
                 f.write(f"{i+1} {j+1} {v.real:.17g} {v.imag:.17g}\n")
         else:
             for i, j, v in zip(A.row, A.col, A.data):
                 f.write(f"{i+1} {j+1} {v:.17g}\n")
         for vec in (rhs, solution):
             if vec is not None:
-                for v in np.asarray(vec).ravel():
-                    f.write(f"{v:.17g}\n")
+                vv = np.asarray(vec).ravel()
+                if is_complex:
+                    for v in vv.astype(np.complex128):
+                        f.write(f"{v.real:.17g} {v.imag:.17g}\n")
+                else:
+                    for v in vv:
+                        f.write(f"{v:.17g}\n")

@@ -41,6 +41,22 @@ PUBLIC_MODES = (
 MODE_IDS = {name: i for i, name in enumerate(PUBLIC_MODES)}
 
 _fp64_warned: set = set()
+_complex_warned: list = []
+
+
+def _warn_complex_host():
+    """One-time notice: complex data runs on the host backend (this TPU
+    runtime has no complex lowering — probed: even c64 add returns
+    UNIMPLEMENTED)."""
+    if _complex_warned:
+        return
+    _complex_warned.append(True)
+    from .utils.logging import amgx_output
+
+    amgx_output(
+        "NOTE: complex-mode data runs on the HOST backend: this TPU "
+        "runtime has no complex lowering (c64 ops return "
+        "UNIMPLEMENTED), matching the hZZI/hCCI host modes.\n")
 
 
 def _warn_fp64_downgrade(mode_name: str):
@@ -87,10 +103,15 @@ class Mode:
 
     def placement_device(self):
         """The jax.Device data should live on: CPU for host modes, the
-        default accelerator for device modes."""
+        default accelerator for device modes.  Complex device modes fall
+        back to the host backend on TPUs — the runtime has no complex
+        lowering (even addition is UNIMPLEMENTED; probed on v5e)."""
         import jax
 
         if self.mem_space == "host":
+            return jax.local_devices(backend="cpu")[0]
+        if self.is_complex and jax.default_backend() == "tpu":
+            _warn_complex_host()
             return jax.local_devices(backend="cpu")[0]
         return jax.devices()[0]
 
@@ -101,10 +122,16 @@ class Mode:
         import jax
 
         if (self.mem_space == "device"
-                and jax.default_backend() not in ("cpu",)
-                and self.mat_dtype == np.dtype(np.float64)):
-            _warn_fp64_downgrade(self.name)
-            return np.dtype(np.float32)
+                and jax.default_backend() not in ("cpu",)):
+            if self.mat_dtype == np.dtype(np.float64):
+                _warn_fp64_downgrade(self.name)
+                return np.dtype(np.float32)
+            if self.mat_dtype == np.dtype(np.complex128):
+                # complex data runs on the HOST backend on this TPU
+                # runtime (no complex lowering at all) — c64 pack there
+                # keeps the hZZI-style wide-host/narrow-pack split
+                _warn_complex_host()
+                return np.dtype(np.complex64)
         return self.mat_dtype
 
 

@@ -392,18 +392,49 @@ class Solver:
             b = shard_vector(self.Ad, b)
             if x0 is not None and not zero_initial_guess:
                 x0 = shard_vector(self.Ad, x0)
-        elif not refine:
+        pin = None
+        if not dist:
+            # pinned packs (host modes; complex modes on a TPU runtime
+            # without complex support) pull the solve vectors onto THEIR
+            # device — jit rejects mixed device sets
+            try:
+                devs = list(self.Ad.diag.devices())
+                if len(devs) == 1 and devs[0] != jax.devices()[0]:
+                    pin = devs[0]
+            except Exception:
+                pin = None
+        if not dist and not refine:
             # device-resident b stays put; anything else uploads — and a
             # wrong-dtype device array is cast so the loop never silently
-            # retraces in (TPU-emulated) f64
-            b = jnp.asarray(b, dtype) if isinstance(b, jax.Array) else \
-                jnp.asarray(np.asarray(b), dtype=dtype)
+            # retraces in (TPU-emulated) f64.  Pinned solves go STRAIGHT
+            # to the pin: staging through the default device would ship
+            # (and, for complex dtypes, hang) on a backend that cannot
+            # hold the data.
+            if pin is not None:
+                if not (isinstance(b, jax.Array) and b.dtype == dtype
+                        and set(b.devices()) == {pin}):
+                    b = jax.device_put(np.asarray(b, dtype=dtype), pin)
+            else:
+                b = jnp.asarray(b, dtype) if isinstance(b, jax.Array) \
+                    else jnp.asarray(np.asarray(b), dtype=dtype)
         if not refine:
             if x0 is None or zero_initial_guess:
-                x0 = jnp.zeros_like(b)
+                if pin is not None:
+                    x0 = jax.device_put(
+                        np.zeros(np.shape(b), dtype=dtype), pin)
+                else:
+                    x0 = jnp.zeros_like(b)
             elif not dist:
-                x0 = jnp.asarray(x0, dtype) if isinstance(x0, jax.Array) \
-                    else jnp.asarray(np.asarray(x0), dtype=dtype)
+                if pin is not None:
+                    if not (isinstance(x0, jax.Array)
+                            and x0.dtype == dtype
+                            and set(x0.devices()) == {pin}):
+                        x0 = jax.device_put(np.asarray(x0, dtype=dtype),
+                                            pin)
+                else:
+                    x0 = jnp.asarray(x0, dtype) \
+                        if isinstance(x0, jax.Array) \
+                        else jnp.asarray(np.asarray(x0), dtype=dtype)
 
         if refine and not hasattr(self, "_refine_lo"):
             # refine became active after a non-refined solve (e.g. the user
@@ -445,10 +476,16 @@ class Solver:
                 x, iters, nrm, nrm_ini, history = self._solve_refined(
                     b_in, x0_in)
             else:
-                x, stats, history = self._solve_fn(
-                    self._bindings.collect(), b, x0,
-                    jnp.asarray(self.tolerance, dtype),
-                    jnp.asarray(self.max_iters, jnp.int32))
+                import contextlib
+                ctx = jax.default_device(pin) if pin is not None \
+                    else contextlib.nullcontext()
+                # tolerances compare against REAL norms (complex modes)
+                rdt = np.zeros((), dtype).real.dtype
+                with ctx:
+                    x, stats, history = self._solve_fn(
+                        self._bindings.collect(), b, x0,
+                        jnp.asarray(self.tolerance, rdt),
+                        jnp.asarray(self.max_iters, jnp.int32))
                 # ONE small host fetch for (iters, norms) — per-transfer
                 # cost dominates on remote-attached TPUs
                 stats = np.asarray(stats)

@@ -290,7 +290,9 @@ class _GMRESBase(Solver):
             r = b - spmv(self.Ad, x)
             beta = blas.nrm2(r)
             v0 = jnp.where(beta > 0, r / jnp.where(beta == 0, 1, beta), 0.0)
-            return v0, jnp.abs(beta)
+            # g rides in the basis dtype (complex modes store the real
+            # |r| as a complex scalar)
+            return v0, jnp.abs(beta).astype(state.g.dtype)
 
         def keep_v0(_):
             return state.V[0], state.g[0]
@@ -308,13 +310,15 @@ class _GMRESBase(Solver):
         # --- Arnoldi step with CGS2 orthogonalisation; rows > j may hold
         # stale directions from the previous cycle — mask their
         # coefficients instead of zeroing the basis storage
-        row_ok = (jnp.arange(m + 1) <= j).astype(V.dtype)
+        row_ok = (jnp.arange(m + 1) <= j).astype(state.V.real.dtype)
         v_j = state.V[j]
         z_j = self._M(v_j)
         w = spmv(self.Ad, z_j)
-        h1 = (state.V @ w) * row_ok
+        # projections h_i = <v_i, w> are CONJUGATED (complex modes:
+        # jnp.conj of a real array is a no-op XLA folds away)
+        h1 = (jnp.conj(state.V) @ w) * row_ok
         w = w - state.V.T @ h1
-        h2 = (state.V @ w) * row_ok
+        h2 = (jnp.conj(state.V) @ w) * row_ok
         w = w - state.V.T @ h2
         hcol = h1 + h2              # (m+1,)
         h_next = blas.nrm2(w)
@@ -323,12 +327,16 @@ class _GMRESBase(Solver):
         hcol = hcol.at[j + 1].set(h_next)
         Z = state.Z.at[j].set(z_j) if self.flexible else state.Z
 
-        # --- apply previous Givens rotations to the new column (sequential)
+        # --- apply previous Givens rotations to the new column
+        # (sequential).  The unitary form G = [[c̄, s̄], [−s, c]] with
+        # c = a/r, s = b/r (r = √(|a|²+|b|²)) maps (a, b) → (r, 0) for
+        # real AND complex entries alike (conj on reals folds away).
         def rot_body(i, hc):
             ci, si = state.cs[i], state.sn[i]
             hi, hi1 = hc[i], hc[i + 1]
             active = i < j
-            new_i = jnp.where(active, ci * hi + si * hi1, hi)
+            new_i = jnp.where(active,
+                              jnp.conj(ci) * hi + jnp.conj(si) * hi1, hi)
             new_i1 = jnp.where(active, -si * hi + ci * hi1, hi1)
             return hc.at[i].set(new_i).at[i + 1].set(new_i1)
 
@@ -336,15 +344,16 @@ class _GMRESBase(Solver):
 
         # --- new Givens rotation zeroing h[j+1]
         hj, hj1 = hcol[j], hcol[j + 1]
-        denom = jnp.sqrt(hj * hj + hj1 * hj1)
+        denom = jnp.sqrt(jnp.abs(hj) ** 2 + jnp.abs(hj1) ** 2)
         safe = jnp.where(denom == 0, 1.0, denom)
-        c = jnp.where(denom == 0, 1.0, hj / safe)
-        s = jnp.where(denom == 0, 0.0, hj1 / safe)
-        hcol = hcol.at[j].set(c * hj + s * hj1).at[j + 1].set(0.0)
+        c = jnp.where(denom == 0, jnp.ones((), hcol.dtype), hj / safe)
+        s = jnp.where(denom == 0, jnp.zeros((), hcol.dtype), hj1 / safe)
+        hcol = hcol.at[j].set(jnp.conj(c) * hj + jnp.conj(s) * hj1) \
+                   .at[j + 1].set(0.0)
         cs = state.cs.at[j].set(c)
         sn = state.sn.at[j].set(s)
         gj = state.g[j]
-        g = state.g.at[j].set(c * gj).at[j + 1].set(-s * gj)
+        g = state.g.at[j].set(jnp.conj(c) * gj).at[j + 1].set(-s * gj)
         R = state.R.at[:, j].set(hcol)
         quasi = jnp.abs(g[j + 1])
 
