@@ -619,11 +619,8 @@ class Matrix:
                                            dtype, ell_max_width,
                                            dia_max_diags=0,
                                            device=self.placement)
-            if self.placement is not None and dia is None:
-                import jax
-                dev = self.placement
-                self._device = jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, dev), self._device)
+            # placement is honored inside _pack_dia_arrays /
+            # pack_device (device=...): no second pass needed
         self._device_dtype = dtype
         return self._device
 

@@ -122,10 +122,18 @@ def read_matrix_market(path: str) -> SystemData:
     A.sort_indices()
 
     if has_diag:
-        # external diagonal: rows scalar values appended (readers.cu diag path)
-        nvals = rows
-        dvals = np.asarray(rest[:nvals], dtype=np.float64)
-        rest = rest[nvals:]
+        # external diagonal: one value per row appended (readers.cu diag
+        # path) — 're im' pairs in complex files, like every other block
+        per = 2 if is_complex else 1
+        ntok = rows * per
+        tok = np.asarray(rest[:ntok])
+        rest = rest[ntok:]
+        if is_complex:
+            t = tok.reshape(rows, 2)
+            dvals = t[:, 0].astype(np.float64) \
+                + 1j * t[:, 1].astype(np.float64)
+        else:
+            dvals = tok.astype(np.float64)
         A = A + sp.diags(dvals, shape=(rows, cols))
         A = sp.csr_matrix(A)
 

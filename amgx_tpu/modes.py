@@ -126,10 +126,12 @@ class Mode:
             if self.mat_dtype == np.dtype(np.float64):
                 _warn_fp64_downgrade(self.name)
                 return np.dtype(np.float32)
-            if self.mat_dtype == np.dtype(np.complex128):
+            if self.mat_dtype == np.dtype(np.complex128) and \
+                    jax.default_backend() == "tpu":
                 # complex data runs on the HOST backend on this TPU
                 # runtime (no complex lowering at all) — c64 pack there
-                # keeps the hZZI-style wide-host/narrow-pack split
+                # keeps the hZZI-style wide-host/narrow-pack split;
+                # other accelerators keep native c128
                 _warn_complex_host()
                 return np.dtype(np.complex64)
         return self.mat_dtype
