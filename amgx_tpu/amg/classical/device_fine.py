@@ -40,10 +40,14 @@ import numpy as np
 
 
 def _shift(x, d: int, fill=0):
-    """y[i] = x[i+d] with ``fill`` outside — the DIA neighbour read."""
+    """y[i] = x[i+d] with ``fill`` outside — the DIA neighbour read.
+    |d| ≥ n (tiny grids meeting a D2 pairwise-sum offset) is all-fill."""
     import jax.numpy as jnp
     if d == 0:
         return x
+    n = x.shape[0]
+    if abs(d) >= n:
+        return jnp.full((n,), fill, x.dtype)
     f = jnp.full((abs(d),), fill, x.dtype)
     return jnp.concatenate([x[d:], f]) if d > 0 else \
         jnp.concatenate([f, x[:d]])

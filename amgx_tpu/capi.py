@@ -579,31 +579,90 @@ def AMGX_read_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
                                  allocated_halo_depth=1, num_partitions=1,
                                  partition_sizes=None,
                                  partition_vector=None):
-    """``amgx_c.h:464``: partition-vector-driven read."""
+    """``amgx_c.h:464`` / ``distributed_io.cu:182-278``:
+    partition-vector-driven read.
+
+    The partition vector assigns each GLOBAL row to a rank (rows need
+    not be contiguous); like the reference's
+    ``DistributedRead``/renumbering, rows are permuted rank-major
+    (stable, preserving in-rank order), each rank receives ITS row block
+    (``set_distributed_blocks`` — the global matrix is never the setup
+    representation), and the permutation is recorded on the handle so
+    ``AMGX_write_system_distributed`` round-trips to the ORIGINAL
+    numbering."""
     sysdata = _io.read_system_auto(path)
-    mtx.matrix = Matrix(sysdata.A.astype(mtx.mode.mat_dtype))
+    A = sysdata.A.astype(mtx.mode.mat_dtype)
+    b = _resolve_rhs(sysdata, mtx)
+    x = sysdata.solution
+    mtx._dist_perm = None
+    if num_partitions > 1 and partition_vector is not None:
+        import scipy.sparse as _sp
+        pv = np.asarray(partition_vector)
+        order = np.argsort(pv, kind="stable")   # rank-major renumbering
+        A = _sp.csr_matrix(A)[order][:, order].tocsr()
+        b = np.asarray(b)[order]
+        if x is not None:
+            x = np.asarray(x)[order]
+        counts = np.bincount(pv, minlength=num_partitions)
+        offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+        mtx._dist_perm = order
+        import jax as _jax
+        if len(_jax.devices()) >= num_partitions:
+            from .distributed import make_mesh
+            from .distributed.partition import split_row_blocks
+            blocks = split_row_blocks(_sp.csr_matrix(A), offsets)
+            m = Matrix()
+            m.set_distributed_blocks(blocks, offsets,
+                                     make_mesh(num_partitions))
+            mtx.matrix = m
+        else:
+            mtx.matrix = Matrix(A)   # 1-chip session: renumbered global
+    else:
+        mtx.matrix = Matrix(A)
+        if num_partitions > 1:
+            _maybe_distribute(mtx.matrix, num_partitions, None)
     _apply_mode_policy(mtx)
-    if num_partitions > 1:
-        offsets = None
-        if partition_vector is not None:
-            from .distributed import partition_offsets_from_vector
-            offsets = partition_offsets_from_vector(
-                np.asarray(partition_vector), num_partitions)
-        _maybe_distribute(mtx.matrix, num_partitions, offsets)
     if rhs is not None:
-        rhs.data = np.asarray(_resolve_rhs(sysdata, mtx),
-                              dtype=rhs.mode.vec_dtype)
+        rhs.data = np.asarray(b, dtype=rhs.mode.vec_dtype)
     if sol is not None:
-        n = sysdata.A.shape[0]
-        sol.data = (np.asarray(sysdata.solution, dtype=sol.mode.vec_dtype)
-                    if sysdata.solution is not None
+        n = A.shape[0]
+        sol.data = (np.asarray(x, dtype=sol.mode.vec_dtype)
+                    if x is not None
                     else np.zeros(n, dtype=sol.mode.vec_dtype))
 
 
 @_catches()
 def AMGX_write_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
-                                  sol: VectorHandle, path: str):
-    AMGX_write_system.__wrapped__(mtx, rhs, sol, path)
+                                  sol: VectorHandle, path: str,
+                                  allocated_halo_depth=1,
+                                  num_partitions=1, partition_sizes=None,
+                                  partition_vector_size=0,
+                                  partition_vector=None):
+    """``amgx_c.h:447`` / ``distributed_io.cu``: gather the per-rank row
+    blocks (consolidation — the write-side halo exchange) and write ONE
+    system file in the ORIGINAL global numbering: the renumbering
+    recorded by the distributed read (or given here as a partition
+    vector) is inverted so read→write round-trips byte-for-value."""
+    m = mtx.matrix
+    A = m.assemble_global() if (m.host is None and m.blocks is not None) \
+        else m.scalar_csr()
+    b = None if rhs is None else np.asarray(rhs.data)
+    x = None if sol is None else np.asarray(sol.data)
+    perm = getattr(mtx, "_dist_perm", None)
+    if perm is None and partition_vector is not None:
+        perm = np.argsort(np.asarray(partition_vector), kind="stable")
+    if perm is not None:
+        import scipy.sparse as _sp
+        inv = np.argsort(perm)
+        A = _sp.csr_matrix(A)[inv][:, inv].tocsr()
+        if b is not None and len(b) == A.shape[0]:
+            b = b[inv]
+        if x is not None and len(x) == A.shape[0]:
+            x = x[inv]
+    writer = str(mtx.rsrc.cfg.cfg.get("matrix_writer"))
+    write = (_io.write_binary if writer == "binary"
+             else _io.write_matrix_market)
+    write(path, A, rhs=b, solution=x, block_dim=m.block_dim)
 
 
 # -------------------------------------------------------------- distributed
@@ -717,9 +776,28 @@ def AMGX_matrix_upload_distributed(mtx: MatrixHandle, n_global, n, nnz,
     _try_validate_comm_maps(mtx)   # maps may have arrived before upload
 
 
+@_catches()
+def AMGX_matrix_upload_all_global_32(mtx: MatrixHandle, n_global, n, nnz,
+                                     block_dimx, block_dimy, row_ptrs,
+                                     col_indices_global, data,
+                                     diag_data=None,
+                                     allocated_halo_depth=1,
+                                     num_import_rings=1,
+                                     partition_vector=None):
+    """``amgx_c.h:568-590`` (32-bit variant): identical contract with
+    int32 global column indices — the native width of every device pack
+    here, so this simply delegates (the 64-bit entry point accepts any
+    integer dtype)."""
+    return AMGX_matrix_upload_all_global.__wrapped__(
+        mtx, n_global, n, nnz, block_dimx, block_dimy, row_ptrs,
+        np.asarray(col_indices_global, dtype=np.int32), data, diag_data,
+        allocated_halo_depth, num_import_rings, partition_vector)
+
+
 @_catches(1)
 def AMGX_distribution_create(cfg: ConfigHandle = None):
-    return {"partition_offsets": None, "num_partitions": 1}
+    return {"partition_offsets": None, "num_partitions": 1,
+            "colindices_32bit": False}
 
 
 @_catches()
@@ -729,8 +807,25 @@ def AMGX_distribution_set_partition_data(dist, kind, data):
 
 
 @_catches()
+def AMGX_distribution_set_32bit_colindices(dist, on):
+    """``amgx_c.h:438``: declare 32-bit column indices for the coming
+    upload.  Informational here — device packs always use int32 columns
+    (``DeviceMatrix`` layout), and the upload path accepts either
+    width."""
+    dist["colindices_32bit"] = bool(on)
+
+
+@_catches()
 def AMGX_distribution_destroy(dist):
     pass
+
+
+@_catches()
+def AMGX_solver_register_print_callback(fn):
+    """``amgx_c.h:396``: solver print-callback registration — the
+    reference routes it to the same global print stream as
+    ``AMGX_register_print_callback``; so do we."""
+    _register_cb(fn)
 
 
 @_catches(2)

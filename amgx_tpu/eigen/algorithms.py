@@ -102,9 +102,11 @@ class PageRankSolver(EigenSolver):
         from ..core.matrix import Matrix as _M
         self.PT = _M(sp.csr_matrix(P.T).astype(
             np.asarray(self.Ad.diag).dtype)).device()
+        # pack dtype, not f64: a wider dangling vector would promote the
+        # while-loop carry and break the traced loop on f32 devices
         self.dangling = jnp.asarray(
             (np.asarray(np.abs(csr).sum(axis=1)).ravel() == 0
-             ).astype(np.float64))
+             ).astype(np.asarray(self.Ad.diag).dtype))
         return self
 
     def solver_setup(self):

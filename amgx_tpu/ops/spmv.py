@@ -101,8 +101,13 @@ def abs_rowsum(A) -> jax.Array:
 
 
 def spmm(A: DeviceMatrix, X: jax.Array) -> jax.Array:
-    """Y = A @ X for a block of vectors X (n, m) — used by eigensolvers."""
-    return jax.vmap(lambda v: spmv(A, v), in_axes=1, out_axes=1)(X)
+    """Y = A @ X for a block of vectors X (n, m) — used by eigensolvers.
+
+    Statically unrolled over the (small, trace-time-known) vector count:
+    the Pallas kernels cannot be vmapped (ANY-memory-space operands
+    reject batching), and eigensolver blocks are a handful of columns."""
+    cols = [spmv(A, X[:, j]) for j in range(X.shape[1])]
+    return jnp.stack(cols, axis=1)
 
 
 def residual(A: DeviceMatrix, b: jax.Array, x: jax.Array) -> jax.Array:

@@ -400,11 +400,92 @@ def main():
         extra_cases["bicgstab_dilu_4x4"] = guarded("bicgstab_dilu_4x4",
                                                    case_blk)
 
+        # BASELINE config 5 (stretch): LOBPCG smallest eigenpairs +
+        # PAGERANK on a synthetic scale-free web graph — tracks
+        # eigensolver perf round over round
+        def case_eig():
+            from amgx_tpu.eigen import EigenSolverFactory
+            out = {}
+            # 32³ with a bench-scale tolerance: LOBPCG iterations pay a
+            # host round-trip each through the tunnel (~0.1-0.3 s), so
+            # the case tracks per-iteration cost, not deep convergence
+            A6 = poisson7pt(32, 32, 32)
+            m6 = amgx.Matrix(A6)
+            m6.device_dtype = np.float32
+            cfg6 = amgx.AMGConfig(
+                "config_version=2, eig_solver(e)=LOBPCG, "
+                "e:eig_max_iters=60, e:eig_tolerance=1e-4, "
+                "e:eig_wanted_count=2, e:eig_which=smallest")
+            es = EigenSolverFactory.allocate(cfg6)
+            es.setup(m6)
+            res = es.solve()            # warm/compile
+            t0 = time.perf_counter()
+            res = es.solve()
+            out["lobpcg_32cubed_s"] = round(time.perf_counter() - t0, 4)
+            out["lobpcg_iterations"] = int(res.iterations)
+            out["lobpcg_lambda_min"] = float(
+                np.min(np.asarray(res.eigenvalues).real))
+            # PageRank: preferential-attachment-ish random digraph
+            import scipy.sparse as sp
+            rng = np.random.default_rng(11)
+            nw = 200_000
+            deg = 8
+            dst = (rng.pareto(1.2, size=nw * deg) * 10).astype(np.int64)
+            dst = dst % nw
+            src = np.repeat(np.arange(nw), deg)
+            W = sp.csr_matrix((np.ones(len(src)), (src, dst)),
+                              shape=(nw, nw))
+            mw = amgx.Matrix(sp.csr_matrix(W))
+            mw.device_dtype = np.float32
+            cfg7 = amgx.AMGConfig(
+                "config_version=2, eig_solver(e)=PAGERANK, "
+                "e:eig_max_iters=200, e:eig_tolerance=1e-7")
+            ep = EigenSolverFactory.allocate(cfg7)
+            ep.setup(mw)
+            res2 = ep.solve()
+            t0 = time.perf_counter()
+            res2 = ep.solve()
+            out["pagerank_200k_s"] = round(time.perf_counter() - t0, 4)
+            out["pagerank_iterations"] = int(res2.iterations)
+            return out
+
+        extra_cases["eigen"] = guarded("eigen", case_eig)
+
+    metric_name = f"poisson{n_side}_fgmres_agg_amg_solve_s"
+    # vs_baseline against the newest recorded round with the same metric
+    # (BENCH_r*.json written by the driver): >1 = faster than baseline
+    # for this time metric; 1.0 when no comparable record exists
+    vs_baseline = 1.0
+    try:
+        import glob
+        recs = sorted(glob.glob(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_r*.json")))
+        for rec in reversed(recs):
+            with open(rec) as fh:
+                prev = json.load(fh)
+            # the driver's record wraps the bench JSON line in "tail"
+            pv = prev if "metric" in prev else None
+            if pv is None:
+                for line in str(prev.get("tail", "")).splitlines():
+                    line = line.strip()
+                    if line.startswith('{"metric"'):
+                        try:
+                            pv = json.loads(line)
+                        except Exception:
+                            pv = None
+            if pv and pv.get("metric") == metric_name and pv.get("value"):
+                vs_baseline = round(float(pv["value"]) /
+                                    float(case["solve_s"]), 3)
+                break
+    except Exception as e:
+        print(f"[bench] vs_baseline lookup failed: {e}", file=sys.stderr)
+
     out = {
-        "metric": f"poisson{n_side}_fgmres_agg_amg_solve_s",
+        "metric": metric_name,
         "value": case["solve_s"],
         "unit": "s",
-        "vs_baseline": 1.0,
+        "vs_baseline": vs_baseline,
         "extras": {
             "backend": backend,
             "n": n,

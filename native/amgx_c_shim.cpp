@@ -238,6 +238,12 @@ AMGX_RC AMGX_register_print_callback(AMGX_print_callback callback) {
     return AMGX_RC_OK; /* messages route through python stdout otherwise */
 }
 
+AMGX_RC AMGX_solver_register_print_callback(AMGX_print_callback callback) {
+    /* amgx_c.h:396: the reference routes solver prints to the same
+       global stream as AMGX_register_print_callback */
+    return AMGX_register_print_callback(callback);
+}
+
 /* ------------------------------------------------------------- config */
 AMGX_RC AMGX_config_create(AMGX_config_handle *cfg, const char *options) {
     if (ensure_init() != AMGX_RC_OK) return AMGX_RC_INTERNAL;

@@ -66,6 +66,8 @@ AMGX_RC AMGX_get_api_version(int *major, int *minor);
 AMGX_RC AMGX_get_error_string(AMGX_RC err, char *buf, int buf_len);
 void AMGX_abort(AMGX_resources_handle rsrc, int err);
 AMGX_RC AMGX_register_print_callback(AMGX_print_callback callback);
+/* amgx_c.h:396 — routes to the same global print stream */
+AMGX_RC AMGX_solver_register_print_callback(AMGX_print_callback callback);
 AMGX_RC AMGX_install_signal_handler(void);
 AMGX_RC AMGX_reset_signal_handler(void);
 AMGX_RC AMGX_pin_memory(void *ptr, unsigned int bytes);

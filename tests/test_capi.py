@@ -292,3 +292,41 @@ def test_attach_geometry_enables_geo_fast_path():
         z.ravel().astype(float))
     assert rc == 0
     assert mtx.matrix.grid_dims == (nz, ny, nx)
+
+
+def test_capi_tail_functions():
+    """VERDICT r3 Missing #7: the last three reference entry points —
+    upload_all_global_32, distribution_set_32bit_colindices,
+    solver_register_print_callback."""
+    from amgx_tpu import capi
+    from amgx_tpu.io import poisson5pt
+
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    n = A.shape[0]
+    rc, cfg = capi.AMGX_config_create(
+        "config_version=2, solver(out)=PCG, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=1")
+    rc, rsrc = capi.AMGX_resources_create_simple(cfg)
+    rc, mtx = capi.AMGX_matrix_create(rsrc, "dDDI")
+    rc = capi.AMGX_matrix_upload_all_global_32(
+        mtx, n, n, A.nnz, 1, 1, A.indptr,
+        A.indices.astype(np.int32), A.data)
+    assert rc == 0
+    assert mtx.matrix.shape == (n, n)
+
+    rc, dist = capi.AMGX_distribution_create(cfg)
+    assert rc == 0
+    assert capi.AMGX_distribution_set_32bit_colindices(dist, True) == 0
+    assert dist["colindices_32bit"] is True
+    capi.AMGX_distribution_destroy(dist)
+
+    lines = []
+    assert capi.AMGX_solver_register_print_callback(
+        lambda s: lines.append(s)) == 0
+    from amgx_tpu.utils import amgx_output
+    amgx_output("print-callback probe\n")
+    from amgx_tpu import register_print_callback
+    register_print_callback(None)
+    assert any("print-callback probe" in ln for ln in lines)

@@ -208,3 +208,15 @@ def test_classical_resetup_refreshed_values_match_host_galerkin():
     Ac_ref = sp.csr_matrix(sp.csr_matrix(P0.T) @ A2 @ P0)
     diff = abs(Ac_dev - Ac_ref)
     assert diff.max() < 1e-10 * max(1.0, abs(Ac_ref).max())
+
+
+@pytest.mark.parametrize("dims", [(2, 2, 2), (3, 2, 2), (4, 3, 1)])
+def test_device_fine_tiny_grids(dims):
+    """Tiny grids where D2 pairwise-sum offsets reach |d| >= n must not
+    break the shifted-slice reads (regression: (3,) vs (4,) broadcast
+    crash on the 12x12 reference config systems' coarse levels)."""
+    A = sp.csr_matrix(poisson7pt(*dims))
+    cf_ref, P_ref = _host_ref(A, D2Interpolator)
+    cf_dev, P_dev = _device(A, True)
+    assert np.array_equal(cf_ref.astype(np.int8), cf_dev)
+    assert abs(P_ref - P_dev).max() < 1e-12
