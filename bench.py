@@ -14,14 +14,17 @@ iterative refinement against the fp64 host matrix (the reference's dDFI
 mixed mode, amgx_config.h:114-123).
 
 Timing note: the remote-TPU tunnel adds O(100 ms) per host sync and runs
-at ~20-130 MB/s (vs ~25 GB/s PCIe in the reference rig), so (a) the SpMV
+at ~2-130 MB/s (vs ~25 GB/s PCIe in the reference rig), so (a) the SpMV
 measurement amortises a long in-executable chain between two syncs with
-min-of-reps noise rejection, and (b) the fine-operator transfer is timed
-separately as ``upload_s`` — the reference's AMGX_matrix_upload_all is
-likewise a separate API call from AMGX_solver_setup, whose GPU analog
-pays PCIe bandwidth, not tunnel bandwidth.  ``setup_s`` is the
-AMGX_solver_setup analog: the AMG setup loop, which round 3 moved onto
-the device (amg/dia_device.py).
+min-of-reps noise rejection, and (b) ``upload_s`` times the
+fine-operator ACQUISITION separately — a tunnel transfer for uploaded
+systems (the AMGX_matrix_upload_all analog) or the on-device generation
+(io/device_gen.py; the reference generates its benchmark operator
+in-library too).  ``setup_s`` is the AMGX_solver_setup analog: the AMG
+setup loop — DIA hierarchies and classical stencil fine levels derive
+on device (amg/dia_device.py, amg/classical/device_fine.py); classical
+COARSE levels and the hierarchy transfer still pay host+tunnel costs
+that move with the tunnel's regime.
 """
 import json
 import os
