@@ -16,6 +16,11 @@ import scipy.sparse as sp
 from ...errors import BadConfigurationError
 from .util import entry_mask_in
 
+
+def _rowsum(n, rows, data, mask):
+    """Masked per-row sum via bincount (np.add.at is ~5x slower)."""
+    return np.bincount(rows[mask], weights=data[mask], minlength=n)
+
 _interp_registry: Dict[str, type] = {}
 
 
@@ -49,18 +54,32 @@ def truncate_and_scale(P: sp.csr_matrix, trunc_factor: float,
     if trunc_factor < 1.0:
         keep &= absd >= trunc_factor * rowmax[rows]
     if max_elements > 0:
-        # keep only the max_elements largest entries per row
-        order = np.lexsort((-absd, rows))
-        rank = np.empty(len(order), dtype=np.int64)
-        pos_in_row = np.arange(len(order)) - np.repeat(
-            P.indptr[:-1], np.diff(P.indptr))
-        rank[order] = pos_in_row
-        keep &= rank < max_elements
-    old_sum = np.zeros(n)
-    np.add.at(old_sum, rows, P.data)
+        # keep the max_elements largest |entries| per row WITHOUT the
+        # 22M-entry lexsort (2.4 s/level at 128-cubed): max_elements
+        # passes of row-max + mask, each a bincount-speed reduction
+        remaining = keep.copy()
+        topk = np.zeros(len(P.data), dtype=bool)
+        for _ in range(max_elements):
+            if not remaining.any():
+                break
+            rowmax_r = np.full(n, -1.0)
+            np.maximum.at(rowmax_r, rows[remaining], absd[remaining])
+            # first occurrence of each row's current max: mark + retire
+            is_max = remaining & (absd == rowmax_r[rows])
+            # ties within a row would mark several at once — keep only
+            # the FIRST (stable CSR order) via cumcount-within-run
+            if is_max.any():
+                idx = np.flatnonzero(is_max)
+                first = np.ones(len(idx), dtype=bool)
+                first[1:] = rows[idx[1:]] != rows[idx[:-1]]
+                sel = idx[first]
+                topk[sel] = True
+                remaining[sel] = False
+                # rows that reached their quota... handled by loop count
+        keep &= topk
+    old_sum = np.bincount(rows, weights=P.data, minlength=n)
     P.data = np.where(keep, P.data, 0.0)
-    new_sum = np.zeros(n)
-    np.add.at(new_sum, rows, P.data)
+    new_sum = np.bincount(rows, weights=P.data, minlength=n)
     scale = np.where(new_sum != 0, old_sum / np.where(new_sum == 0, 1.0,
                                                       new_sum), 1.0)
     P.data = P.data * scale[rows]
@@ -116,14 +135,10 @@ class D1Interpolator(_InterpolatorBase):
         neg = data < 0
         pos = data > 0
         # row sums over all off-diag and over C_i, split by sign
-        sum_all_neg = np.zeros(n)
-        sum_all_pos = np.zeros(n)
-        np.add.at(sum_all_neg, rows[off & neg], data[off & neg])
-        np.add.at(sum_all_pos, rows[off & pos], data[off & pos])
-        sum_c_neg = np.zeros(n)
-        sum_c_pos = np.zeros(n)
-        np.add.at(sum_c_neg, rows[in_Ci & neg], data[in_Ci & neg])
-        np.add.at(sum_c_pos, rows[in_Ci & pos], data[in_Ci & pos])
+        sum_all_neg = _rowsum(n, rows, data, off & neg)
+        sum_all_pos = _rowsum(n, rows, data, off & pos)
+        sum_c_neg = _rowsum(n, rows, data, in_Ci & neg)
+        sum_c_pos = _rowsum(n, rows, data, in_Ci & pos)
 
         alpha = np.where(sum_c_neg != 0, sum_all_neg /
                          np.where(sum_c_neg == 0, 1.0, sum_c_neg), 0.0)
@@ -154,7 +169,9 @@ class D2Interpolator(_InterpolatorBase):
     through the common C neighbours before the direct formula."""
 
     def compute(self, A, S, cf_map):
-        A = sp.csr_matrix(A).astype(np.float64)
+        A = sp.csr_matrix(A)
+        if A.dtype != np.float64:
+            A = A.astype(np.float64)   # copies — mask attach won't hit
         n = A.shape[0]
         # Build the operator Â where each strong F neighbour k of i is
         # replaced by its own strong-C row (one Jacobi-like substitution):
@@ -174,8 +191,7 @@ class D2Interpolator(_InterpolatorBase):
         A_fs.eliminate_zeros()
         # distribution operator: row k of W = a_kj/Σ_{j∈C_k^s} a_kj over C_k^s
         in_Ck = off & strong & (cf_map[indices] > 0)
-        sum_ck = np.zeros(n)
-        np.add.at(sum_ck, rows[in_Ck], data[in_Ck])
+        sum_ck = _rowsum(n, rows, data, in_Ck)
         wk = np.where(in_Ck, data / np.where(sum_ck[rows] == 0, 1.0,
                                              sum_ck[rows]), 0.0)
         W = sp.csr_matrix((wk, indices.copy(), indptr.copy()), shape=A.shape)
@@ -200,7 +216,9 @@ class MultipassInterpolator(_InterpolatorBase):
     interpolate through already-interpolated neighbours (passes 2..)."""
 
     def compute(self, A, S, cf_map):
-        A = sp.csr_matrix(A).astype(np.float64)
+        A = sp.csr_matrix(A)
+        if A.dtype != np.float64:
+            A = A.astype(np.float64)   # copies — mask attach won't hit
         n = A.shape[0]
         cnum = _coarse_numbering(cf_map)
         nc = int(cf_map.sum())

@@ -85,6 +85,10 @@ class AhatStrength(_StrengthBase):
         S = sp.csr_matrix((strong.astype(np.int8), indices.copy(),
                            indptr.copy()), shape=A.shape)
         S.eliminate_zeros()
+        # the mask aligned with A's stored entries — interpolators skip
+        # their entry_mask_in merge for any shallow re-wrap of A (the
+        # attach is keyed on the shared index buffers)
+        S._amgx_mask_src = (A.indices, A.indptr, strong)
         return S
 
 
@@ -99,6 +103,8 @@ class AllStrength(_StrengthBase):
              A.indptr.copy()), shape=A.shape)
         S.setdiag(0)
         S.eliminate_zeros()
+        rows = np.repeat(np.arange(A.shape[0]), np.diff(A.indptr))
+        S._amgx_mask_src = (A.indices, A.indptr, A.indices != rows)
         return S
 
 
@@ -134,4 +140,5 @@ class AffinityStrength(_StrengthBase):
         S = sp.csr_matrix((strong.astype(np.int8), indices.copy(),
                            indptr.copy()), shape=A.shape)
         S.eliminate_zeros()
+        S._amgx_mask_src = (A.indices, A.indptr, strong)
         return S

@@ -8,9 +8,14 @@ import scipy.sparse as sp
 def entry_mask_in(A: sp.csr_matrix, S: sp.csr_matrix) -> np.ndarray:
     """For each stored entry (i,j) of A, True iff (i,j) is stored in S.
 
-    O(nnz log nnz) merge on (row, col) keys — both matrices must have
-    sorted indices.
-    """
+    Fast path: the strength classes attach the boolean mask they
+    derived from A itself, aligned with A.data and keyed on the SHARED
+    index buffers (``csr_matrix`` re-wraps share them), so this becomes
+    a lookup instead of an O(nnz log nnz) merge (~2.7 s per level on a
+    572k-row coarse operator)."""
+    att = getattr(S, "_amgx_mask_src", None)
+    if att is not None and att[0] is A.indices and att[1] is A.indptr:
+        return att[2]
     A = sp.csr_matrix(A)
     S = sp.csr_matrix(S)
     A.sort_indices()

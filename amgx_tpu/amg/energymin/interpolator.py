@@ -30,7 +30,9 @@ class EnergyMinInterpolator(D1Interpolator):
     omega = 0.6
 
     def compute(self, A, S, cf_map):
-        A = sp.csr_matrix(A).astype(np.float64)
+        A = sp.csr_matrix(A)
+        if A.dtype != np.float64:
+            A = A.astype(np.float64)   # copies — mask attach won't hit
         P = super().compute(A, S, cf_map)
         # allowed pattern: distance-2 neighbourhood of the D1 pattern
         pattern = sp.csr_matrix(
