@@ -59,15 +59,21 @@ def truncate_and_scale(P: sp.csr_matrix, trunc_factor: float,
         # passes of row-max + mask, each a bincount-speed reduction
         remaining = keep.copy()
         topk = np.zeros(len(P.data), dtype=bool)
+        starts = P.indptr[:-1]
+        nonempty = np.diff(P.indptr) > 0
         for _ in range(max_elements):
             if not remaining.any():
                 break
+            # segment row-max via reduceat (contiguous CSR rows) — the
+            # buffered np.maximum.at was ~5x slower per pass
+            masked = np.where(remaining, absd, -1.0)
             rowmax_r = np.full(n, -1.0)
-            np.maximum.at(rowmax_r, rows[remaining], absd[remaining])
+            if nonempty.any():
+                red = np.maximum.reduceat(masked, starts[nonempty])
+                rowmax_r[nonempty] = red
             # first occurrence of each row's current max: mark + retire
-            is_max = remaining & (absd == rowmax_r[rows])
-            # ties within a row would mark several at once — keep only
-            # the FIRST (stable CSR order) via cumcount-within-run
+            is_max = remaining & (absd == rowmax_r[rows]) & \
+                (rowmax_r[rows] >= 0)
             if is_max.any():
                 idx = np.flatnonzero(is_max)
                 first = np.ones(len(idx), dtype=bool)
@@ -75,7 +81,6 @@ def truncate_and_scale(P: sp.csr_matrix, trunc_factor: float,
                 sel = idx[first]
                 topk[sel] = True
                 remaining[sel] = False
-                # rows that reached their quota... handled by loop count
         keep &= topk
     old_sum = np.bincount(rows, weights=P.data, minlength=n)
     P.data = np.where(keep, P.data, 0.0)

@@ -409,15 +409,13 @@ def main():
         def case_eig():
             from amgx_tpu.eigen import EigenSolverFactory
             out = {}
-            # 32³ with a bench-scale tolerance: LOBPCG iterations pay a
-            # host round-trip each through the tunnel (~0.1-0.3 s), so
-            # the case tracks per-iteration cost, not deep convergence
+            # fused whole-loop LOBPCG: one executable, one host sync
             A6 = poisson7pt(32, 32, 32)
             m6 = amgx.Matrix(A6)
             m6.device_dtype = np.float32
             cfg6 = amgx.AMGConfig(
                 "config_version=2, eig_solver(e)=LOBPCG, "
-                "e:eig_max_iters=60, e:eig_tolerance=1e-4, "
+                "e:eig_max_iters=300, e:eig_tolerance=1e-4, "
                 "e:eig_wanted_count=2, e:eig_which=smallest")
             es = EigenSolverFactory.allocate(cfg6)
             es.setup(m6)
