@@ -143,9 +143,49 @@ class PageRankSolver(EigenSolver):
                            iterations=int(it), status=status)
 
 
+@functools.lru_cache(maxsize=None)
+def _subspace_fn(n: int, m: int, k: int, dtype_str: str, tol: float,
+                 max_iters: int, shift: float):
+    """Compiled-once subspace-iteration loop (same whole-loop fusion as
+    LOBPCG: a per-iteration host sync costs ~0.3 s through the tunnel)."""
+    dt = jnp.dtype(dtype_str)
+
+    def body(Ad, carry):
+        X, lam_old, it, _done = carry
+        Y = spmm(Ad, X)
+        if shift:
+            Y = Y - jnp.asarray(shift, dt) * X
+        Q, _ = jnp.linalg.qr(Y)
+        AQ = spmm(Ad, Q)
+        if shift:
+            AQ = AQ - jnp.asarray(shift, dt) * Q
+        H = Q.T @ AQ
+        w, V = jnp.linalg.eigh((H + H.T) / 2)
+        order = jnp.argsort(-jnp.abs(w))
+        X = Q @ V[:, order]
+        lam = w[order]
+        done = jnp.max(jnp.abs(lam[:k] - lam_old[:k])) <= \
+            tol * jnp.maximum(jnp.max(jnp.abs(lam[:k])), 1e-30)
+        return X, lam, it + 1, done
+
+    def cond(carry):
+        _X, _lam, it, done = carry
+        return (~done) & (it < max_iters)
+
+    @jax.jit
+    def run(Ad, X0):
+        return jax.lax.while_loop(
+            cond, lambda c: body(Ad, c),
+            (X0, jnp.zeros((m,), dt), jnp.asarray(0),
+             jnp.asarray(False)))
+
+    return run
+
+
 @register_eigensolver("SUBSPACE_ITERATION")
 class SubspaceIterationSolver(EigenSolver):
-    """Block power iteration + Rayleigh-Ritz (``subspace_iteration.cu``)."""
+    """Block power iteration + Rayleigh-Ritz (``subspace_iteration.cu``),
+    fused into one cached ``lax.while_loop`` executable."""
 
     def _solve_impl(self, x0):
         k = max(self.wanted_count, 1)
@@ -154,29 +194,16 @@ class SubspaceIterationSolver(EigenSolver):
         rng = np.random.default_rng(1)
         X = jnp.asarray(rng.standard_normal((n, m)), dtype=x0.dtype)
         X, _ = jnp.linalg.qr(X)
-        lam_old = jnp.zeros((m,), X.dtype)
-        it_done = 0
-        for it in range(self.max_iters):
-            Y = spmm(self.Ad, X)
-            if self.shift:
-                Y = Y - self.shift * X
-            Q, _ = jnp.linalg.qr(Y)
-            H = Q.T @ spmm(self.Ad, Q)
-            w, V = jnp.linalg.eigh((H + H.T) / 2)
-            order = jnp.argsort(-jnp.abs(w))
-            X = Q @ V[:, order]
-            lam = w[order]
-            it_done = it + 1
-            if bool(jnp.max(jnp.abs(lam[:k] - lam_old[:k])) <=
-                    self.tolerance * jnp.maximum(jnp.max(jnp.abs(lam[:k])),
-                                                 1e-300)):
-                lam_old = lam
-                break
-            lam_old = lam
-        lam_np = np.asarray(lam_old)[:k] + self.shift
+        run = _subspace_fn(n, m, k, np.dtype(self.Ad.dtype).str,
+                           float(self.tolerance), int(self.max_iters),
+                           float(self.shift))
+        X, lam, it, done = run(self.Ad, X)
+        lam_np = np.asarray(lam)[:k] + self.shift
+        status = SolveStatus.SUCCESS if bool(done) else \
+            SolveStatus.NOT_CONVERGED
         return EigenResult(eigenvalues=lam_np,
                            eigenvectors=np.asarray(X)[:, :k],
-                           iterations=it_done, status=SolveStatus.SUCCESS)
+                           iterations=int(it), status=status)
 
 
 @register_eigensolver("LANCZOS")
