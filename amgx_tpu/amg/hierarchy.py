@@ -194,7 +194,15 @@ class AMGHierarchy:
                 else:
                     self._setup_fresh(A)
         except BaseException:
-            # a partial structure must never feed a later reuse pass
+            # a partial structure must never feed a later reuse pass;
+            # a streaming uploader must not outlive the failed setup
+            st = getattr(self, "_stream_uploader", None)
+            if st is not None:
+                try:
+                    st.join_threads()
+                except Exception:
+                    pass
+                self._stream_uploader = None
             self._structure = None
             self.levels = []
             raise
@@ -261,11 +269,40 @@ class AMGHierarchy:
                                fine_map=fine_map, fine_map_dev=None,
                                fine_mask=mask)
 
+    def _level_pack_mats(self, level):
+        """(matrices, lean-exception ids) of one level's packs — shared
+        by the streaming uploader and the final arena upload so the two
+        can never diverge: the fine level (index 0) ships NON-lean (its
+        gather-form cols/vals feed mixed-precision refinement)."""
+        mats = [level.A]
+        if hasattr(level, "transfer_matrices"):
+            mats.extend(level.transfer_matrices())
+        lean_except = {id(level.A)} if self.levels and \
+            self.levels[0] is level else set()
+        return mats, lean_except
+
     def _build_levels(self, cur: Matrix) -> Matrix:
         """Run the fresh coarsening loop from ``cur``, appending to
         ``self.levels`` / ``self._structure``; returns the coarsest matrix
-        (reference hot setup loop, ``amg.cu:177-450``)."""
+        (reference hot setup loop, ``amg.cu:177-450``).
+
+        Classical serial setups STREAM each finished level's packs to the
+        device on a worker thread while the next level coarsens on host:
+        through the remote tunnel the hierarchy transfer otherwise
+        serialises after all host work (the reference's uploads ride a
+        CUDA stream concurrently with setup for the same reason).  The
+        wire transfer releases the GIL, so host coarsening and the
+        upload genuinely overlap; ``_setup_smoothers_and_coarse`` drains
+        the stream before touching any pack."""
         cur = self._build_dia_device(cur)
+        stream = None
+        if self.algorithm == "CLASSICAL" and cur.dist is None:
+            from ..utils.thread_manager import ThreadManager
+            stream = ThreadManager(
+                max_workers=1,
+                serialize=bool(self.cfg.get("serialize_threads")))
+            stream.spawn_threads()
+            self._stream_uploader = stream
         while True:
             n = cur.n_block_rows
             if len(self.levels) + 1 >= self.max_levels:
@@ -284,6 +321,13 @@ class AMGHierarchy:
                 break
             self.levels.append(level)
             self._structure.append(struct)
+            if stream is not None and getattr(level, "kind", "") == \
+                    "classical":
+                from ..core.matrix import batch_upload
+                mats, lean_except = self._level_pack_mats(level)
+                stream.push_work(
+                    lambda ms=mats, le=lean_except:
+                    batch_upload(ms, lean_except=le))
             cur = Ac
         return cur
 
@@ -979,16 +1023,19 @@ class AMGHierarchy:
         # ~0.1 s-per-array tunnel latency otherwise dominates hierarchy
         # setup (reference: the hierarchy lives on device from the
         # start, amg.cu:177-450)
+        stream = getattr(self, "_stream_uploader", None)
+        if stream is not None:
+            # wait out the per-level uploads streamed during coarsening
+            # (only the residual wire time shows up here)
+            with cpu_profiler("hierarchy_upload_drain"):
+                stream.join_threads()
+            self._stream_uploader = None
         with cpu_profiler("hierarchy_upload"):
-            mats = []
+            mats, fine_ids = [], set()
             for lvl in self.levels:
-                mats.append(lvl.A)
-                if hasattr(lvl, "transfer_matrices"):
-                    mats.extend(lvl.transfer_matrices())
-            # the fine level is the USER's solve matrix: keep its
-            # gather-form cols/vals (mixed-precision refinement needs
-            # them); hierarchy-internal levels ship lean
-            fine_ids = {id(self.levels[0].A)} if self.levels else set()
+                ms, le = self._level_pack_mats(lvl)
+                mats.extend(ms)
+                fine_ids |= le
             batch_upload(mats + [coarsest], lean_except=fine_ids)
 
         def smoother_task(lvl):
