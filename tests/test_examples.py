@@ -26,6 +26,7 @@ EXAMPLES = [
     ("amgx_mpi_capi_multi.py", ["-m", "{mtx}", "-p", "7"], True),
     ("amgx_mpi_poisson5pt.py", ["-p", "24", "24", "2", "2"], False),
     ("eigensolver_mpi.py", ["-m", "{mtx}", "-p", "4"], False),
+    ("amgx_resetup_timestepping.py", ["-n", "12", "-steps", "2"], True),
 ]
 
 
