@@ -248,3 +248,47 @@ def test_pmis_makes_progress_on_uniform_ring():
     # maximal: every F point has a C neighbour
     f = np.flatnonzero(cf == 0)
     assert np.all(cf[(f + 1) % n] | cf[(f - 1) % n])
+
+
+def test_failed_setup_mid_stream_drains_uploader():
+    """A coarsening failure while per-level uploads are streaming must
+    join the worker, clear the partial structure, and leave the solver
+    reusable (hierarchy.setup's exception path)."""
+    from amgx_tpu.amg import hierarchy as H
+    from amgx_tpu.io import poisson7pt
+
+    A = poisson7pt(16, 16, 16)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=50, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+        "amg:interpolator=D2, amg:max_iters=1, amg:max_levels=6, "
+        "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+        "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+
+    orig = H.AMGHierarchy._coarsen_once
+    calls = {"n": 0}
+
+    def boom(self, cur, idx):
+        calls["n"] += 1
+        if calls["n"] >= 3:       # fail after two streamed levels
+            raise RuntimeError("synthetic coarsening failure")
+        return orig(self, cur, idx)
+
+    H.AMGHierarchy._coarsen_once = boom
+    try:
+        with pytest.raises(Exception):
+            slv.setup(amgx.Matrix(A))
+    finally:
+        H.AMGHierarchy._coarsen_once = orig
+    hier = slv.preconditioner.hierarchy
+    assert hier.levels == [] and hier._structure is None
+    assert getattr(hier, "_stream_uploader", None) is None
+    # the solver recovers with a clean setup
+    slv2 = amgx.create_solver(cfg)
+    slv2.setup(amgx.Matrix(A))
+    res = slv2.solve(np.ones(A.shape[0]))
+    x = np.asarray(res.x)
+    assert np.linalg.norm(np.ones(A.shape[0]) - A @ x) < 1e-5
