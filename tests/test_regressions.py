@@ -279,10 +279,13 @@ def test_failed_setup_mid_stream_drains_uploader():
 
     H.AMGHierarchy._coarsen_once = boom
     try:
-        with pytest.raises(Exception):
+        with pytest.raises(RuntimeError,
+                           match="synthetic coarsening failure"):
             slv.setup(amgx.Matrix(A))
     finally:
         H.AMGHierarchy._coarsen_once = orig
+    # the failure really fired mid-stream (two levels already streamed)
+    assert calls["n"] == 3
     hier = slv.preconditioner.hierarchy
     assert hier.levels == [] and hier._structure is None
     assert getattr(hier, "_stream_uploader", None) is None
