@@ -30,6 +30,11 @@ downloads (n·(1+Kp·2)) small arrays, assembles scipy P, and continues
 the (cheap) coarse levels as before.  Entries are "present" iff their
 stored DIA value is nonzero — identical semantics to the
 ``dia_to_scipy`` assembly the host path would see.
+
+The building blocks (strength / PMIS / Â / D1 weights / truncation) are
+module-level functions shared with the fully-device hierarchy pipeline
+(:mod:`.device_pipeline`), which keeps the results ON device and runs
+the Galerkin product there too.
 """
 from __future__ import annotations
 
@@ -67,6 +72,225 @@ def ahat_plan(offs: Sequence[int]) -> Tuple[Tuple[int, ...], list]:
     return tuple(out), [sums.get(e, []) for e in out]
 
 
+def pmis_multiplier(n: int) -> int:
+    """The affine-bijection multiplier of ``selectors.pmis_tie_breaker``
+    (coprime to n) — shared so device runs reproduce the host weights."""
+    a_mult = 2654435761
+    while np.gcd(a_mult, n) != 1:
+        a_mult += 1
+    return a_mult
+
+
+def dia_strength(vals, offs: Sequence[int], n: int, dt, theta: float,
+                 max_row_sum: float, strength_all: bool) -> List:
+    """AHAT/ALL strength rows over a DIA stencil (strength/ahat.cu
+    formula; returns one bool row per diagonal, diagonal slot all-False).
+    """
+    import functools as _ft
+
+    import jax.numpy as jnp
+    offs = [int(o) for o in offs]
+    nd = len(offs)
+    k0 = offs.index(0)
+    offd = [k for k in range(nd) if k != k0]
+    diag = vals[k0]
+    present = [vals[k] != 0 for k in range(nd)]
+    if strength_all:
+        return [present[k] if k != k0 else jnp.zeros_like(present[k])
+                for k in range(nd)]
+    sgn = jnp.sign(diag)
+    sgn = jnp.where(sgn == 0, jnp.asarray(1.0, dt), sgn)
+    ninf = jnp.asarray(-jnp.inf, dt)
+    meas = [jnp.where(present[k], -vals[k] * sgn, ninf) for k in offd]
+    meas_abs = [jnp.where(present[k], jnp.abs(vals[k]), ninf)
+                for k in offd]
+    rowmax = _ft.reduce(jnp.maximum, meas)
+    no_neg = ~(rowmax > 0)
+    rowmax_abs = _ft.reduce(jnp.maximum, meas_abs)
+    rowmax_f = jnp.where(no_neg, rowmax_abs, rowmax)
+    strong = {}
+    for j, k in enumerate(offd):
+        mf = jnp.where(no_neg, meas_abs[j], meas[j])
+        strong[k] = (mf >= theta * rowmax_f) & (mf > 0)
+    if max_row_sum < 1.0 + 1e-12:
+        rs = sum(vals[k] for k in range(nd))
+        dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
+        weak = jnp.abs(rs / dsafe) > max_row_sum
+        strong = {k: s & ~weak for k, s in strong.items()}
+    return [strong.get(k, jnp.zeros(n, dtype=bool)) for k in range(nd)]
+
+
+def dia_pmis(S, offs: Sequence[int], n: int, seed: int):
+    """PMIS C/F split over the symmetrised DIA strength graph — the same
+    synchronous two-phase rounds and strictly-distinct tie-break weights
+    as the host ``selectors._pmis``.  Returns cf (n,) bool."""
+    import functools as _ft
+
+    import jax
+    import jax.numpy as jnp
+    offs = [int(o) for o in offs]
+    nd = len(offs)
+    k0 = offs.index(0)
+    offd = [k for k in range(nd) if k != k0]
+    kneg = {o: k for k, o in enumerate(offs)}
+    a_mult = pmis_multiplier(n)
+    # tie-break permutation computed ON DEVICE — int64 exact for
+    # a·i < 2^50; a 2 MB fraction upload through the tunnel would cost
+    # more than the rest of the program
+    i64 = jnp.arange(n, dtype=jnp.int64)
+    perm = (i64 * a_mult + (seed % n)) % n
+    frac = (perm.astype(jnp.float64) + 1.0) / float(n + 2)
+    # symmetrised graph row masks: G_d = S_d | shift(S_{-d}, d)
+    G = []
+    for k in range(nd):
+        if k == k0:
+            G.append(jnp.zeros(n, dtype=bool))
+            continue
+        g = S[k]
+        ko = kneg.get(-offs[k])
+        if ko is not None:
+            g = g | _shift(S[ko], offs[k], False)
+        G.append(g)
+    # lam[j] = #rows strongly depending on j = Σ_k shift(S_k, -off_k)
+    lam = sum(_shift(S[k].astype(jnp.float64), -offs[k])
+              for k in offd)
+    w = lam + frac                      # strictly distinct (f64)
+    deg = sum(G[k].astype(jnp.int32) for k in offd)
+    state0 = jnp.where(deg == 0, 0, -1).astype(jnp.int32)
+
+    def round_(state):
+        und = state == -1
+        ninf = jnp.asarray(-jnp.inf, jnp.float64)
+        max_nb = _ft.reduce(jnp.maximum, [
+            jnp.where(und & G[k] & _shift(und, offs[k], False),
+                      _shift(w, offs[k], ninf), ninf)
+            for k in offd])
+        become_c = und & ((max_nb == -jnp.inf) | (w > max_nb))
+        state = jnp.where(become_c, 1, state)
+        just_c = become_c
+        near_c = _ft.reduce(jnp.logical_or, [
+            G[k] & _shift(just_c, offs[k], False) for k in offd])
+        return jnp.where((state == -1) & near_c, 0, state)
+
+    state = jax.lax.while_loop(
+        lambda s: jnp.any(s == -1), lambda s: round_(s), state0)
+    return state == 1
+
+
+def dia_ahat(vals, S, cf, offs: Sequence[int],
+             hat_offs: Tuple[int, ...], hat_pairs, interp_d2: bool,
+             n: int, dt):
+    """Â rows (nh, n): A − A_Fs + A_Fs·W (D2) or A itself (D1); plus the
+    per-hat-offset shifted cf masks."""
+    import jax.numpy as jnp
+    offs = [int(o) for o in offs]
+    nd = len(offs)
+    k0 = offs.index(0)
+    offd = [k for k in range(nd) if k != k0]
+    kneg = {o: k for k, o in enumerate(offs)}
+    nh = len(hat_offs)
+    cf_sh = {k: _shift(cf, offs[k], False) for k in range(nd)}
+    if not interp_d2:
+        return [vals[k] for k in range(nd)], cf_sh
+    zero = jnp.zeros(n, dtype=dt)
+    A_fs = {k: jnp.where(S[k] & ~cf_sh[k], vals[k], zero)
+            for k in offd}
+    in_ck = {k: S[k] & cf_sh[k] for k in offd}
+    sum_ck = sum(jnp.where(in_ck[k], vals[k], zero) for k in offd)
+    cksafe = jnp.where(sum_ck == 0, jnp.asarray(1.0, dt), sum_ck)
+    W = {k: jnp.where(in_ck[k], vals[k] / cksafe, zero)
+         for k in offd}
+    rows = []
+    for e_i, e in enumerate(hat_offs):
+        acc = zero
+        if e in kneg:
+            k = kneg[e]
+            acc = vals[k] - (A_fs[k] if k in A_fs else zero)
+        for (k1, k2) in hat_pairs[e_i]:
+            acc = acc + A_fs[k1] * _shift(W[k2], offs[k1])
+        rows.append(acc)
+    cf_hat = {e_i: _shift(cf, hat_offs[e_i], False)
+              for e_i in range(nh)}
+    return rows, cf_hat
+
+
+def dia_d1_weights(hat, cf_sh, cf, hat_offs: Tuple[int, ...], n: int,
+                   dt, strength_rows=None):
+    """Direct interpolation on Â.
+
+    For the D2 path Â already collapsed strong F couplings and the host
+    composition uses ALL strength (every stored entry), so
+    ``strength_rows`` is None and C_i = {nonzero Â entries at C columns}.
+    For the D1 path (hat = A) the host ``D1Interpolator`` restricts C_i
+    to STRENGTH-filtered entries (``off & strong_mask & is_c_col``,
+    reference ``distance1.cu``) — callers pass the strength rows aligned
+    with ``hat_offs`` so weak couplings stay out of the α/β denominators
+    (advisor finding, round 4)."""
+    import jax.numpy as jnp
+    h0 = hat_offs.index(0)
+    nh = len(hat_offs)
+    zero = jnp.zeros(n, dtype=dt)
+    diag = hat[h0]
+    dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
+    ho = [e_i for e_i in range(nh) if e_i != h0]
+    neg = {e_i: hat[e_i] < 0 for e_i in ho}
+    pos = {e_i: hat[e_i] > 0 for e_i in ho}
+    if strength_rows is None:
+        in_ci = {e_i: (hat[e_i] != 0) & cf_sh[e_i] for e_i in ho}
+    else:
+        in_ci = {e_i: strength_rows[e_i] & cf_sh[e_i] for e_i in ho}
+    s_all_neg = sum(jnp.where(neg[e], hat[e], zero) for e in ho)
+    s_all_pos = sum(jnp.where(pos[e], hat[e], zero) for e in ho)
+    s_c_neg = sum(jnp.where(in_ci[e] & neg[e], hat[e], zero)
+                  for e in ho)
+    s_c_pos = sum(jnp.where(in_ci[e] & pos[e], hat[e], zero)
+                  for e in ho)
+    one = jnp.asarray(1.0, dt)
+    alpha = jnp.where(s_c_neg != 0,
+                      s_all_neg / jnp.where(s_c_neg == 0, one,
+                                            s_c_neg), zero)
+    beta = jnp.where(s_c_pos != 0,
+                     s_all_pos / jnp.where(s_c_pos == 0, one,
+                                           s_c_pos), zero)
+    f_row = ~cf
+    ws = []
+    for e_i in ho:
+        coef = jnp.where(neg[e_i], alpha, beta)
+        w = -coef * hat[e_i] / dsafe
+        ws.append(jnp.where(in_ci[e_i] & f_row, w, zero))
+    return ws, ho
+
+
+def dia_truncate(ws, trunc_factor: float, max_elements: int, Kp: int):
+    """truncate_and_scale parity: drop small entries, keep the
+    ``max_elements`` largest per row, rescale to preserve row sums.
+    Returns (kv (n, Kp), topi (n, Kp) slot indices into ws)."""
+    import jax
+    import jax.numpy as jnp
+    W = jnp.stack(ws, axis=1)                     # (n, nh-1)
+    absw = jnp.abs(W)
+    old_sum = jnp.sum(W, axis=1)
+    keep = W != 0
+    if trunc_factor < 1.0:
+        rowmax = jnp.max(absw, axis=1)
+        keep &= absw >= trunc_factor * rowmax[:, None]
+    if max_elements > 0:
+        # rank by |w| descending, ties to lower index (= ascending
+        # offset — the host lexsort's stable order)
+        topv, topi = jax.lax.top_k(jnp.where(keep, absw, -1.0),
+                                   min(Kp, W.shape[1]))
+        kv = jnp.take_along_axis(W, topi, axis=1)
+        kv = jnp.where(topv > 0, kv, 0.0)
+    else:
+        kv, topi = jnp.where(keep, W, 0.0), \
+            jnp.broadcast_to(jnp.arange(W.shape[1]), W.shape)
+    new_sum = jnp.sum(kv, axis=1)
+    scale = jnp.where(new_sum != 0,
+                      old_sum / jnp.where(new_sum == 0, 1.0,
+                                          new_sum), 1.0)
+    return kv * scale[:, None], topi
+
+
 @functools.lru_cache(maxsize=32)
 def _fine_fn(offs: Tuple[int, ...], n: int, theta: float,
              max_row_sum: float, strength_all: bool, interp_d2: bool,
@@ -76,189 +300,33 @@ def _fine_fn(offs: Tuple[int, ...], n: int, theta: float,
     import jax
     import jax.numpy as jnp
 
-    # the PMIS tie-break permutation (selectors.pmis_tie_breaker) is
-    # computed ON DEVICE — int64 is exact for a·i < 2^50, and a 2 MB
-    # fraction upload through the tunnel would cost more than the rest
-    # of the program
-    a_mult = 2654435761
-    while np.gcd(a_mult, n) != 1:
-        a_mult += 1
-
     offs = [int(o) for o in offs]
     nd = len(offs)
     k0 = offs.index(0)
-    offd = [k for k in range(nd) if k != k0]
-    kneg = {o: k for k, o in enumerate(offs)}      # offset -> row index
     dt = jnp.dtype(dtype_str)
     hat_offs, hat_pairs = ahat_plan(offs) if interp_d2 \
         else (tuple(offs), [[] for _ in offs])
     nh = len(hat_offs)
-    h0 = hat_offs.index(0)
     Kp = max_elements if max_elements > 0 else nh - 1
 
-    def strength(vals):
-        diag = vals[k0]
-        sgn = jnp.sign(diag)
-        sgn = jnp.where(sgn == 0, jnp.asarray(1.0, dt), sgn)
-        present = [vals[k] != 0 for k in range(nd)]
-        if strength_all:
-            return [present[k] if k != k0 else jnp.zeros_like(present[k])
-                    for k in range(nd)]
-        ninf = jnp.asarray(-jnp.inf, dt)
-        meas = [jnp.where(present[k], -vals[k] * sgn, ninf) for k in offd]
-        meas_abs = [jnp.where(present[k], jnp.abs(vals[k]), ninf)
-                    for k in offd]
-        rowmax = functools.reduce(jnp.maximum, meas)
-        no_neg = ~(rowmax > 0)
-        rowmax_abs = functools.reduce(jnp.maximum, meas_abs)
-        rowmax_f = jnp.where(no_neg, rowmax_abs, rowmax)
-        strong = {}
-        for j, k in enumerate(offd):
-            mf = jnp.where(no_neg, meas_abs[j], meas[j])
-            strong[k] = (mf >= theta * rowmax_f) & (mf > 0)
-        if max_row_sum < 1.0 + 1e-12:
-            rs = sum(vals[k] for k in range(nd))
-            dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
-            weak = jnp.abs(rs / dsafe) > max_row_sum
-            strong = {k: s & ~weak for k, s in strong.items()}
-        return [strong.get(k, jnp.zeros(n, dtype=bool))
-                for k in range(nd)]
-
-    def pmis(S):
-        i64 = jnp.arange(n, dtype=jnp.int64)
-        perm = (i64 * a_mult + (seed % n)) % n
-        frac = (perm.astype(jnp.float64) + 1.0) / float(n + 2)
-        # symmetrised graph row masks: G_d = S_d | shift(S_{-d}, d)
-        G = []
-        for k in range(nd):
-            if k == k0:
-                G.append(jnp.zeros(n, dtype=bool))
-                continue
-            g = S[k]
-            ko = kneg.get(-offs[k])
-            if ko is not None:
-                g = g | _shift(S[ko], offs[k], False)
-            G.append(g)
-        # lam[j] = #rows strongly depending on j = Σ_k shift(S_k, -off_k)
-        lam = sum(_shift(S[k].astype(jnp.float64), -offs[k])
-                  for k in offd)
-        w = lam + frac                      # strictly distinct (f64)
-        deg = sum(G[k].astype(jnp.int32) for k in offd)
-        state0 = jnp.where(deg == 0, 0, -1).astype(jnp.int32)
-
-        def round_(state):
-            und = state == -1
-            ninf = jnp.asarray(-jnp.inf, jnp.float64)
-            max_nb = functools.reduce(jnp.maximum, [
-                jnp.where(und & G[k] & _shift(und, offs[k], False),
-                          _shift(w, offs[k], ninf), ninf)
-                for k in offd])
-            become_c = und & ((max_nb == -jnp.inf) | (w > max_nb))
-            state = jnp.where(become_c, 1, state)
-            just_c = become_c
-            near_c = functools.reduce(jnp.logical_or, [
-                G[k] & _shift(just_c, offs[k], False) for k in offd])
-            return jnp.where((state == -1) & near_c, 0, state)
-
-        state = jax.lax.while_loop(
-            lambda s: jnp.any(s == -1), lambda s: round_(s), state0)
-        return state == 1
-
-    def ahat(vals, S, cf):
-        """Â rows (nh, n): A − A_Fs + A_Fs·W (D2) or A itself (D1)."""
-        cf_sh = {k: _shift(cf, offs[k], False) for k in range(nd)}
-        if not interp_d2:
-            return [vals[k] for k in range(nd)], cf_sh
-        zero = jnp.zeros(n, dtype=dt)
-        A_fs = {k: jnp.where(S[k] & ~cf_sh[k], vals[k], zero)
-                for k in offd}
-        in_ck = {k: S[k] & cf_sh[k] for k in offd}
-        sum_ck = sum(jnp.where(in_ck[k], vals[k], zero) for k in offd)
-        cksafe = jnp.where(sum_ck == 0, jnp.asarray(1.0, dt), sum_ck)
-        W = {k: jnp.where(in_ck[k], vals[k] / cksafe, zero)
-             for k in offd}
-        rows = []
-        for e_i, e in enumerate(hat_offs):
-            acc = zero
-            if e in kneg:
-                k = kneg[e]
-                acc = vals[k] - (A_fs[k] if k in A_fs else zero)
-            for (k1, k2) in hat_pairs[e_i]:
-                acc = acc + A_fs[k1] * _shift(W[k2], offs[k1])
-            rows.append(acc)
-        cf_hat = {e_i: _shift(cf, hat_offs[e_i], False)
-                  for e_i in range(nh)}
-        return rows, cf_hat
-
-    def d1_weights(hat, cf_sh, cf):
-        """Direct interpolation on Â with ALL strength (every stored
-        entry strong — matching interpolators.D2's host composition)."""
-        zero = jnp.zeros(n, dtype=dt)
-        diag = hat[h0]
-        dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
-        ho = [e_i for e_i in range(nh) if e_i != h0]
-        neg = {e_i: hat[e_i] < 0 for e_i in ho}
-        pos = {e_i: hat[e_i] > 0 for e_i in ho}
-        in_ci = {e_i: (hat[e_i] != 0) & cf_sh[e_i] for e_i in ho}
-        s_all_neg = sum(jnp.where(neg[e], hat[e], zero) for e in ho)
-        s_all_pos = sum(jnp.where(pos[e], hat[e], zero) for e in ho)
-        s_c_neg = sum(jnp.where(in_ci[e] & neg[e], hat[e], zero)
-                      for e in ho)
-        s_c_pos = sum(jnp.where(in_ci[e] & pos[e], hat[e], zero)
-                      for e in ho)
-        one = jnp.asarray(1.0, dt)
-        alpha = jnp.where(s_c_neg != 0,
-                          s_all_neg / jnp.where(s_c_neg == 0, one,
-                                                s_c_neg), zero)
-        beta = jnp.where(s_c_pos != 0,
-                         s_all_pos / jnp.where(s_c_pos == 0, one,
-                                               s_c_pos), zero)
-        f_row = ~cf
-        ws = []
-        for e_i in ho:
-            coef = jnp.where(neg[e_i], alpha, beta)
-            w = -coef * hat[e_i] / dsafe
-            ws.append(jnp.where(in_ci[e_i] & f_row, w, zero))
-        return ws, ho
-
-    def truncate(ws):
-        """truncate_and_scale parity: drop small entries, keep the
-        ``max_elements`` largest per row, rescale to preserve row sums."""
-        W = jnp.stack(ws, axis=1)                     # (n, nh-1)
-        absw = jnp.abs(W)
-        old_sum = jnp.sum(W, axis=1)
-        keep = W != 0
-        if trunc_factor < 1.0:
-            rowmax = jnp.max(absw, axis=1)
-            keep &= absw >= trunc_factor * rowmax[:, None]
-        if max_elements > 0:
-            # rank by |w| descending, ties to lower index (= ascending
-            # offset — the host lexsort's stable order)
-            topv, topi = jax.lax.top_k(jnp.where(keep, absw, -1.0),
-                                       min(Kp, W.shape[1]))
-            kv = jnp.take_along_axis(W, topi, axis=1)
-            kv = jnp.where(topv > 0, kv, 0.0)
-        else:
-            kv, topi = jnp.where(keep, W, 0.0), \
-                jnp.broadcast_to(jnp.arange(W.shape[1]), W.shape)
-        new_sum = jnp.sum(kv, axis=1)
-        scale = jnp.where(new_sum != 0,
-                          old_sum / jnp.where(new_sum == 0, 1.0,
-                                              new_sum), 1.0)
-        return kv * scale[:, None], topi
-
     def run(vals):
-        S = strength(vals)
-        cf = pmis(S)
-        hat, cf_sh = ahat(vals, S, cf)
-        ws, ho = d1_weights(hat, cf_sh, cf)
-        pv, pi = truncate(ws)
+        S = dia_strength(vals, offs, n, dt, theta, max_row_sum,
+                         strength_all)
+        cf = dia_pmis(S, offs, n, seed)
+        hat, cf_sh = dia_ahat(vals, S, cf, offs, hat_offs, hat_pairs,
+                              interp_d2, n, dt)
+        # D1 path: restrict C_i to strength-filtered entries (hat
+        # offsets == stencil offsets there, so slots align 1:1)
+        srows = None if interp_d2 else \
+            {k: S[k] for k in range(nd) if k != k0}
+        ws, ho = dia_d1_weights(hat, cf_sh, cf, hat_offs, n, dt,
+                                strength_rows=srows)
+        pv, pi = dia_truncate(ws, trunc_factor, max_elements, Kp)
         # int8 index outputs: the host download crosses a ~10-100 MB/s
         # tunnel (pv keeps the compute dtype — f32 on chip, f64 in CPU
         # parity tests)
         return cf.astype(jnp.int8), pv, pi.astype(jnp.int8)
 
-    import jax
     return jax.jit(run), hat_offs, Kp
 
 

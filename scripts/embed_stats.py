@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Feasibility stats for the embedded (fine-grid DIA) classical
+hierarchy: per level, the count of realized fine-displacement offsets
+when coarse points keep their fine-grid indices."""
+import sys
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+
+n_side = int(sys.argv[1]) if len(sys.argv) > 1 else 48
+
+CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=1, "
+    "out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+    "amg:interpolator=D2, amg:max_iters=1, "
+    "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+    "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+    "sm:max_iters=1, amg:min_coarse_rows=32, "
+    "amg:coarse_solver=DENSE_LU_SOLVER")
+
+A = poisson7pt(n_side, n_side, n_side)
+m = amgx.Matrix(A)
+cfg = amgx.AMGConfig(CFG)
+slv = amgx.create_solver(cfg)
+slv.setup(m)
+hier = slv.preconditioner.hierarchy
+
+fine_idx = np.arange(A.shape[0])
+for i, lvl in enumerate(hier.levels):
+    Al = sp.csr_matrix(lvl.A.host)
+    n = Al.shape[0]
+    fi = fine_idx
+    r = np.repeat(fi, np.diff(Al.indptr))
+    c = fi[Al.indices]
+    offs = np.unique(c - r)
+    K = int(np.max(np.diff(Al.indptr)))
+    cf = getattr(lvl.A, "cf_map", None)
+    Pm = lvl._Pm.host if lvl._Pm is not None else None
+    print(f"level {i}: n={n} nnz={Al.nnz} K={K} "
+          f"embedded_offsets={len(offs)} "
+          f"span=({offs.min()},{offs.max()})", flush=True)
+    if Pm is None:
+        break
+    P = sp.csr_matrix(Pm)
+    if cf is not None:
+        cidx = np.flatnonzero(np.asarray(cf).astype(bool))
+    else:
+        # identity rows of P: rows with a single unit entry
+        Pc = sp.csc_matrix(P)
+        cidx = np.empty(P.shape[1], dtype=np.int64)
+        for j in range(P.shape[1]):
+            s, e = Pc.indptr[j], Pc.indptr[j + 1]
+            rr = Pc.indices[s:e]
+            vv = Pc.data[s:e]
+            one = rr[np.isclose(vv, 1.0)]
+            cidx[j] = one[0] if len(one) else rr[np.argmax(np.abs(vv))]
+    pr = np.repeat(fi, np.diff(P.indptr))
+    pc = fi[cidx[P.indices]]
+    pd = np.unique(pc - pr)
+    Kp = int(np.max(np.diff(P.indptr)))
+    print(f"   P: nnz={P.nnz} Kp={Kp} offsets={len(pd)} "
+          f"span=({pd.min()},{pd.max()})", flush=True)
+    fine_idx = fi[cidx]
+
+print("levels:", len(hier.levels))

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Micro-bench of the XLA primitives the device classical coarse path
+needs: gather, per-row sort, scatter-add, top_k — at level-1-like sizes."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+n = 572_000
+K = 42
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    float(jnp.sum(out[0] if isinstance(out, tuple) else out).astype(
+        jnp.float32))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        v = out[0] if isinstance(out, tuple) else out
+        float(jnp.sum(v).astype(jnp.float32))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+rng = np.random.default_rng(0)
+cols = jnp.asarray(rng.integers(0, n, size=(n, K)), jnp.int32)
+x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+vals = jnp.asarray(rng.standard_normal((n, K)), jnp.float32)
+
+# 1. element gather x[cols]
+g = jax.jit(lambda x, c: x[c])
+t = timeit(g, x, cols)
+print(f"gather {n*K/1e6:.0f}M elems: {t:.3f}s = "
+      f"{n*K/t/1e9:.2f} G/s", flush=True)
+
+# 2. row gather W[cols[:, :8]] -> (n, 8, K)
+W = vals
+rg = jax.jit(lambda W, c: W[c])
+c8 = cols[:, :8]
+t = timeit(rg, W, c8)
+print(f"rowgather {n*8*K/1e6:.0f}M elems: {t:.3f}s = "
+      f"{n*8*K/t/1e9:.2f} G/s", flush=True)
+
+# 3. per-row sort (n, 512) f32 key
+wide = jnp.asarray(rng.standard_normal((n, 512)), jnp.float32)
+s = jax.jit(lambda w: jnp.sort(w, axis=1))
+t = timeit(s, wide)
+print(f"rowsort (n,512): {t:.3f}s = {n*512/t/1e9:.2f} G/s", flush=True)
+
+# 3b. per-row argsort int32 keys (n, 256)
+widek = jnp.asarray(rng.integers(0, 1 << 30, size=(n, 256)), jnp.int32)
+s2 = jax.jit(lambda w: jnp.argsort(w, axis=1))
+t = timeit(s2, widek)
+print(f"row-argsort i32 (n,256): {t:.3f}s = {n*256/t/1e9:.2f} G/s",
+      flush=True)
+
+# 4. scatter-add (n*K,) -> (n,)
+flatc = cols.reshape(-1)
+flatv = vals.reshape(-1)
+sc = jax.jit(lambda c, v: jnp.zeros((n,), jnp.float32).at[c].add(v))
+t = timeit(sc, flatc, flatv)
+print(f"scatter-add {n*K/1e6:.0f}M: {t:.3f}s = {n*K/t/1e9:.2f} G/s",
+      flush=True)
+
+# 5. segment_sum on SORTED ids
+ids = jnp.asarray(np.sort(rng.integers(0, n, size=n * K)), jnp.int32)
+ss = jax.jit(lambda i, v: jax.ops.segment_sum(
+    v, i, num_segments=n, indices_are_sorted=True))
+t = timeit(ss, ids, flatv)
+print(f"segsum sorted {n*K/1e6:.0f}M: {t:.3f}s = {n*K/t/1e9:.2f} G/s",
+      flush=True)
+
+# 6. top_k k=8 over (n, 64)
+w64 = jnp.asarray(rng.standard_normal((n, 64)), jnp.float32)
+tk = jax.jit(lambda w: jax.lax.top_k(w, 8))
+t = timeit(tk, w64)
+print(f"top_k8 (n,64): {t:.3f}s = {n*64/t/1e9:.2f} G/s", flush=True)
+
+# 7. cumsum along rows (n, 512)
+cs = jax.jit(lambda w: jnp.cumsum(w, axis=1))
+t = timeit(cs, wide)
+print(f"row-cumsum (n,512): {t:.3f}s = {n*512/t/1e9:.2f} G/s",
+      flush=True)
+
+# 8. global sort of 120M int64 keys (SpGEMM dedup scale)
+big = jnp.asarray(
+    rng.integers(0, 1 << 60, size=120_000_000), jnp.int64)
+gs = jax.jit(lambda b: jnp.sort(b))
+t = timeit(gs, big, reps=2)
+print(f"flat sort 120M i64: {t:.3f}s = {120e6/t/1e9:.2f} G/s",
+      flush=True)

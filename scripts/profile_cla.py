@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Profile the classical bench case in isolation (setup/solve split)."""
+import os
+import sys
+import time
+
+os.environ.setdefault("AMGX_BENCH_PROFILE", "1")
+
+import numpy as np
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+
+n_side = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+CFG_CLA = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+    "amg:interpolator=D2, amg:max_iters=1, "
+    "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+    "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+    "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+    "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER, "
+    "amg:print_grid_stats=1")
+
+A = poisson7pt(n_side, n_side, n_side)
+m = amgx.Matrix(A)
+m.device_dtype = np.float32
+cfg = amgx.AMGConfig(CFG_CLA)
+slv = amgx.create_solver(cfg)
+
+t0 = time.perf_counter()
+md = m.device()
+print(f"[prof] pack+upload fine: {time.perf_counter()-t0:.2f}s",
+      flush=True)
+
+t0 = time.perf_counter()
+slv.setup(m)
+t_host = time.perf_counter() - t0
+hier = slv.preconditioner.hierarchy
+import jax
+jax.device_get(hier.levels[-1].Ad.diag)
+t_all = time.perf_counter() - t0
+print(f"[prof] setup host {t_host:.2f}s + drain "
+      f"{t_all - t_host:.2f}s = {t_all:.2f}s", flush=True)
+
+from amgx_tpu.utils.profiler import profiler_tree
+print(profiler_tree().report(), flush=True)
+profiler_tree().reset()
+
+import jax.numpy as jnp
+b = jnp.ones(A.shape[0], jnp.float32)
+res = slv.solve(b)                      # warm
+t0 = time.perf_counter()
+res = slv.solve(b)
+print(f"[prof] solve {time.perf_counter()-t0:.2f}s "
+      f"iters={res.iterations}", flush=True)
+
+# per-level info
+for i, lvl in enumerate(hier.levels):
+    Ad = lvl.Ad
+    nn = lvl.A.shape[0]
+    print(f"[prof] level {i}: n={nn} fmt={Ad.fmt} "
+          f"nnz={getattr(lvl.A, 'nnz', '?')}", flush=True)
