@@ -1,0 +1,542 @@
+"""Device-side classical coarsening for COMPACT (coarse-local ELL)
+levels — the general-sparsity continuation of the embedded fine-level
+pipeline (:mod:`.device_pipeline`).
+
+Reference: the same on-accelerator setup loop the fine level matches —
+``classical_amg_level.cu:240-340`` (strength → PMIS → interpolation) and
+the hash-table SpGEMM of ``base/src/csr_multiply.cu:739`` for A·P and
+R·AP.
+
+TPU redesign — the hash table becomes sort algebra.  Measured v5e rates
+shape every choice here (scripts/prim_bench.py): element gathers and
+scatters crawl at ~0.1 G lookups/s (XLA lowers them to scalar loops)
+while a ROW gather amortises ~10× more payload per lookup, and per-row
+sorts / top_k / segmented scans stream at 1+ G elem/s.  So:
+
+* neighbour-row access (W rows, P rows, AP rows) is always a ROW gather
+  of a fixed-width ELL row — never an element gather per entry;
+* SpGEMM expand → (row, col) dedup is a per-row ``argsort`` by column
+  plus a SEGMENTED INCLUSIVE SCAN (``jax.lax.associative_scan``) that
+  sums duplicate columns in log(width) passes — no segment_sum, no
+  scatter; side channels (the is-C-column flag the interpolator needs)
+  ride the same scan as extra summed lanes;
+* width compaction (keep each row's realized nnz) is ``top_k`` on a
+  liveness-position key that keeps columns ascending per row — the
+  stable order scipy CSR gives the host path, so truncation tie-breaks
+  match bit for bit;
+* the only scatters left are the per-level λ (in-degree) count, PMIS's
+  reverse-edge max, and the transpose's final table build — each O(nnz)
+  once on levels already ≥4× coarser than the fine grid.
+
+All shapes are bucketed (rows to ``compact_step`` multiples, widths to
+the ``width_bucket`` ladder) so recompiles stay rare and the persistent
+compile cache carries across runs.
+
+ELL conventions (shared with :mod:`.device_pipeline`): pad ENTRIES point
+at their own row with value 0; pad ROWS (beyond the logical count) carry
+a bare unit diagonal, making them isolated F points every algorithm
+ignores; stored entries are "present" iff value ≠ 0; columns ascend
+within each row.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+from .device_pipeline import bucket, width_bucket
+
+
+# ----------------------------------------------------------- helpers
+def _rowwise(x):
+    import jax.numpy as jnp
+    return jnp.arange(x.shape[0], dtype=jnp.int32)[:, None]
+
+
+def _seg_sum_scan(vals, new):
+    """Segmented inclusive sum along the LAST axis: runs delimited by
+    ``new`` flags; at a run's last position this is the run total."""
+    import jax
+    import jax.numpy as jnp
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, va + vb), fa | fb
+
+    out, _ = jax.lax.associative_scan(op, (vals, new), axis=-1)
+    return out
+
+
+def dedup_rows(cols, val_list, out_width: int):
+    """Per-row (col → Σ vals) dedup of an expanded product block.
+
+    ``cols`` (n, W) int32 with dead entries = -1; ``val_list`` is a list
+    of (n, W) arrays, each summed over duplicate columns.  Returns
+    (cols (n, K), [vals (n, K)...], live (n, K)) with columns ascending
+    and dead entries (-1, 0) packed to the right."""
+    import jax
+    import jax.numpy as jnp
+
+    n, W = cols.shape
+    order = jnp.argsort(cols, axis=1)            # dead (-1) sort first
+    sc = jnp.take_along_axis(cols, order, axis=1)
+    new = jnp.ones((n, W), dtype=bool)
+    new = new.at[:, 1:].set(sc[:, 1:] != sc[:, :-1])
+    runs = [_seg_sum_scan(jnp.take_along_axis(v, order, axis=1), new)
+            for v in val_list]
+    last = jnp.ones((n, W), dtype=bool)
+    last = last.at[:, :-1].set(new[:, 1:])
+    live = last & (sc >= 0)
+    # keep ≤out_width live entries in ascending-column (== ascending
+    # position) order: key = live·BIG − position
+    pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (n, W))
+    kkey = jnp.where(live, jnp.int32(4 * W), jnp.int32(0)) - pos
+    k = min(out_width, W)
+    _, topi = jax.lax.top_k(kkey, k)
+    oc = jnp.take_along_axis(sc, topi, axis=1)
+    ovs = [jnp.take_along_axis(r, topi, axis=1) for r in runs]
+    ol = jnp.take_along_axis(live, topi, axis=1)
+    if out_width > k:
+        pad = out_width - k
+        oc = jnp.pad(oc, ((0, 0), (0, pad)), constant_values=-1)
+        ovs = [jnp.pad(v, ((0, 0), (0, pad))) for v in ovs]
+        ol = jnp.pad(ol, ((0, 0), (0, pad)))
+    oc = jnp.where(ol, oc, -1)
+    ovs = [jnp.where(ol, v, 0.0) for v in ovs]
+    return oc, ovs, ol
+
+
+# ------------------------------------------------------ strength + PMIS
+@functools.lru_cache(maxsize=128)
+def _strength_pmis_fn(nb: int, K: int, dtype_str: str, theta: float,
+                      max_row_sum: float, strength_all: bool,
+                      seed: int):
+    """jit: (cols, vals, n_log i32, a_mult i64) →
+    (cf bool (nb,), S (nb, K) bool, stats i32[3] = nc, k_c, k_fs).
+
+    Strength follows ``strength/ahat.cu`` exactly as the host
+    ``AhatStrength``; PMIS is the host ``selectors._pmis`` with the same
+    strictly-distinct tie-break weights (computed from the LOGICAL row
+    count, so device and host agree bit for bit)."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype_str)
+
+    def run(cols, vals, n_log, a_mult):
+        n = cols.shape[0]
+        rown = _rowwise(cols)
+        off = cols != rown
+        present = (vals != 0) & off
+        diag = jnp.sum(jnp.where(cols == rown, vals, 0.0), axis=1)
+        if strength_all:
+            S = present
+        else:
+            sgn = jnp.sign(diag)
+            sgn = jnp.where(sgn == 0, jnp.asarray(1.0, dt), sgn)
+            ninf = jnp.asarray(-jnp.inf, dt)
+            meas = jnp.where(present, -vals * sgn[:, None], ninf)
+            meas_abs = jnp.where(present, jnp.abs(vals), ninf)
+            rowmax = jnp.max(meas, axis=1)
+            no_neg = ~(rowmax > 0)
+            rowmax_f = jnp.where(no_neg, jnp.max(meas_abs, axis=1),
+                                 rowmax)
+            meas_f = jnp.where(no_neg[:, None], meas_abs, meas)
+            S = present & (meas_f >= theta * rowmax_f[:, None]) & \
+                (meas_f > 0)
+            if max_row_sum < 1.0 + 1e-12:
+                rs = jnp.sum(vals, axis=1)
+                dsafe = jnp.where(diag == 0, jnp.asarray(1.0, dt), diag)
+                weak = jnp.abs(rs / dsafe) > max_row_sum
+                S = S & ~weak[:, None]
+
+        ccol = jnp.where(S, cols, 0)          # masked writes carry 0/ninf
+        lam = jnp.zeros((n,), jnp.float64).at[ccol].add(
+            S.astype(jnp.float64))
+        i64 = jnp.arange(n, dtype=jnp.int64)
+        nl = jnp.maximum(n_log.astype(jnp.int64), 1)
+        perm = (i64 * a_mult + (jnp.int64(seed) % nl)) % nl
+        frac = (perm.astype(jnp.float64) + 1.0) / \
+            (n_log.astype(jnp.float64) + 2.0)
+        w = lam + frac
+        has_out = jnp.any(S, axis=1)
+        has_in = jnp.zeros((n,), jnp.int32).at[ccol].max(
+            S.astype(jnp.int32)) > 0
+        ninf64 = jnp.asarray(-jnp.inf, jnp.float64)
+        state0 = jnp.where(has_out | has_in, -1, 0).astype(jnp.int32)
+
+        def round_(state):
+            und = state == -1
+            wu = jnp.where(und, w, ninf64)
+            out_max = jnp.max(jnp.where(S, wu[cols], ninf64), axis=1)
+            in_max = jnp.full((n,), ninf64).at[ccol].max(
+                jnp.where(S & und[:, None], wu[:, None], ninf64))
+            max_nb = jnp.maximum(out_max, in_max)
+            become_c = und & ((max_nb == ninf64) | (w > max_nb))
+            state = jnp.where(become_c, 1, state)
+            near_out = jnp.any(S & become_c[cols], axis=1)
+            near_in = jnp.zeros((n,), jnp.int32).at[ccol].max(
+                (S & become_c[:, None]).astype(jnp.int32)) > 0
+            return jnp.where((state == -1) & (near_out | near_in), 0,
+                             state)
+
+        state = jax.lax.while_loop(
+            lambda s: jnp.any(s == -1), round_, state0)
+        cf = state == 1
+        nc = jnp.sum(cf.astype(jnp.int32))
+        cfc = cf[cols]
+        k_c = jnp.max(jnp.sum((S & cfc).astype(jnp.int32), axis=1))
+        k_fs = jnp.max(jnp.sum((S & ~cfc).astype(jnp.int32), axis=1))
+        return cf, S, jnp.stack([nc, k_c, k_fs])
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
+def _cf_stats_fn(nb: int, K: int):
+    import jax
+    import jax.numpy as jnp
+
+    def run(cols, S, cf):
+        cfc = cf[cols]
+        nc = jnp.sum(cf.astype(jnp.int32))
+        k_c = jnp.max(jnp.sum((S & cfc).astype(jnp.int32), axis=1))
+        k_fs = jnp.max(jnp.sum((S & ~cfc).astype(jnp.int32), axis=1))
+        return jnp.stack([nc, k_c, k_fs])
+
+    return jax.jit(run)
+
+
+# ------------------------------------------------------- interpolation
+@functools.lru_cache(maxsize=128)
+def _interp_fn(nb: int, K: int, Kc: int, Kfs: int, Kp: int,
+               dtype_str: str, interp_d2: bool, trunc_factor: float,
+               max_elements: int):
+    """jit: (cols, vals, S, cf) →
+    (P_cols (nb, Kp) i32 coarse-local, P_vals, cnum (nb,) i32,
+    kmax i32).
+
+    D1: the host ``D1Interpolator`` formula (distance1.cu) rowwise, C_i
+    strength-filtered.  D2: Â = A − A_Fs + A_Fs·W expanded via ROW
+    gathers of the compacted W rows, deduped with sort+scan (the
+    is-C-column flag rides the scan as a summed lane), then
+    D1-with-ALL-strength on Â — the exact host ``D2Interpolator``
+    composition."""
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.dtype(dtype_str)
+
+    def compact_by(cols, vals, mask, width):
+        """Keep ``mask`` entries (≤ width per row), cols ascending."""
+        pos = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32),
+                               cols.shape)
+        kkey = jnp.where(mask, jnp.int32(4 * K), jnp.int32(0)) - pos
+        k = min(width, K)
+        _, topi = jax.lax.top_k(kkey, k)
+        oc = jnp.take_along_axis(cols, topi, axis=1)
+        ov = jnp.take_along_axis(vals, topi, axis=1)
+        om = jnp.take_along_axis(mask, topi, axis=1)
+        if width > k:
+            pad = width - k
+            oc = jnp.pad(oc, ((0, 0), (0, pad)), constant_values=-1)
+            ov = jnp.pad(ov, ((0, 0), (0, pad)))
+            om = jnp.pad(om, ((0, 0), (0, pad)))
+        return jnp.where(om, oc, -1), jnp.where(om, ov, 0.0), om
+
+    def d1_on(c_cols, c_vals, c_live, diag, row_neg, row_pos, cf):
+        """Direct interpolation given the C-candidate entries and the
+        full signed row sums (distance1.cu formula)."""
+        neg = c_live & (c_vals < 0)
+        pos = c_live & (c_vals > 0)
+        s_c_neg = jnp.sum(jnp.where(neg, c_vals, 0.0), axis=1)
+        s_c_pos = jnp.sum(jnp.where(pos, c_vals, 0.0), axis=1)
+        one = jnp.asarray(1.0, dt)
+        alpha = jnp.where(s_c_neg != 0, row_neg /
+                          jnp.where(s_c_neg == 0, one, s_c_neg), 0.0)
+        beta = jnp.where(s_c_pos != 0, row_pos /
+                         jnp.where(s_c_pos == 0, one, s_c_pos), 0.0)
+        dsafe = jnp.where(diag == 0, one, diag)
+        coef = jnp.where(c_vals < 0, alpha[:, None], beta[:, None])
+        w = -coef * c_vals / dsafe[:, None]
+        return jnp.where(c_live & ~cf[:, None], w, 0.0)
+
+    def truncate(pc, pv):
+        """truncate_and_scale parity (truncate.cu:625): factor filter,
+        top-``max_elements`` by |w| (ties to the lower column — the
+        ascending-cols invariant makes slot order == column order),
+        rescale to preserve row sums."""
+        absw = jnp.abs(pv)
+        old = jnp.sum(pv, axis=1)
+        keep = pv != 0
+        if trunc_factor < 1.0:
+            rmax = jnp.max(absw, axis=1)
+            keep = keep & (absw >= trunc_factor * rmax[:, None])
+        if max_elements > 0:
+            topv, topi = jax.lax.top_k(
+                jnp.where(keep, absw, -1.0), min(Kp, pv.shape[1]))
+            kc = jnp.take_along_axis(pc, topi, axis=1)
+            kv = jnp.take_along_axis(pv, topi, axis=1)
+            kv = jnp.where(topv > 0, kv, 0.0)
+        else:
+            kc, kv = pc, jnp.where(keep, pv, 0.0)
+        new = jnp.sum(kv, axis=1)
+        scale = jnp.where(new != 0, old /
+                          jnp.where(new == 0, 1.0, new), 1.0)
+        return kc, kv * scale[:, None]
+
+    def run(cols, vals, S, cf):
+        n = cols.shape[0]
+        rown = _rowwise(cols)
+        diag = jnp.sum(jnp.where(cols == rown, vals, 0.0), axis=1)
+        cnum = jnp.cumsum(cf.astype(jnp.int32)) - 1
+        cfc = cf[cols]
+        off = cols != rown
+        present = (vals != 0) & off
+        if not interp_d2:
+            in_ci = S & cfc          # strength-filtered (distance1.cu)
+            row_neg = jnp.sum(jnp.where(present & (vals < 0), vals,
+                                        0.0), axis=1)
+            row_pos = jnp.sum(jnp.where(present & (vals > 0), vals,
+                                        0.0), axis=1)
+            w = d1_on(cols, jnp.where(in_ci, vals, 0.0), in_ci, diag,
+                      row_neg, row_pos, cf)
+            pc, pv = truncate(jnp.where(in_ci, cols, -1), w)
+        else:
+            sc_mask = S & cfc
+            fs_mask = S & ~cfc
+            sum_ck = jnp.sum(jnp.where(sc_mask, vals, 0.0), axis=1)
+            wrow = jnp.where(
+                sc_mask,
+                vals / jnp.where(sum_ck == 0, 1.0, sum_ck)[:, None],
+                0.0)
+            wc, wv, _ = compact_by(cols, wrow, sc_mask, Kc)
+            fc, fv, fl = compact_by(cols, vals, fs_mask, Kfs)
+            fcc = jnp.where(fl, fc, 0)
+            # ROW gathers of the compacted W rows of each strong F
+            # neighbour — the fast gather shape
+            gw_c = wc[fcc]                       # (n, Kfs, Kc)
+            gw_v = wv[fcc]
+            path_c = jnp.where(fl[:, :, None], gw_c, -1)
+            path_v = jnp.where(fl[:, :, None] & (gw_c >= 0),
+                               fv[:, :, None] * gw_v, 0.0)
+            # direct part of Â: A − A_Fs (diagonal kept; its column is
+            # the own row, excluded from C candidates below)
+            dir_keep = present & ~fs_mask
+            dir_c = jnp.where(dir_keep, cols, -1)
+            dir_v = jnp.where(dir_keep, vals, 0.0)
+            dir_isc = jnp.where(dir_keep, cfc.astype(dt), 0.0)
+            path_isc = jnp.where(fl[:, :, None] & (gw_c >= 0) &
+                                 (gw_v != 0),
+                                 jnp.asarray(1.0, dt), 0.0)
+            W2 = K + Kfs * Kc
+            ac = jnp.concatenate(
+                [dir_c, path_c.reshape(n, Kfs * Kc)], axis=1)
+            av = jnp.concatenate(
+                [dir_v, path_v.reshape(n, Kfs * Kc)], axis=1)
+            aisc = jnp.concatenate(
+                [dir_isc, path_isc.reshape(n, Kfs * Kc)], axis=1)
+            hc, (hv, hisc), hl = dedup_rows(ac, [av, aisc], W2)
+            hpresent = hl & (hv != 0)
+            hoff = hpresent & (hc != rown)
+            row_neg = jnp.sum(jnp.where(hoff & (hv < 0), hv, 0.0),
+                              axis=1)
+            row_pos = jnp.sum(jnp.where(hoff & (hv > 0), hv, 0.0),
+                              axis=1)
+            in_ci = hoff & (hisc > 0)
+            # Â diag == A diag (distribution paths land on C columns;
+            # weights only matter for F rows)
+            w = d1_on(hc, jnp.where(in_ci, hv, 0.0), in_ci, diag,
+                      row_neg, row_pos, cf)
+            pc, pv = truncate(jnp.where(in_ci, hc, -1), w)
+        live = pv != 0
+        pcc = jnp.where(live, cnum[jnp.maximum(pc, 0)], -1)
+        kmax = jnp.max(jnp.sum(live.astype(jnp.int32), axis=1))
+        return pcc, jnp.where(live, pv, 0.0), cnum, kmax
+
+    return jax.jit(run)
+
+
+# --------------------------------------------------------------- RAP
+@functools.lru_cache(maxsize=128)
+def _transpose_fn(nb: int, Kpx: int, ncb: int, Kr: int):
+    """jit: (P_cols (nb, Kpx) coarse-local, P_vals) →
+    (R_cols (ncb, Kr) i32 = fine-source ids, R_vals, maxdeg i32).
+
+    Transpose via ONE flat argsort of (col, row) keys + rank-in-run via
+    segmented scan; a single scatter builds the (ncb, Kr) table."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(pc, pv):
+        n = pc.shape[0]
+        rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int64)[:, None], pc.shape
+        ).reshape(-1)
+        cols = pc.reshape(-1).astype(jnp.int64)
+        vals = pv.reshape(-1)
+        live = (vals != 0) & (cols >= 0)
+        key = jnp.where(live, cols * n + rows,
+                        jnp.int64(ncb) * n + rows)
+        order = jnp.argsort(key)
+        sk = key[order]
+        sv = jnp.where(live, vals, 0.0)[order]
+        scol = (sk // n).astype(jnp.int32)
+        srow = (sk % n).astype(jnp.int32)
+        new = jnp.ones(sk.shape, dtype=bool).at[1:].set(
+            scol[1:] != scol[:-1])
+        rank = (_seg_sum_scan(jnp.ones_like(sv), new) - 1.0
+                ).astype(jnp.int32)
+        ok = (scol < ncb) & (rank < Kr)
+        flat = jnp.where(ok, scol * Kr + rank, 0)
+        rv = jnp.zeros((ncb * Kr,), sv.dtype).at[flat].add(
+            jnp.where(ok, sv, 0.0))
+        rc = jnp.full((ncb * Kr,), -1, jnp.int32).at[flat].max(
+            jnp.where(ok, srow, -1))
+        maxdeg = jnp.max(jnp.where(scol < ncb, rank, -1)) + 1
+        return rc.reshape(ncb, Kr), rv.reshape(ncb, Kr), maxdeg
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
+def _ap_fn(nb: int, K: int, Kpx: int, Kap: int):
+    """jit: (A_cols, A_vals, P_cols, P_vals) → AP ELL (nb, Kap) (cols
+    -1-padded) + kmax.  Expand via row gathers of P rows, dedup via
+    sort+scan."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(ac, av, pc, pv):
+        n = ac.shape[0]
+        live = av != 0
+        acc = jnp.where(live, ac, 0)
+        g_c = pc[acc]                         # (n, K, Kpx)
+        g_v = pv[acc]
+        keep = live[:, :, None] & (g_c >= 0) & (g_v != 0)
+        ec = jnp.where(keep, g_c, -1).reshape(n, K * Kpx)
+        ev = jnp.where(keep, av[:, :, None] * g_v,
+                       0.0).reshape(n, K * Kpx)
+        oc, (ov,), ol = dedup_rows(ec, [ev], Kap)
+        kmax = jnp.max(jnp.sum(ol.astype(jnp.int32), axis=1))
+        return oc, ov, kmax
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
+def _rap_fn(ncb: int, Kr: int, Kap: int, Kc2: int):
+    """jit: (R_cols, R_vals, AP_cols, AP_vals) → coarse ELL
+    (ncb, Kc2) in standard conventions (self-pad entries, unit-diagonal
+    pad rows) + kmax."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(rc, rv, apc, apv):
+        live = (rv != 0) & (rc >= 0)
+        rcc = jnp.where(live, rc, 0)
+        g_c = apc[rcc]                        # (ncb, Kr, Kap)
+        g_v = apv[rcc]
+        keep = live[:, :, None] & (g_c >= 0) & (g_v != 0)
+        ec = jnp.where(keep, g_c, -1).reshape(ncb, Kr * Kap)
+        ev = jnp.where(keep, rv[:, :, None] * g_v,
+                       0.0).reshape(ncb, Kr * Kap)
+        oc, (ov,), ol = dedup_rows(ec, [ev], Kc2)
+        kmax = jnp.max(jnp.sum(ol.astype(jnp.int32), axis=1))
+        rown = _rowwise(oc)
+        oc = jnp.where(ol, oc, rown)
+        empty = ~jnp.any(ol, axis=1)
+        first = jnp.arange(oc.shape[1]) == 0
+        ov = jnp.where(empty[:, None] & first, 1.0, ov)
+        return oc, ov, kmax
+
+    return jax.jit(run)
+
+
+# ------------------------------------------------------------- driver
+class CompactCoarsenResult(NamedTuple):
+    cf: object          # (nb,) bool device
+    cnum: object        # (nb,) i32 device
+    P_cols: object      # (nb, Kpx) i32 coarse-local; slot 0 = identity
+    P_vals: object
+    Ac_cols: object     # (ncb2, Kc2) i32 (self-padded)
+    Ac_vals: object
+    nc: int
+    ncb2: int
+    Kc2: int
+
+
+def coarsen_compact(cols, vals, n_logical: int, *, theta: float,
+                    max_row_sum: float, strength_all: bool,
+                    interp_d2: bool, trunc_factor: float,
+                    max_elements: int, seed: int,
+                    compact_step: int = 8192, cf_S=None):
+    """One classical coarsening step on a compact device ELL level.
+
+    ``cf_S``: optionally a precomputed (cf, S ELL mask) pair — the
+    embedded pipeline computes level 1's strength+PMIS with shift
+    algebra (far cheaper at that size) and hands interpolation+RAP over
+    here.  Returns None when the coarse grid degenerates."""
+    import jax
+    import jax.numpy as jnp
+
+    from .device_fine import pmis_multiplier
+
+    nb, K = cols.shape
+    dt = jnp.dtype(vals.dtype)
+    if cf_S is None:
+        sp_fn = _strength_pmis_fn(nb, K, dt.str, float(theta),
+                                  float(max_row_sum),
+                                  bool(strength_all), int(seed))
+        a_mult = pmis_multiplier(max(n_logical, 1))
+        cf, S, stats = sp_fn(cols, vals, jnp.int32(n_logical),
+                             jnp.int64(a_mult))
+    else:
+        cf, S = cf_S
+        stats = _cf_stats_fn(nb, K)(cols, S, cf)
+    nc, k_c, k_fs = (int(x) for x in jax.device_get(stats))
+    if nc == 0 or nc >= n_logical:
+        return None
+    Kc = width_bucket(max(k_c, 1))
+    Kfs = width_bucket(max(k_fs, 1))
+    Kp = max_elements if max_elements > 0 else K
+    interp = _interp_fn(nb, K, Kc, Kfs, int(Kp), dt.str,
+                        bool(interp_d2), float(trunc_factor),
+                        int(max_elements))
+    pc, pv, cnum, _pk = interp(cols, vals, S, cf)
+
+    # P with the identity column of C rows folded in — the RAP operand
+    ident_c = jnp.where(cf, cnum, -1)[:, None]
+    ident_v = jnp.where(cf, jnp.asarray(1.0, dt),
+                        jnp.asarray(0.0, dt))[:, None]
+    pfull_c = jnp.concatenate([ident_c, pc], axis=1)
+    pfull_v = jnp.concatenate([ident_v, pv], axis=1)
+    Kpx = pfull_c.shape[1]
+
+    ncb2 = bucket(nc, compact_step)
+    Kr = width_bucket(max(8, 2 * Kpx))
+    while True:
+        rc, rv, maxdeg = _transpose_fn(nb, Kpx, ncb2, Kr)(pfull_c,
+                                                          pfull_v)
+        maxdeg = int(jax.device_get(maxdeg))
+        if maxdeg <= Kr:
+            break
+        Kr = width_bucket(maxdeg)
+    Kap = width_bucket(min(K * Kpx, 4 * K))
+    while True:
+        apc, apv, apk = _ap_fn(nb, K, Kpx, Kap)(cols, vals, pfull_c,
+                                                pfull_v)
+        apk = int(jax.device_get(apk))
+        if apk < Kap or Kap >= K * Kpx:
+            break
+        Kap = width_bucket(min(K * Kpx, 2 * Kap + 1))
+    Kc2 = width_bucket(min(Kr * Kap, max(2 * K, 16)))
+    while True:
+        acc, acv, ack = _rap_fn(ncb2, Kr, Kap, Kc2)(rc, rv, apc, apv)
+        ack = int(jax.device_get(ack))
+        if ack < Kc2 or Kc2 >= Kr * Kap:
+            break
+        Kc2 = width_bucket(min(Kr * Kap, 2 * Kc2 + 1))
+    return CompactCoarsenResult(
+        cf=cf, cnum=cnum, P_cols=pfull_c, P_vals=pfull_v,
+        Ac_cols=acc, Ac_vals=acv, nc=nc, ncb2=ncb2, Kc2=int(Kc2))

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Parity check: device_coarse.coarsen_compact vs host classical path
+on the level-1 operator produced by the embedded fine pipeline."""
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import scipy.sparse as sp
+
+from amgx_tpu.amg.classical.device_pipeline import coarsen_fine_embedded
+from amgx_tpu.amg.classical.device_coarse import coarsen_compact
+from amgx_tpu.io import poisson7pt
+from amgx_tpu.core.matrix import dia_arrays
+
+nx = 10
+A = sp.csr_matrix(poisson7pt(nx, nx, nx)).astype(np.float64)
+n = A.shape[0]
+
+
+class _Cfg:
+    def get(self, k, scope=None):
+        return {"strength_threshold": 0.2401, "max_row_sum": 0.9,
+                "interp_truncation_factor": 0.0,
+                "interp_max_elements": 4,
+                "determinism_flag": 1}[k]
+
+
+from amgx_tpu.amg.classical.strength import AhatStrength
+from amgx_tpu.amg.classical.selectors import _pmis
+from amgx_tpu.amg.classical.interpolators import (D1Interpolator,
+                                                  D2Interpolator)
+
+for case, interp_d2 in (("D2", True), ("D1", False)):
+    offs, vals = dia_arrays(A, max_diags=16)
+    import jax.numpy as jnp
+    res = coarsen_fine_embedded(
+        offs, jnp.asarray(vals), n, theta=0.2401, max_row_sum=0.9,
+        strength_all=False, interp_d2=interp_d2, trunc_factor=0.0,
+        max_elements=4, seed=7, compact_step=256)
+    # host level-1 (known bit-parity from pipe_check)
+    S0 = AhatStrength(_Cfg(), "s").compute(A)
+    cf0 = _pmis(S0, 7)
+    I0 = (D2Interpolator if interp_d2 else D1Interpolator)(_Cfg(), "s")
+    P0 = I0.compute(A, S0, cf0)
+    A1h = sp.csr_matrix(P0.T @ A @ P0)
+    A1h.sum_duplicates()
+    nc1 = res.nc
+    assert A1h.shape[0] == nc1
+
+    # ---- device compact coarsening of level 1 ----
+    out = coarsen_compact(res.cols, res.vals, nc1, theta=0.2401,
+                          max_row_sum=0.9, strength_all=False,
+                          interp_d2=interp_d2, trunc_factor=0.0,
+                          max_elements=4, seed=7, compact_step=256)
+    assert out is not None
+
+    # ---- host coarsening of the SAME level-1 matrix ----
+    S1 = AhatStrength(_Cfg(), "s").compute(A1h)
+    cf1 = _pmis(S1, 7)
+    cf1_d = np.asarray(out.cf)[:nc1].astype(np.int8)
+    nmis = int(np.sum(cf1 != cf1_d))
+    print(f"{case}: level2 nc host={cf1.sum()} dev={out.nc} "
+          f"cf mismatches={nmis}")
+    assert nmis == 0
+    P1 = I0.compute(A1h, S1, cf1)
+    # device P (drop identity slot handling: P_cols slot0=identity)
+    pcd = np.asarray(out.P_cols)[:nc1]
+    pvd = np.asarray(out.P_vals)[:nc1]
+    nc2 = out.nc
+    Pd = np.zeros((nc1, nc2))
+    for r in range(nc1):
+        for k in range(pcd.shape[1]):
+            if pvd[r, k] != 0 and pcd[r, k] >= 0:
+                Pd[r, pcd[r, k]] += pvd[r, k]
+    dP = np.abs(P1.toarray() - Pd).max()
+    print(f"{case}: P diff={dP}")
+    assert dP < 1e-12
+    A2h = sp.csr_matrix(P1.T @ A1h @ P1)
+    acd_c = np.asarray(out.Ac_cols)[:nc2]
+    acd_v = np.asarray(out.Ac_vals)[:nc2]
+    A2d = np.zeros((nc2, nc2))
+    for r in range(nc2):
+        for k in range(acd_c.shape[1]):
+            A2d[r, acd_c[r, k]] += acd_v[r, k]
+    dA = np.abs(A2h.toarray() - A2d).max()
+    print(f"{case}: Ac diff={dA} (max {np.abs(A2h.toarray()).max()}) "
+          f"Kc2={out.Kc2}")
+    assert dA < 1e-10
+
+print("ALL OK")

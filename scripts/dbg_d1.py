@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+
+from amgx_tpu.amg.classical.device_pipeline import coarsen_fine_embedded
+from amgx_tpu.amg.classical.device_coarse import (_strength_pmis_fn,
+                                                  _interp_fn)
+from amgx_tpu.amg.classical.device_fine import pmis_multiplier
+from amgx_tpu.io import poisson7pt
+from amgx_tpu.core.matrix import dia_arrays
+from amgx_tpu.amg.classical.strength import AhatStrength
+from amgx_tpu.amg.classical.selectors import _pmis
+from amgx_tpu.amg.classical.interpolators import D1Interpolator
+from amgx_tpu.amg.classical.util import entry_mask_in
+
+nx = 10
+A = sp.csr_matrix(poisson7pt(nx, nx, nx)).astype(np.float64)
+n = A.shape[0]
+
+
+class _Cfg:
+    def get(self, k, scope=None):
+        return {"strength_threshold": 0.25, "max_row_sum": 0.9,
+                "interp_truncation_factor": 0.0,
+                "interp_max_elements": 4, "determinism_flag": 1}[k]
+
+
+offs, vals = dia_arrays(A, max_diags=16)
+res = coarsen_fine_embedded(offs, jnp.asarray(vals), n, theta=0.25,
+                            max_row_sum=0.9, strength_all=False,
+                            interp_d2=False, trunc_factor=0.0,
+                            max_elements=4, seed=7, compact_step=256)
+S0 = AhatStrength(_Cfg(), "s").compute(A)
+cf0 = _pmis(S0, 7)
+P0 = D1Interpolator(_Cfg(), "s").compute(A, S0, cf0)
+A1h = sp.csr_matrix(P0.T @ A @ P0)
+A1h.sum_duplicates()
+nc1 = res.nc
+
+# host level-2 D1
+S1 = AhatStrength(_Cfg(), "s").compute(A1h)
+cf1 = _pmis(S1, 7)
+P1 = D1Interpolator(_Cfg(), "s").compute(A1h, S1, cf1)
+
+# device S
+nb, K = res.cols.shape
+sp_fn = _strength_pmis_fn(nb, K, jnp.dtype(res.vals.dtype).str, 0.25,
+                          0.9, False, 7)
+cfd, Sd, stats = sp_fn(res.cols, res.vals, jnp.int32(nc1),
+                       jnp.int64(pmis_multiplier(nc1)))
+Sd_np = np.asarray(Sd)[:nc1]
+cols_np = np.asarray(res.cols)[:nc1]
+vals_np = np.asarray(res.vals)[:nc1]
+
+# compare S patterns
+S1c = sp.csr_matrix(S1)
+Sh = np.zeros((nc1, nc1), dtype=bool)
+Sh[np.repeat(np.arange(nc1), np.diff(S1c.indptr)), S1c.indices] = True
+Sdev = np.zeros((nc1, nc1), dtype=bool)
+for r in range(nc1):
+    for k in range(K):
+        if Sd_np[r, k]:
+            Sdev[r, cols_np[r, k]] = True
+print("S mismatch count:", int((Sh != Sdev).sum()))
+
+interp = _interp_fn(nb, K, 16, 16, 4, jnp.dtype(res.vals.dtype).str,
+                    False, 0.0, 4)
+pc, pv, cnum, _ = interp(res.cols, res.vals, Sd, cfd)
+pc = np.asarray(pc)[:nc1]
+pv = np.asarray(pv)[:nc1]
+nc2 = int(cf1.sum())
+Pd = np.zeros((nc1, nc2))
+cfd_np = np.asarray(cfd)[:nc1]
+cnum_np = np.asarray(cnum)[:nc1]
+for r in range(nc1):
+    if cfd_np[r]:
+        Pd[r, cnum_np[r]] += 1.0
+    for k in range(pc.shape[1]):
+        if pv[r, k] != 0 and pc[r, k] >= 0:
+            Pd[r, pc[r, k]] += pv[r, k]
+Ph = P1.toarray()
+bad = np.argwhere(np.abs(Ph - Pd) > 1e-12)
+print("bad entries:", len(bad))
+if len(bad):
+    r, c = bad[0]
+    print(f"row {r} col {c}: host {Ph[r, c]} dev {Pd[r, c]}")
+    s, e = A1h.indptr[r], A1h.indptr[r + 1]
+    strong = entry_mask_in(A1h, S1)[s:e]
+    print("host row cols:", A1h.indices[s:e])
+    print("host row vals:", A1h.data[s:e])
+    print("host strong  :", strong.astype(int))
+    print("host cf cols :", cf1[A1h.indices[s:e]])
+    print("dev cols:", cols_np[r])
+    print("dev vals:", vals_np[r])
+    print("dev S   :", Sd_np[r].astype(int))
+    print("host P row:", Ph[r][np.abs(Ph[r]) > 0])
+    print("dev  P row:", Pd[r][np.abs(Pd[r]) > 0])
