@@ -459,6 +459,8 @@ class CompactCoarsenResult(NamedTuple):
     cnum: object        # (nb,) i32 device
     P_cols: object      # (nb, Kpx) i32 coarse-local; slot 0 = identity
     P_vals: object
+    R_cols: object      # (ncb2, Kr) i32 fine-source ids (-1 dead)
+    R_vals: object
     Ac_cols: object     # (ncb2, Kc2) i32 (self-padded)
     Ac_vals: object
     nc: int
@@ -470,7 +472,7 @@ def coarsen_compact(cols, vals, n_logical: int, *, theta: float,
                     max_row_sum: float, strength_all: bool,
                     interp_d2: bool, trunc_factor: float,
                     max_elements: int, seed: int,
-                    compact_step: int = 8192, cf_S=None):
+                    compact_step: int = 2048, cf_S=None):
     """One classical coarsening step on a compact device ELL level.
 
     ``cf_S``: optionally a precomputed (cf, S ELL mask) pair — the
@@ -539,4 +541,5 @@ def coarsen_compact(cols, vals, n_logical: int, *, theta: float,
         Kc2 = width_bucket(min(Kr * Kap, 2 * Kc2 + 1))
     return CompactCoarsenResult(
         cf=cf, cnum=cnum, P_cols=pfull_c, P_vals=pfull_v,
+        R_cols=rc, R_vals=rv,
         Ac_cols=acc, Ac_vals=acv, nc=nc, ncb2=ncb2, Kc2=int(Kc2))

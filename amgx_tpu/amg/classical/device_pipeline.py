@@ -283,7 +283,7 @@ def coarsen_fine_embedded(offs: Sequence[int], dvals, n: int, *,
                           theta: float, max_row_sum: float,
                           strength_all: bool, interp_d2: bool,
                           trunc_factor: float, max_elements: int,
-                          seed: int, compact_step: int = 8192):
+                          seed: int, compact_step: int = 2048):
     """Run the fully-device fine-level classical coarsening.
 
     Returns an :class:`EmbeddedFineResult` (or None when the coarse grid
@@ -311,8 +311,9 @@ def coarsen_fine_embedded(offs: Sequence[int], dvals, n: int, *,
     kept_offs = tuple(int(delta[i]) for i in kept)
     lvl_fn = _level_arrays_fn(kept, delta, p_offs, n)
     A1, diag, dinv, R_rows, cnum = lvl_fn(Ac, P_rows, cf)
-    ncb = bucket(nc, compact_step)
-    ncb = min(ncb, max(compact_step, n))
+    # a bucket larger than the fine grid would make foc shorter than
+    # its static shape — clamp to n (still ≥ nc, still shape-stable)
+    ncb = min(bucket(nc, compact_step), n)
     Kb = width_bucket(kmax)
     cfn = _compact_fn(kept_offs, n, ncb, Kb)
     foc, ccols, cvals = cfn(A1, cnum, cf, jnp.int32(nc))

@@ -295,6 +295,10 @@ class AMGHierarchy:
         upload genuinely overlap; ``_setup_smoothers_and_coarse`` drains
         the stream before touching any pack."""
         cur = self._build_dia_device(cur)
+        if self.algorithm == "CLASSICAL":
+            nxt = self._build_classical_device_pipeline(cur)
+            if nxt is not None:
+                cur = nxt
         stream = None
         if self.algorithm == "CLASSICAL" and cur.dist is None:
             from ..utils.thread_manager import ThreadManager
@@ -611,6 +615,201 @@ class AMGHierarchy:
         with cpu_profiler("dia_device_derive"):
             outs = derive_hierarchy_device(steps, offs, dvals)
         return len(steps), self._append_dia_levels(cur, steps, outs)
+
+    #: below this logical size the device pipeline hands the tail to the
+    #: host algorithms (a ≤4k-row download is ~1 MB; host finishes in ms)
+    _PIPELINE_TAIL_ROWS = 4096
+
+    def _pipeline_tail_rows(self) -> int:
+        import os
+        v = os.environ.get("AMGX_PIPELINE_TAIL_ROWS")
+        return int(v) if v else self._PIPELINE_TAIL_ROWS
+
+    def _classical_pipeline_eligible(self, cur: Matrix):
+        """Static gates of the fully-device classical pipeline; returns
+        the (offsets, keep, params) inputs or None (host path)."""
+        import os
+        if os.environ.get("AMGX_NO_DEVICE_PIPELINE") == "1":
+            return None
+        if cur.dist is not None or cur.block_dim != 1 or \
+                cur.placement is not None:
+            return None
+        if self.structure_reuse_levels != 0 or self.aggressive_levels:
+            return None
+        if len(self.levels) + 1 >= self.max_levels or \
+                cur.n_block_rows <= max(self.min_coarse_rows,
+                                        self._pipeline_tail_rows()):
+            return None
+        g = lambda p: self.cfg.get(p, self.scope)
+        sel = str(g("selector"))
+        interp = str(g("interpolator"))
+        sname = str(g("strength"))
+        if sel != "PMIS" or interp not in ("D1", "D2") or \
+                sname not in ("AHAT", "ALL"):
+            return None
+        # smoothers that set up from the device pack alone — a colored
+        # smoother would download the multi-GB embedded level for its
+        # host coloring pass
+        smoother = str(self.cfg.get("smoother", self.scope))
+        if smoother not in ("JACOBI_L1", "BLOCK_JACOBI", "JACOBI"):
+            return None
+        if self.cycle_type not in ("V", "W", "F"):
+            return None
+        if getattr(self, "host_levels_rows", -1) > 0 or \
+                getattr(self, "error_scaling", 0) in (2, 3):
+            return None
+        inputs = self._dia_plan_inputs(cur, max_diags=16)
+        if inputs is None:
+            return None
+        offs = inputs[0]
+        if any(-o not in offs for o in offs):
+            return None          # one-sided stencil: host path
+        from .classical.device_fine import ahat_plan
+        if interp == "D2" and len(ahat_plan(offs)[0]) > 48:
+            return None
+        params = dict(
+            theta=float(g("strength_threshold")),
+            max_row_sum=float(g("max_row_sum")),
+            strength_all=sname == "ALL", interp_d2=interp == "D2",
+            trunc_factor=float(g("interp_truncation_factor")),
+            max_elements=int(g("interp_max_elements")))
+        return offs, inputs[3], params
+
+    def _build_classical_device_pipeline(self, cur: Matrix):
+        """Fully-device classical setup (classical/device_pipeline.py +
+        device_coarse.py): the fine level coarsens by shift algebra into
+        an EMBEDDED coarse operator (a fine-grid DIA matrix — solve
+        SpMVs ride the Pallas DIA kernel), deeper levels by the compact
+        sort-algebra pipeline, until the ≤4k tail is handed back to the
+        host loop.  Returns the tail matrix, or None when any gate sends
+        the whole setup down the existing host path.
+
+        Reference: the on-accelerator setup loop of
+        ``classical_amg_level.cu:240-340`` + ``csr_multiply.h:100-126``
+        — here the hierarchy is born on the device and only a ~1 MB tail
+        ever crosses the wire."""
+        elig = self._classical_pipeline_eligible(cur)
+        if elig is None:
+            return None
+        offs, keep, params = elig
+        curd = cur.device()
+        if curd.fmt != "dia":
+            return None
+        import jax.numpy as jnp
+
+        from ..core.matrix import _dia_device_matrix
+        from ..ops.device_pack import device_ell_matrix
+        from ..utils.determinism import SESSION_SEED
+        from .classical.device_coarse import coarsen_compact
+        from .classical.device_pipeline import coarsen_fine_embedded
+        seed = 7 if bool(self.cfg.get("determinism_flag")) \
+            else SESSION_SEED
+        n = cur.n_block_rows
+        dvals = curd.vals if keep is None else curd.vals[keep]
+        with cpu_profiler("classical_device_fine_embedded"):
+            res = coarsen_fine_embedded(offs, dvals, n, seed=seed,
+                                        **params)
+        if res is None or res.nc >= self.coarsen_threshold * n or \
+                res.nc <= max(self.min_coarse_rows,
+                              self._pipeline_tail_rows()):
+            # too-small coarse grid: the embedded level-0 transfers
+            # would feed a tail that must stay embedded-sized — at these
+            # sizes the host path is already fast
+            return None
+        # ---- level 0: P/R as embedded DIA packs ----
+        h0 = res.p_offs.index(0)
+        P0 = _dia_device_matrix(res.p_offs, res.P_rows,
+                                res.P_rows[h0], n_cols=n)
+        r_offs = tuple(-o for o in res.p_offs[::-1])
+        R0 = _dia_device_matrix(r_offs, jnp.flip(res.R_rows, axis=0),
+                                res.P_rows[h0], n_cols=n)
+        lvl0 = ClassicalLevel(cur, len(self.levels), P0, R0, None)
+        A1m = Matrix.from_dia_device(res.a_offs, res.A_vals,
+                                     ddiag=res.diag, dinv=res.dinv)
+        A1m.logical_rows = res.nc
+        A1m._nnz_hint = int(jnp.count_nonzero(res.A_vals))
+        self.levels.append(lvl0)
+        self._structure.append(("classical-device", ()))
+        # ---- compact continuation ----
+        cur_m, cols, vals, n_log = A1m, res.cols, res.vals, res.nc
+        foc = res.foc            # embedded↔compact map of level 1
+        with cpu_profiler("classical_device_coarse_levels"):
+            while True:
+                if len(self.levels) + 1 >= self.max_levels or \
+                        n_log <= max(self.min_coarse_rows,
+                                     self._pipeline_tail_rows()):
+                    break
+                out = coarsen_compact(cols, vals, n_log, seed=seed,
+                                      **params)
+                if out is None or out.nc >= \
+                        self.coarsen_threshold * n_log or \
+                        out.nc >= n_log:
+                    break
+                nb, Kpx = out.P_cols.shape
+                if foc is not None:
+                    # embedded boundary: P rows live at the C points'
+                    # fine indices; R columns address the embedded
+                    # vector — pad foc entries (== n) drop on scatter
+                    pce = jnp.zeros((n, Kpx), jnp.int32).at[foc].set(
+                        out.P_cols, mode="drop")
+                    pve = jnp.zeros((n, Kpx), vals.dtype).at[foc].set(
+                        out.P_vals, mode="drop")
+                    rc_src = jnp.where(
+                        out.R_cols >= 0,
+                        foc[jnp.maximum(out.R_cols, 0)], -1)
+                    p_rows_space = n
+                else:
+                    pce, pve = out.P_cols, out.P_vals
+                    rc_src = out.R_cols
+                    p_rows_space = nb
+                Pd = device_ell_matrix(pce, pve, p_rows_space,
+                                       out.ncb2, square_diag=False)
+                Rd = device_ell_matrix(rc_src, out.R_vals, out.ncb2,
+                                       p_rows_space, square_diag=False)
+                lvl = ClassicalLevel(cur_m, len(self.levels), Pd, Rd,
+                                     None)
+                Acd = device_ell_matrix(out.Ac_cols, out.Ac_vals,
+                                        out.ncb2, out.ncb2)
+                nxt = Matrix.from_device_pack(
+                    Acd, nnz_hint=int(jnp.count_nonzero(out.Ac_vals)),
+                    logical_rows=out.nc)
+                self.levels.append(lvl)
+                self._structure.append(("classical-device", ()))
+                cur_m, cols, vals, n_log = nxt, out.Ac_cols, \
+                    out.Ac_vals, out.nc
+                foc = None
+        if cur_m is A1m:
+            # no compact level materialised (degenerate coarsening right
+            # below the fine level): a host continuation would need the
+            # multi-GB embedded matrix — unwind and let the host path
+            # redo this setup from scratch
+            self.levels.pop()
+            self._structure.pop()
+            return None
+        # ---- tail: hand the (small, padded) matrix to the host loop
+        with cpu_profiler("classical_device_tail_download"):
+            cur_m._host = self._compact_to_host(cols, vals)
+            cur_m.dtype = np.dtype(np.float64)
+        return cur_m
+
+    @staticmethod
+    def _compact_to_host(cols, vals) -> sp.csr_matrix:
+        """Download a compact device ELL level into host CSR (f64 — the
+        host tail algorithms and the dense coarse factorisation run at
+        setup precision, matching the uploaded-matrix path)."""
+        cc = np.asarray(cols)
+        cv = np.asarray(vals).astype(np.float64)
+        nb, K = cc.shape
+        rows = np.repeat(np.arange(nb), K)
+        flat_c = cc.reshape(-1)
+        flat_v = cv.reshape(-1)
+        keepm = (flat_v != 0) | (flat_c == rows)
+        M = sp.csr_matrix(
+            (flat_v[keepm], (rows[keepm], flat_c[keepm])),
+            shape=(nb, nb))
+        M.sum_duplicates()
+        M.sort_indices()
+        return M
 
     def _coarsen_classical_device_fine(self, cur: Matrix, idx: int,
                                        strength, sel_name: str,
@@ -1070,7 +1269,10 @@ class AMGHierarchy:
         """Grid-stats table mirroring the reference README sample output."""
         rows = []
         tot_rows = tot_nnz = 0
-        all_levels = [(l.Ad.n_rows, l.A.nnz) for l in self.levels]
+        # device-pipeline levels report their LOGICAL size (the embedded
+        # level-1 pack is fine-grid sized; pads aren't rows)
+        all_levels = [(getattr(l.A, "logical_rows", None) or
+                       l.Ad.n_rows, l.A.nnz) for l in self.levels]
         all_levels.append((self.coarsest.n_block_rows, self.coarsest.nnz))
         for i, (n, nnz) in enumerate(all_levels):
             sprs = nnz / max(n * n, 1)

@@ -351,6 +351,28 @@ class Matrix:
             m._dinv_dev = (m._device_dtype, dinv)
         return m
 
+    @classmethod
+    def from_device_pack(cls, dm: "DeviceMatrix",
+                         nnz_hint: Optional[int] = None,
+                         logical_rows: Optional[int] = None) -> "Matrix":
+        """Wrap an already-built DeviceMatrix (device-born coarse level,
+        amg/classical/device_pipeline.py) — no host data, no transfer.
+        ``nnz_hint``/``logical_rows`` feed grid stats without forcing a
+        device download; downstream consumers that genuinely need host
+        values trigger the lazy fetch paths."""
+        m = cls()
+        m.block_dim = dm.block_dim
+        m.dtype = np.dtype(dm.dtype)
+        m.device_dtype = np.dtype(dm.dtype)
+        m._device = dm
+        m._device_dtype = np.dtype(dm.dtype)
+        m._n_dia = (dm.n_rows, dm.n_cols)
+        if nnz_hint is not None:
+            m._nnz_hint = int(nnz_hint)
+        if logical_rows is not None:
+            m.logical_rows = int(logical_rows)
+        return m
+
     def _download_dia(self):
         """Fetch a device-resident DIA pack back to host (lazy — dense
         coarse solves, grid stats, and IO are the only consumers)."""
@@ -543,6 +565,11 @@ class Matrix:
     @property
     def nnz(self) -> int:
         # number of stored blocks × block area = scalar nnz
+        if self._host is None and \
+                getattr(self, "_nnz_hint", None) is not None:
+            # device-born level (from_device_pack): the hint avoids a
+            # multi-GB download just for grid stats
+            return self._nnz_hint
         if self._host is None and self.blocks is not None:
             return int(sum(b.nnz for b in self.blocks))
         if self._host is None and \

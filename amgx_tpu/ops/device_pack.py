@@ -1,0 +1,135 @@
+"""Device-side solve-pack builders for device-born coarse operators.
+
+The round-4 packs (``pallas_ell.ell_window_pack``, dense densify) run on
+HOST numpy because uploaded matrices start there.  The device classical
+pipeline (amg/classical/device_pipeline.py) births its coarse levels ON
+the accelerator — downloading a level just to window-pack it would put
+the wire right back into setup.  This module rebuilds the windowed-ELL
+layout with jnp ops (argsort / segmented flags / vmapped searchsorted —
+all in the measured-fast primitive set) so the pack never leaves the
+device.
+
+Reference analog: ``base/src/matrix.cu`` computes its solve layouts
+(row-major reorders, diagonal pointers) on the GPU at upload/setup time
+for the same reason.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from ..core.matrix import DeviceMatrix
+from .pallas_ell import _FLAT_BUDGET, _MAX_BLOCKS, _tile_rows
+
+
+@functools.lru_cache(maxsize=128)
+def _win_stats_fn(nb: int, K: int, tile: int):
+    """jit: cols (nb, K) i32 (in-range, self/0-padded) → (blk sorted
+    (n_tiles, T·K), order, maxB i32)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_tiles = nb // tile
+
+    def run(cols):
+        ct = cols.reshape(n_tiles, tile, K).transpose(0, 2, 1)
+        blk = (ct // 128).reshape(n_tiles, tile * K)
+        order = jnp.argsort(blk, axis=1)
+        sblk = jnp.take_along_axis(blk, order, axis=1)
+        new = jnp.ones(sblk.shape, dtype=bool)
+        new = new.at[:, 1:].set(sblk[:, 1:] != sblk[:, :-1])
+        counts = jnp.sum(new.astype(jnp.int32), axis=1)
+        return sblk, new, jnp.max(counts)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
+def _win_build_fn(nb: int, K: int, tile: int, B: int):
+    """jit: (cols, vals, sblk, new) → (block_ids (n_tiles, B) i32,
+    codes (1, nb·K) i32, win_vals (1, nb·K))."""
+    import jax
+    import jax.numpy as jnp
+
+    n_tiles = nb // tile
+    TK = tile * K
+
+    def run(cols, vals, sblk, new):
+        big = jnp.int32(1 << 30)
+        firsts = jnp.where(new, sblk, big)
+        block_ids = jnp.sort(firsts, axis=1)[:, :B]
+        ct = cols.reshape(n_tiles, tile, K).transpose(0, 2, 1)
+        blk = (ct // 128).reshape(n_tiles, TK)
+        lane = (ct % 128).reshape(n_tiles, TK)
+        slot = jax.vmap(jnp.searchsorted)(block_ids, blk)
+        slot = jnp.minimum(slot, B - 1)
+        codes = (slot.astype(jnp.int32) * 128 + lane).reshape(1, nb * K)
+        wv = vals.reshape(n_tiles, tile, K).transpose(0, 2, 1)
+        return (jnp.where(block_ids == big, 0, block_ids),
+                codes, wv.reshape(1, nb * K))
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _diag_fn(nb: int, K: int):
+    import jax
+    import jax.numpy as jnp
+
+    def run(cols, vals):
+        rown = jnp.arange(nb, dtype=jnp.int32)[:, None]
+        return jnp.sum(jnp.where(cols == rown, vals, 0.0), axis=1)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=64)
+def _sanitize_fn(nb: int, K: int, n_cols: int):
+    """Dead (-1) or out-of-range columns → 0 with value 0 (safe for the
+    window pack and the gather fallback alike)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(cols, vals):
+        ok = (cols >= 0) & (cols < n_cols) & (vals != 0)
+        return jnp.where(ok, cols, 0), jnp.where(ok, vals, 0.0)
+
+    return jax.jit(run)
+
+
+def device_ell_matrix(cols, vals, n_rows: int, n_cols: int,
+                      want_window: bool = True,
+                      square_diag: bool = True) -> DeviceMatrix:
+    """DeviceMatrix (fmt='ell') around device-resident ELL arrays, with
+    the windowed-ELL solve layout built ON DEVICE when it fits.
+
+    ``cols`` may carry -1/self padding; sanitized here.  One scalar
+    fetch (the max window-block count) decides the pack — the only
+    device→host traffic of the whole build."""
+    import jax
+    import jax.numpy as jnp
+
+    nb, K = cols.shape
+    cols, vals = _sanitize_fn(nb, K, n_cols)(cols, vals)
+    diag = _diag_fn(nb, K)(cols, vals) if square_diag else \
+        jnp.zeros((nb,), vals.dtype)
+    win = None
+    tile = _tile_rows(K)
+    if want_window and nb % tile == 0 and K <= 256 and \
+            jnp.dtype(vals.dtype) == jnp.float32:
+        sblk, new, maxb = _win_stats_fn(nb, K, tile)(cols)
+        B = -(-int(jax.device_get(maxb)) // 8) * 8
+        if B <= _MAX_BLOCKS and \
+                tile * K * (272 + 4 * B) <= (12 << 20):
+            blocks, codes, wv = _win_build_fn(nb, K, tile, B)(
+                cols, vals, sblk, new)
+            win = (blocks, codes, wv, tile)
+    return DeviceMatrix(
+        cols=cols, vals=vals, diag=diag, row_ids=None,
+        n_rows=nb, n_cols=n_cols, block_dim=1, fmt="ell", ell_width=K,
+        win_blocks=win[0] if win else None,
+        win_codes=win[1] if win else None,
+        win_vals=win[2] if win else None,
+        win_tile=win[3] if win else 0)
