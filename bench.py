@@ -119,6 +119,11 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None):
         print(f"[bench] setup host {t_setup_host:.2f}s "
               f"+ device-drain {setup_t - t_setup_host:.2f}s",
               file=sys.stderr)
+    # self-attributing split (VERDICT r4 weak #1/#8): the host-side
+    # share (python + any wire transfers, which block the host thread)
+    # vs the trailing device-drain — a tunnel-regime swing shows up in
+    # setup_host_s, a device regression in the total
+    setup_host_s, setup_drain_s = t_setup_host, setup_t - t_setup_host
     b_dev = jnp.ones(n, dtype)         # staged on device, no transfer
     res = slv.solve(b_dev)             # warm-up/compile solve
     t0 = time.perf_counter()
@@ -137,6 +142,8 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None):
         print(profiler_tree().report(), file=sys.stderr)
         profiler_tree().reset()
     return {"upload_s": round(upload_t, 4), "setup_s": round(setup_t, 4),
+            "setup_host_s": round(setup_host_s, 4),
+            "setup_drain_s": round(setup_drain_s, 4),
             "solve_s": round(solve_t, 4),
             "relres": relres, "iterations": int(res.iterations),
             "status": int(res.status), "n": int(n)}

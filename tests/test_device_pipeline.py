@@ -1,0 +1,278 @@
+"""Fully-device classical pipeline: parity with the host algorithms and
+end-to-end solves (CPU backend; the same jitted programs run on TPU).
+
+Reference parity targets: classical_amg_level.cu:240-340 (on-device
+strength/PMIS/interp) + csr_multiply.h:100-126 (on-device Galerkin).
+"""
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.core.matrix import dia_arrays
+from amgx_tpu.io import poisson7pt
+
+# θ chosen OFF the equal-coupling fp tie of the 7-pt Poisson: at 0.25
+# the strength test meets theta*rowmax exactly and a 1-ulp RAP
+# summation difference flips entries — both hierarchies are valid, but
+# parity tests need a stable one
+THETA = 0.2401
+
+
+class _Cfg:
+    def __init__(self, **over):
+        self.d = {"strength_threshold": THETA, "max_row_sum": 0.9,
+                  "interp_truncation_factor": 0.0,
+                  "interp_max_elements": 4, "determinism_flag": 1}
+        self.d.update(over)
+
+    def get(self, k, scope=None):
+        return self.d[k]
+
+
+def _host_level(A, interp_d2, cfg=None):
+    from amgx_tpu.amg.classical.interpolators import (D1Interpolator,
+                                                      D2Interpolator)
+    from amgx_tpu.amg.classical.selectors import _pmis
+    from amgx_tpu.amg.classical.strength import AhatStrength
+    cfg = cfg or _Cfg()
+    S = AhatStrength(cfg, "s").compute(sp.csr_matrix(A))
+    cf = _pmis(S, 7)
+    interp = (D2Interpolator if interp_d2 else D1Interpolator)(cfg, "s")
+    P = interp.compute(sp.csr_matrix(A), S, cf)
+    Ac = sp.csr_matrix(P.T @ sp.csr_matrix(A) @ P)
+    Ac.sum_duplicates()
+    return S, cf, P, Ac
+
+
+def _dense_from_ell(cols, vals, nc, n_cols):
+    out = np.zeros((nc, n_cols))
+    cc, vv = np.asarray(cols)[:nc], np.asarray(vals)[:nc]
+    for r in range(nc):
+        for k in range(cc.shape[1]):
+            if vv[r, k] != 0 and 0 <= cc[r, k] < n_cols:
+                out[r, cc[r, k]] += vv[r, k]
+    return out
+
+
+@pytest.mark.parametrize("interp_d2", [True, False])
+def test_embedded_fine_parity(interp_d2):
+    """cf/P/Ac of the embedded fine coarsening == host path (fp-level)."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.amg.classical.device_pipeline import \
+        coarsen_fine_embedded
+    nx = 10
+    A = sp.csr_matrix(poisson7pt(nx, nx, nx)).astype(np.float64)
+    n = A.shape[0]
+    offs, vals = dia_arrays(A, max_diags=16)
+    res = coarsen_fine_embedded(
+        offs, jnp.asarray(vals), n, theta=THETA, max_row_sum=0.9,
+        strength_all=False, interp_d2=interp_d2, trunc_factor=0.0,
+        max_elements=4, seed=7, compact_step=256)
+    assert res is not None
+    _, cf_h, P_h, Ac_h = _host_level(A, interp_d2)
+    cf_d = np.asarray(res.cf).astype(np.int8)
+    assert np.array_equal(cf_h, cf_d)
+    cnum = np.cumsum(cf_d) - 1
+    # embedded P -> dense
+    Pr = np.asarray(res.P_rows)
+    Pd = np.zeros((n, res.nc))
+    for k, o in enumerate(res.p_offs):
+        idx = np.flatnonzero(Pr[k])
+        Pd[idx, cnum[idx + o]] += Pr[k][idx]
+    assert np.allclose(P_h.toarray(), Pd, atol=1e-12)
+    # embedded Ac -> dense (coarse numbering)
+    A1 = np.asarray(res.A_vals)
+    Acd = np.zeros((res.nc, res.nc))
+    for k, d in enumerate(res.a_offs):
+        idx = np.flatnonzero(A1[k])
+        Acd[cnum[idx], cnum[idx + d]] += A1[k][idx]
+    assert np.allclose(Ac_h.toarray(), Acd, atol=1e-10)
+    # compact ELL == the same coarse matrix
+    Acc = _dense_from_ell(res.cols, res.vals, res.nc, res.nc)
+    assert np.allclose(Acc, Ac_h.toarray(), atol=1e-10)
+
+
+@pytest.mark.parametrize("interp_d2", [True, False])
+def test_compact_coarsen_parity(interp_d2):
+    """Second-level device coarsening == host algorithms on the same
+    coarse matrix (strength, PMIS, interpolation, RAP)."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.amg.classical.device_coarse import coarsen_compact
+    from amgx_tpu.amg.classical.device_pipeline import \
+        coarsen_fine_embedded
+    nx = 10
+    A = sp.csr_matrix(poisson7pt(nx, nx, nx)).astype(np.float64)
+    n = A.shape[0]
+    offs, vals = dia_arrays(A, max_diags=16)
+    res = coarsen_fine_embedded(
+        offs, jnp.asarray(vals), n, theta=THETA, max_row_sum=0.9,
+        strength_all=False, interp_d2=interp_d2, trunc_factor=0.0,
+        max_elements=4, seed=7, compact_step=256)
+    _, _, _, A1h = _host_level(A, interp_d2)
+    out = coarsen_compact(res.cols, res.vals, res.nc, theta=THETA,
+                          max_row_sum=0.9, strength_all=False,
+                          interp_d2=interp_d2, trunc_factor=0.0,
+                          max_elements=4, seed=7, compact_step=256)
+    assert out is not None
+    S1, cf1, P1, A2h = _host_level(A1h, interp_d2)
+    nc1 = res.nc
+    assert np.array_equal(cf1, np.asarray(out.cf)[:nc1].astype(np.int8))
+    assert out.nc == int(cf1.sum())
+    Pd = _dense_from_ell(out.P_cols, out.P_vals, nc1, out.nc)
+    assert np.allclose(P1.toarray(), Pd, atol=1e-12)
+    Ad = _dense_from_ell(out.Ac_cols, out.Ac_vals, out.nc, out.nc)
+    assert np.allclose(A2h.toarray(), Ad, atol=1e-10)
+    # R == P^T
+    Rd = _dense_from_ell(out.R_cols, out.R_vals, out.nc, nc1)
+    assert np.allclose(Rd, Pd.T, atol=1e-14)
+
+
+def test_anisotropic_d1_strength_mask_parity():
+    """Round-4 advisor fix: the D1 device path must restrict C_i to
+    strength-filtered entries — exercised on an operator with WEAK
+    couplings (anisotropic 3D Poisson)."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.amg.classical.device_pipeline import \
+        coarsen_fine_embedded
+    nx = 8
+    A3 = poisson7pt(nx, nx, nx).astype(np.float64).tocsr()
+    # scale z-couplings down 100x: weak couplings at theta=0.2401
+    rows = np.repeat(np.arange(A3.shape[0]), np.diff(A3.indptr))
+    zdiff = np.abs(A3.indices - rows) == nx * nx
+    A3.data = np.where(zdiff, A3.data * 0.01, A3.data)
+    # keep it SPD-ish/consistent: also bump the diagonal accordingly
+    diag_fix = np.bincount(rows[zdiff],
+                           weights=0.99 * -A3.data[zdiff] * 100,
+                           minlength=A3.shape[0])
+    A3 = sp.csr_matrix(A3 + sp.diags(-0.0 * diag_fix))
+    n = A3.shape[0]
+    offs, vals = dia_arrays(A3, max_diags=16)
+    res = coarsen_fine_embedded(
+        offs, jnp.asarray(vals), n, theta=THETA, max_row_sum=1.1,
+        strength_all=False, interp_d2=False, trunc_factor=0.0,
+        max_elements=4, seed=7, compact_step=256)
+    assert res is not None
+    _, cf_h, P_h, Ac_h = _host_level(
+        A3, False, _Cfg(max_row_sum=1.1))
+    assert np.array_equal(cf_h, np.asarray(res.cf).astype(np.int8))
+    cnum = np.cumsum(cf_h) - 1
+    Pr = np.asarray(res.P_rows)
+    Pd = np.zeros((n, res.nc))
+    for k, o in enumerate(res.p_offs):
+        idx = np.flatnonzero(Pr[k])
+        Pd[idx, cnum[idx + o]] += Pr[k][idx]
+    assert np.allclose(P_h.toarray(), Pd, atol=1e-12)
+
+
+def test_pipeline_end_to_end_matches_host():
+    """Full solver stack through the device pipeline: same hierarchy
+    sizes and iteration count as the host path."""
+    import jax.numpy as jnp
+    os.environ["AMGX_PIPELINE_TAIL_ROWS"] = "300"
+    try:
+        CFG = (
+            "config_version=2, solver(out)=PCG, out:max_iters=100, "
+            "out:monitor_residual=1, out:tolerance=1e-8, "
+            "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+            "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+            "amg:interpolator=D2, amg:max_iters=1, "
+            "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+            "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+            "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+            "amg:min_coarse_rows=32, "
+            "amg:coarse_solver=DENSE_LU_SOLVER, determinism_flag=1")
+        nx = 20
+        A = sp.csr_matrix(poisson7pt(nx, nx, nx))
+        n = A.shape[0]
+        slv = amgx.create_solver(amgx.AMGConfig(CFG))
+        slv.setup(amgx.Matrix(A))
+        hier = slv.preconditioner.hierarchy
+        kinds = [s[0] for s in hier._structure]
+        assert kinds[0] == "classical-device", kinds
+        b = jnp.ones(n, jnp.float64)
+        res = slv.solve(b)
+        x = np.asarray(res.x)
+        rr = np.linalg.norm(np.ones(n) - A @ x) / np.sqrt(n)
+        assert res.status == 0 and rr < 1e-7
+        os.environ["AMGX_NO_DEVICE_PIPELINE"] = "1"
+        try:
+            slv2 = amgx.create_solver(amgx.AMGConfig(CFG))
+            slv2.setup(amgx.Matrix(A))
+            res2 = slv2.solve(b)
+        finally:
+            del os.environ["AMGX_NO_DEVICE_PIPELINE"]
+        assert res2.status == 0
+        assert abs(int(res.iterations) - int(res2.iterations)) <= 2
+        # logical grid stats: level sizes match the host hierarchy
+        h2 = slv2.preconditioner.hierarchy
+        sizes_d = [getattr(l.A, "logical_rows", None) or l.Ad.n_rows
+                   for l in hier.levels]
+        sizes_h = [l.Ad.n_rows for l in h2.levels]
+        assert sizes_d == sizes_h
+    finally:
+        os.environ.pop("AMGX_PIPELINE_TAIL_ROWS", None)
+
+
+def test_pipeline_gates_fall_back():
+    """Configs outside the device gates must take the host path (here: a
+    colored smoother that needs host setup)."""
+    os.environ["AMGX_PIPELINE_TAIL_ROWS"] = "300"
+    try:
+        CFG = (
+            "config_version=2, solver(out)=PCG, out:max_iters=30, "
+            "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+            "amg:selector=PMIS, amg:interpolator=D2, amg:max_iters=1, "
+            "amg:smoother(sm)=MULTICOLOR_GS, sm:max_iters=1, "
+            "amg:min_coarse_rows=32, "
+            "amg:coarse_solver=DENSE_LU_SOLVER, determinism_flag=1")
+        nx = 12
+        A = sp.csr_matrix(poisson7pt(nx, nx, nx))
+        slv = amgx.create_solver(amgx.AMGConfig(CFG))
+        slv.setup(amgx.Matrix(A))
+        kinds = [s[0] for s in slv.preconditioner.hierarchy._structure]
+        assert all(k == "classical" for k in kinds), kinds
+    finally:
+        os.environ.pop("AMGX_PIPELINE_TAIL_ROWS", None)
+
+
+def test_device_winpack_matches_host_pack():
+    """Device-built windowed-ELL layout == host ell_window_pack."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.device_pack import device_ell_matrix
+    from amgx_tpu.ops.pallas_ell import ell_window_pack, win_vals_pack
+    rng = np.random.default_rng(3)
+    n, K = 1024, 12
+    base = np.arange(n)[:, None]
+    cols = np.clip(base + rng.integers(-200, 200, size=(n, K)), 0,
+                   n - 1)
+    cols = np.sort(cols, axis=1).astype(np.int32)
+    vals = rng.standard_normal((n, K)).astype(np.float32)
+    host = ell_window_pack(cols)
+    assert host is not None
+    blocks_h, codes_h, tile_h = host
+    dm = device_ell_matrix(jnp.asarray(cols), jnp.asarray(vals), n, n)
+    assert dm.win_codes is not None and dm.win_tile == tile_h
+
+    def decode(blocks, codes, tile):
+        c = np.asarray(codes).reshape(-1, tile * K).astype(np.int64)
+        slot, lane = c >> 7, c & 127
+        blk = np.take_along_axis(np.asarray(blocks, np.int64), slot,
+                                 axis=1)
+        return blk * 128 + lane
+
+    ct = cols.reshape(-1, tile_h, K).transpose(0, 2, 1).reshape(
+        -1, tile_h * K)
+    vt = vals.reshape(-1, tile_h, K).transpose(0, 2, 1).reshape(
+        -1, tile_h * K)
+    m = vt != 0
+    assert np.array_equal(
+        decode(dm.win_blocks, dm.win_codes, tile_h)[m], ct[m])
+    assert np.array_equal(np.asarray(dm.win_vals).ravel(),
+                          np.asarray(win_vals_pack(vals, tile_h)).ravel())
