@@ -41,7 +41,13 @@ def create_interpolator(name, cfg, scope):
 def truncate_and_scale(P: sp.csr_matrix, trunc_factor: float,
                        max_elements: int) -> sp.csr_matrix:
     """Drop small P entries and rescale rows to preserve row sums
-    (reference ``truncateAndScale``, truncate.cu:625)."""
+    (reference ``truncateAndScale``, truncate.cu:625).
+
+    When BOTH criteria are configured, the top-``max_elements`` pass
+    ranks only the entries that SURVIVED the factor filter (a
+    factor-dropped entry never consumes a top-k slot) — the host, the
+    device fine program and the device compact program all share this
+    semantics (pinned by ``test_truncate_combined_semantics``)."""
     if trunc_factor >= 1.0 and max_elements <= 0:
         return P
     P = sp.csr_matrix(P).copy()

@@ -595,6 +595,20 @@ def AMGX_read_system_distributed(mtx: MatrixHandle, rhs: VectorHandle,
     b = _resolve_rhs(sysdata, mtx)
     x = sysdata.solution
     mtx._dist_perm = None
+    if partition_vector is None and partition_sizes is not None \
+            and num_partitions > 1:
+        # contiguous-size partitioning (the reference's
+        # partition_sizes-without-vector form): synthesize the
+        # rank-major partition vector — rows are already contiguous, so
+        # the stable renumbering below is the identity
+        sizes = np.asarray(partition_sizes, dtype=np.int64)
+        if len(sizes) != num_partitions or int(sizes.sum()) != \
+                A.shape[0]:
+            raise BadParametersError(
+                "partition_sizes must list num_partitions row counts "
+                "summing to the global row count")
+        partition_vector = np.repeat(
+            np.arange(num_partitions, dtype=np.int64), sizes)
     if num_partitions > 1 and partition_vector is not None:
         import scipy.sparse as _sp
         pv = np.asarray(partition_vector)

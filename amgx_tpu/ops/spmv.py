@@ -62,8 +62,12 @@ def spmv(A, x: jax.Array) -> jax.Array:
                 # gather-free windowed one-hot kernel (XLA lowers the
                 # x[cols] gather to a scalar loop — ~100× slower)
                 return ell_window_spmv(A, x)
-            # cols: (n, K); vals: (n, K); x: (m,)
-            return jnp.sum(A.vals * x[A.cols], axis=1)
+            # cols: (n, K); vals: (n, K); x: (m,) — via the views so a
+            # LEAN shift/window pack (vals/cols deleted; the kernel
+            # layouts carry them) still falls back correctly when the
+            # kernel gate rejects it (advisor finding, round 4)
+            return jnp.sum(A.ell_vals_view() * x[A.ell_cols_view()],
+                           axis=1)
         xb = x.reshape(A.n_cols, b)
         xg = xb[A.cols]                      # (n, K, b)
         y = jnp.einsum("nkab,nkb->na", A.vals, xg,

@@ -220,3 +220,24 @@ def test_device_fine_tiny_grids(dims):
     cf_dev, P_dev = _device(A, True)
     assert np.array_equal(cf_ref.astype(np.int8), cf_dev)
     assert abs(P_ref - P_dev).max() < 1e-12
+
+
+def test_truncate_combined_semantics():
+    """Pin the combined trunc_factor+max_elements behavior (round-4
+    advisor): top-k ranks only factor-surviving entries, so a
+    factor-dropped entry never consumes a top-k slot, and the kept
+    entries rescale to the ORIGINAL row sum."""
+    import scipy.sparse as sp
+
+    from amgx_tpu.amg.classical.interpolators import truncate_and_scale
+
+    # one row: |entries| = 1.0, 0.9, 0.05, 0.04  (factor 0.5 keeps 2)
+    P = sp.csr_matrix(np.array([[1.0, -0.9, 0.05, 0.04]]))
+    out = truncate_and_scale(P, trunc_factor=0.5, max_elements=3)
+    # survivors: 1.0, -0.9 -> top-3 keeps both (NOT 0.05, which the
+    # factor dropped even though a slot is free)
+    dense = out.toarray()[0]
+    assert np.count_nonzero(dense) == 2
+    # rescaled to the original row sum 0.19
+    assert abs(dense.sum() - 0.19) < 1e-14
+    assert dense[2] == 0 and dense[3] == 0
