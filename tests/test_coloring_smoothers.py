@@ -299,3 +299,95 @@ def test_downwind_dilu_beats_min_max_on_advection():
     # both converge; downwind needs strictly fewer sweeps
     assert res_dw.iterations < res_mm.iterations, (
         res_dw.iterations, res_mm.iterations)
+
+
+# ---------------------------------------------------------------------------
+# round-5: vectorized greedy algorithms + real recolor/2ring refinement
+# ---------------------------------------------------------------------------
+
+def _cfg_coloring(**over):
+    from amgx_tpu import AMGConfig
+    base = ("config_version=2, solver(out)=PCG, "
+            "determinism_flag=1")
+    return AMGConfig(base)
+
+
+def test_greedy_recolor_reduces_colors():
+    """GREEDY_RECOLOR's recolor pass must beat plain PARALLEL_GREEDY on
+    an irregular graph (greedy_recolor.cu parity criterion)."""
+    import scipy.sparse as sp
+
+    from amgx_tpu.coloring import check_coloring, create_coloring
+    rng = np.random.default_rng(5)
+    n = 4000
+    # irregular: random sparse symmetric graph + a chain for
+    # connectivity
+    ii = rng.integers(0, n, size=8 * n)
+    jj = rng.integers(0, n, size=8 * n)
+    chain = np.arange(n - 1)
+    ii = np.concatenate([ii, chain])
+    jj = np.concatenate([jj, chain + 1])
+    A = sp.csr_matrix((np.ones(len(ii)), (ii, jj)), shape=(n, n))
+    A = ((A + A.T) + sp.identity(n)).tocsr()
+    cfg = _cfg_coloring()
+    base = create_coloring("PARALLEL_GREEDY", cfg, "default").color(A)
+    rec = create_coloring("GREEDY_RECOLOR", cfg, "default").color(A)
+    assert check_coloring(A, rec) == 0.0
+    assert rec.num_colors <= base.num_colors
+    # the pass must actually engage on this graph
+    assert rec.num_colors < base.num_colors
+
+
+def test_greedy_min_max_2ring_refines():
+    """GREEDY_MIN_MAX_2RING = 2-ring JP + recolor refinement: proper on
+    the distance-2 graph, never more colors than MIN_MAX_2RING."""
+    import scipy.sparse as sp
+
+    from amgx_tpu.coloring import check_coloring, create_coloring
+    from amgx_tpu.io import poisson5pt
+    A = sp.csr_matrix(poisson5pt(24, 24))
+    cfg = _cfg_coloring()
+    plain = create_coloring("MIN_MAX_2RING", cfg, "default").color(A)
+    refined = create_coloring("GREEDY_MIN_MAX_2RING", cfg,
+                              "default").color(A)
+    assert check_coloring(A, refined, level=2) == 0.0
+    assert refined.num_colors <= plain.num_colors
+
+
+def test_serial_greedy_bfs_valid_and_vectorized():
+    import scipy.sparse as sp
+
+    from amgx_tpu.coloring import check_coloring, create_coloring
+    from amgx_tpu.io import poisson7pt
+    A = sp.csr_matrix(poisson7pt(12, 12, 12))
+    cfg = _cfg_coloring()
+    c = create_coloring("SERIAL_GREEDY_BFS", cfg, "default").color(A)
+    assert check_coloring(A, c) == 0.0
+    assert c.num_colors <= 8
+
+
+@pytest.mark.slow
+def test_million_row_greedy_under_two_seconds():
+    """Round-4 verdict item 8: 10⁶-row coloring AND aggregation in < 2 s
+    host time each (the old per-node python loops took minutes)."""
+    import time
+
+    import scipy.sparse as sp
+
+    from amgx_tpu.amg.aggregation.selectors import create_selector
+    from amgx_tpu.coloring import check_coloring, create_coloring
+    from amgx_tpu.io import poisson7pt
+    A = sp.csr_matrix(poisson7pt(100, 100, 100))
+    cfg = _cfg_coloring()
+    col = create_coloring("PARALLEL_GREEDY", cfg, "default")
+    t0 = time.perf_counter()
+    c = col.color(A)
+    t_color = time.perf_counter() - t0
+    assert check_coloring(A, c) == 0.0
+    sel = create_selector("PARALLEL_GREEDY", cfg, "default")
+    t0 = time.perf_counter()
+    agg = sel.select(A)
+    t_agg = time.perf_counter() - t0
+    assert agg.min() >= 0 and len(agg) == A.shape[0]
+    assert t_color < 2.0, t_color
+    assert t_agg < 2.0, t_agg
