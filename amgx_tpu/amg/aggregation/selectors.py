@@ -327,5 +327,37 @@ class DummySelector(_SelectorBase):
 
 @register_selector("GEO")
 class GeoSelector(DummySelector):
-    """Geometric selector placeholder — reference ``geo_selector.cu`` uses
-    attached geometry; without geometry we fall back to block aggregates."""
+    """Geometric aggregation from ATTACHED coordinates (reference
+    ``geo_selector.cu:249-345``): points bin into a uniform
+    ``2^(nlevel-1)`` cell grid per axis — ``nlevel = log2(sqrt n)`` in
+    2D, ``log2(cbrt n)`` in 3D — giving ~4/8-point aggregates in
+    arbitrary row order (no lexicographic assumption).  Non-empty cells
+    renumber contiguously (the reference keeps empty aggregate slots;
+    our Galerkin wants dense ids — same aggregates either way).
+
+    Stencil-ordered grids never reach this code: the hierarchy's
+    structured DIA path (amg/structured.py) handles them gather-free.
+    Without attached geometry the DUMMY block fallback applies
+    (documented)."""
+
+    def select(self, A):
+        coords = getattr(A, "_amgx_geometry", None)
+        if coords is None or len(coords) not in (2, 3):
+            return super().select(A)
+        n = A.shape[0]
+        if len(coords) == 2:
+            nlevel = int(np.floor(np.log2(max(np.sqrt(n), 2.0))))
+        else:
+            nlevel = int(np.ceil(np.log2(max(np.cbrt(n), 2.0))))
+        npr = max(1, 2 ** (nlevel - 1))
+        label = np.zeros(n, dtype=np.int64)
+        mult = 1
+        for c in coords:
+            c = np.asarray(c, dtype=np.float64)
+            cmin, cmax = float(c.min()), float(c.max())
+            dist = 1.01 * max(cmax - cmin, 1e-10)
+            label += mult * np.minimum(
+                ((c - cmin) / dist * npr).astype(np.int64), npr - 1)
+            mult *= npr
+        _, agg = np.unique(label, return_inverse=True)
+        return agg.astype(np.int64)

@@ -874,12 +874,18 @@ class AMGHierarchy:
                 out = self._coarsen_pairwise(cur, idx)
                 if out is not _PAIRWISE_FALLBACK:
                     return out
-                name = "SIZE_2"  # too irregular for the structured path
+                if getattr(cur, "geometry", None) is None:
+                    name = "SIZE_2"  # irregular AND no coordinates
             selector = create_selector(name, self.cfg, self.scope)
             if cur.dist is not None:
                 return self._coarsen_aggregation_dist(cur, idx, selector)
             Asc = cur.scalar_csr() if cur.block_dim == 1 else \
                 _block_condensed(cur)
+            geom = getattr(cur, "geometry", None)
+            if geom is not None:
+                # attached per-row coordinates feed the GEO selector
+                # (AMGX_matrix_attach_geometry → geo_selector.cu)
+                Asc._amgx_geometry = geom
             agg = selector.select(Asc)
             nc = int(agg.max()) + 1 if len(agg) else 0
             if nc == 0:
@@ -887,6 +893,14 @@ class AMGHierarchy:
             Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
             level = AggregationLevel(cur, idx, agg, nc)
             Ac = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
+            if geom is not None:
+                # coarse-level geometry = aggregate centroids, so GEO
+                # keeps aggregating geometrically below the fine level
+                cnt = np.bincount(agg, minlength=nc).astype(np.float64)
+                Ac.geometry = tuple(
+                    np.bincount(agg, weights=np.asarray(c, np.float64),
+                                minlength=nc) / np.maximum(cnt, 1)
+                    for c in geom)
             return level, Ac, ("aggregation", (agg, nc))
         elif self.algorithm in ("CLASSICAL", "ENERGYMIN"):
             if cur.block_dim != 1:
