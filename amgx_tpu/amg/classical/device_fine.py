@@ -120,10 +120,16 @@ def dia_strength(vals, offs: Sequence[int], n: int, dt, theta: float,
     return [strong.get(k, jnp.zeros(n, dtype=bool)) for k in range(nd)]
 
 
-def dia_pmis(S, offs: Sequence[int], n: int, seed: int):
+def dia_pmis(S, offs: Sequence[int], n: int, seed: int,
+             tie_idx=None, n_log=None, a_mult=None):
     """PMIS C/F split over the symmetrised DIA strength graph — the same
     synchronous two-phase rounds and strictly-distinct tie-break weights
-    as the host ``selectors._pmis``.  Returns cf (n,) bool."""
+    as the host ``selectors._pmis``.  Returns cf (n,) bool.
+
+    ``tie_idx``/``n_log``/``a_mult``: for an EMBEDDED coarse level
+    (device_pipeline) the tie-break weights must be the host weights of
+    the LOGICAL (compact) indices — pass the embedded→compact numbering
+    and the logical row count (both may be traced)."""
     import functools as _ft
 
     import jax
@@ -133,13 +139,21 @@ def dia_pmis(S, offs: Sequence[int], n: int, seed: int):
     k0 = offs.index(0)
     offd = [k for k in range(nd) if k != k0]
     kneg = {o: k for k, o in enumerate(offs)}
-    a_mult = pmis_multiplier(n)
     # tie-break permutation computed ON DEVICE — int64 exact for
     # a·i < 2^50; a 2 MB fraction upload through the tunnel would cost
     # more than the rest of the program
-    i64 = jnp.arange(n, dtype=jnp.int64)
-    perm = (i64 * a_mult + (seed % n)) % n
-    frac = (perm.astype(jnp.float64) + 1.0) / float(n + 2)
+    if tie_idx is None:
+        a_mult = pmis_multiplier(n)
+        i64 = jnp.arange(n, dtype=jnp.int64)
+        perm = (i64 * a_mult + (seed % n)) % n
+        frac = (perm.astype(jnp.float64) + 1.0) / float(n + 2)
+    else:
+        i64 = tie_idx.astype(jnp.int64)
+        nl = jnp.maximum(jnp.asarray(n_log, jnp.int64), 1)
+        am = jnp.asarray(a_mult, jnp.int64)
+        perm = (i64 * am + (jnp.int64(seed) % nl)) % nl
+        frac = (perm.astype(jnp.float64) + 1.0) / \
+            (nl.astype(jnp.float64) + 2.0)
     # symmetrised graph row masks: G_d = S_d | shift(S_{-d}, d)
     G = []
     for k in range(nd):

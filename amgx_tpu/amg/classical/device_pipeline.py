@@ -194,11 +194,12 @@ def _level_arrays_fn(kept: Tuple[int, ...], delta_offs: Tuple[int, ...],
             jnp.zeros((n,), Ac.dtype)
         dinv = jnp.where(diag != 0,
                          1.0 / jnp.where(diag == 0, 1.0, diag), 0.0)
+        l1row = jnp.sum(jnp.abs(A1), axis=0)
         R_rows = jnp.stack([
             _shift(P_rows[pi], -int(p_offs[pi]))
             for pi in range(len(p_offs))])
         cnum = jnp.cumsum(cf.astype(jnp.int32)) - 1
-        return A1, diag, dinv, R_rows, cnum
+        return A1, diag, dinv, l1row, R_rows, cnum
 
     return jax.jit(run)
 
@@ -246,6 +247,7 @@ def _compact_fn(kept_offs: Tuple[int, ...], n: int, ncb: int, Kb: int):
             cols = jnp.pad(cols, ((0, 0), (0, pad)))
             vals = jnp.pad(vals, ((0, 0), (0, pad)))
             live_k = jnp.pad(live_k, ((0, 0), (0, pad)))
+            topi = jnp.pad(topi, ((0, 0), (0, pad)))
         rown = jnp.arange(ncb, dtype=jnp.int32)[:, None]
         cols = jnp.where(live_k, cols, rown)          # self-loop pad
         vals = jnp.where(live_k, vals, 0.0)
@@ -253,9 +255,17 @@ def _compact_fn(kept_offs: Tuple[int, ...], n: int, ncb: int, Kb: int):
         # them as isolated F points
         first = jnp.arange(Kb) == 0
         vals = jnp.where((~valid[:, None]) & first, 1.0, vals)
-        return foc, cols, vals
+        return foc, cols, vals, topi, live_k
 
     return jax.jit(run)
+
+
+# NOTE: an embedded (shift-algebra) strength+PMIS for level 1 was
+# built and benchmarked here in round 5 and REMOVED: its jitted program
+# carries one op per realized offset (~200) inside the PMIS while-loop
+# body, and XLA compile time for that graph (minutes, keyed on a
+# data-dependent offset tuple) dwarfed the 1-2 s of gathers it saved.
+# The compact gather path in device_coarse handles level 1.
 
 
 def bucket(x: int, step: int = 8192) -> int:
@@ -310,15 +320,16 @@ def coarsen_fine_embedded(offs: Sequence[int], dvals, n: int, *,
         return None
     kept_offs = tuple(int(delta[i]) for i in kept)
     lvl_fn = _level_arrays_fn(kept, delta, p_offs, n)
-    A1, diag, dinv, R_rows, cnum = lvl_fn(Ac, P_rows, cf)
+    A1, diag, dinv, l1row, R_rows, cnum = lvl_fn(Ac, P_rows, cf)
     # a bucket larger than the fine grid would make foc shorter than
     # its static shape — clamp to n (still ≥ nc, still shape-stable)
     ncb = min(bucket(nc, compact_step), n)
     Kb = width_bucket(kmax)
     cfn = _compact_fn(kept_offs, n, ncb, Kb)
-    foc, ccols, cvals = cfn(A1, cnum, cf, jnp.int32(nc))
+    foc, ccols, cvals, topi, live = cfn(A1, cnum, cf, jnp.int32(nc))
     return EmbeddedFineResult(
         p_offs=p_offs, P_rows=P_rows, R_rows=R_rows,
         a_offs=kept_offs, A_vals=A1, diag=diag, dinv=dinv,
-        cf=cf, cnum=cnum, nc=nc, kmax=kmax,
-        foc=foc, cols=ccols, vals=cvals, ncb=ncb, Kb=Kb)
+        l1row=l1row, cf=cf, cnum=cnum, nc=nc, kmax=kmax,
+        foc=foc, cols=ccols, vals=cvals, ncb=ncb, Kb=Kb,
+        topi=topi, live=live)

@@ -26,6 +26,10 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
         return dist_spmv(A, x)
+    if A.fmt == "dia3":
+        # Galerkin composition R·(A·(P·x)) — three DIA streams instead
+        # of one low-fill embedded matrix (core.matrix.ComposedDIA)
+        return spmv(A.R, spmv(A.A, spmv(A.P, x)))
     if A.fmt == "dia":
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
@@ -89,6 +93,8 @@ def abs_rowsum(A) -> jax.Array:
     contribute 0).  Serves the L1-Jacobi diagonal and Chebyshev
     Gershgorin bound without host work or extra uploads."""
     import jax.numpy as jnp
+    if A.fmt == "dia3":
+        return A.l1row          # precomputed from the embedded form
     if A.fmt == "dia":
         return jnp.sum(jnp.abs(A.vals), axis=0)
     if A.fmt == "dense":

@@ -152,7 +152,7 @@ class JacobiL1Solver(Solver):
 
     def solver_setup(self):
         if self.Ad.block_dim == 1 and self.Ad.fmt in (
-                "dia", "ell", "csr", "dense", "sharded-ell"):
+                "dia", "dia3", "ell", "csr", "dense", "sharded-ell"):
             # L1 row sums from the pack ON DEVICE (|diag| + Σ|off-diag| =
             # Σ|row|): zero transfer, works with or without a host
             # matrix (blocks-mode distributed levels included), and
