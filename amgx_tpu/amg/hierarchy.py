@@ -695,6 +695,16 @@ class AMGHierarchy:
         curd = cur.device()
         if curd.fmt != "dia":
             return None
+        # HBM guard: the embedded RAP materialises (candidate Δ, n) —
+        # ~2.9 GB at 128³.  Past ~8 GB (256³ would need 23 GB) the host
+        # path takes over rather than OOMing the chip.
+        from .classical.device_pipeline import (ahat_plan,
+                                                rap_candidate_offsets)
+        p_offs = ahat_plan(offs)[0] if params["interp_d2"] else offs
+        n_cand = len(rap_candidate_offsets(offs, p_offs))
+        itemsize = np.dtype(cur.device_dtype or cur.dtype).itemsize
+        if n_cand * cur.n_block_rows * itemsize > (8 << 30):
+            return None
         import jax.numpy as jnp
 
         from ..core.matrix import _dia_device_matrix
