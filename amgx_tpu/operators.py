@@ -1,0 +1,157 @@
+"""Implicit linear operators for Krylov-on-operator solves.
+
+Reference: ``base/include/operators/operator.h:37-80`` (the abstract
+``Operator::apply`` the solver framework accepts instead of a concrete
+matrix) and its concrete flavours in ``core/src/operators/``:
+``shifted_operator.cu`` (A − σI), ``deflated_multiply_operator.cu``
+(A·v − λ (v·x₀) x₀ for locked eigenpairs), ``pagerank_operator.cu``
+(damped column-stochastic web operator), ``solve_operator.cu`` /
+``solver_operator.cu`` (a nested solver as an operator).
+
+TPU redesign: an operator is a frozen PYTREE with an ``apply`` the
+:func:`amgx_tpu.ops.spmv.spmv` dispatch recognises (``fmt == "op"``) —
+it rides through the whole-solve jit as arguments, composes with every
+Krylov solver (``Solver.setup`` accepts an operator wherever it accepts
+a matrix), and its latency hiding is XLA's problem, as the reference
+header's comment wishes it could be.  The eigensolver machinery has
+used these formulas inline since round 3 (``eigen/algorithms.py``);
+this module makes the capability public API, matching the reference's
+operator registry.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ImplicitOperator", "ShiftedOperator", "DeflatedOperator",
+           "PageRankOperator", "SolverOperator", "as_operator"]
+
+
+@functools.partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["base", "diag", "aux"],
+    meta_fields=["n_rows", "n_cols", "kind"],
+)
+@dataclasses.dataclass(frozen=True)
+class ImplicitOperator:
+    """A linear operator defined by composition over a base pack.
+
+    ``base``: the underlying DeviceMatrix (or another operator);
+    ``aux``: kind-specific arrays (shift scalar, deflation basis,
+    dangling mask...); ``diag``: the operator's diagonal (smoothers and
+    Jacobi-family preconditioners read it, reference
+    ``Matrix::computeDiagonal`` analog)."""
+
+    base: Any
+    diag: jax.Array
+    aux: Any
+    n_rows: int
+    n_cols: int
+    kind: str
+
+    fmt = "op"
+    block_dim = 1
+    ell_width = 0
+
+    @property
+    def n(self) -> int:
+        return self.n_rows
+
+    @property
+    def dtype(self):
+        return self.diag.dtype
+
+    def apply(self, x: jax.Array) -> jax.Array:
+        from .ops.spmv import spmv
+        if self.kind == "shifted":
+            # (A − σI)·x  (shifted_operator.cu:'s apply)
+            return spmv(self.base, x) - self.aux * x
+        if self.kind == "deflated":
+            # A·x − Σ_k λ_k (x·v_k) v_k  (deflated_multiply_operator.cu)
+            V, lam = self.aux
+            coef = lam * (V.T @ x)
+            return spmv(self.base, x) - V @ coef
+        if self.kind == "pagerank":
+            # α·Aᵀ_stoch·x + teleport  (pagerank_operator.cu): base is
+            # the pre-normalised column-stochastic pack; aux = (alpha,
+            # dangling mask)
+            alpha, dangle = self.aux
+            y = spmv(self.base, x)
+            leaked = jnp.sum(jnp.where(dangle, x, 0.0))
+            nr = jnp.asarray(self.n_rows, x.dtype)
+            return alpha * (y + leaked / nr) + \
+                (1.0 - alpha) * jnp.sum(x) / nr
+        raise ValueError(f"unknown operator kind {self.kind!r}")
+
+
+def _matrix_pack(A):
+    """DeviceMatrix of a Matrix/DeviceMatrix/operator argument."""
+    return A.device() if hasattr(A, "device") and callable(
+        getattr(A, "device")) else A
+
+
+def ShiftedOperator(A, sigma: float) -> ImplicitOperator:
+    """``(A − σI)`` without materialising the shift
+    (``shifted_operator.cu``) — the eigensolver spectral transforms
+    build on exactly this formula."""
+    Ad = _matrix_pack(A)
+    sig = jnp.asarray(sigma, Ad.dtype)
+    return ImplicitOperator(
+        base=Ad, diag=Ad.diag - sig, aux=sig,
+        n_rows=Ad.n_rows, n_cols=Ad.n_cols, kind="shifted")
+
+
+def DeflatedOperator(A, vectors, values) -> ImplicitOperator:
+    """``A·v − Σ λ_k (v·x_k) x_k`` for locked eigenpairs
+    (``deflated_multiply_operator.cu``)."""
+    Ad = _matrix_pack(A)
+    V = jnp.asarray(vectors, Ad.dtype)
+    if V.ndim == 1:
+        V = V[:, None]
+    lam = jnp.atleast_1d(jnp.asarray(values, Ad.dtype))
+    diag = Ad.diag - jnp.sum(lam[None, :] * V * V, axis=1)
+    return ImplicitOperator(
+        base=Ad, diag=diag, aux=(V, lam),
+        n_rows=Ad.n_rows, n_cols=Ad.n_cols, kind="deflated")
+
+
+def PageRankOperator(W, alpha: float = 0.85) -> ImplicitOperator:
+    """The damped PageRank iteration operator over a link matrix ``W``
+    (rows = source pages), matching ``pagerank_operator.cu``'s
+    normalise-then-damp apply."""
+    import numpy as np
+    import scipy.sparse as sp
+
+    from .core.matrix import Matrix, pack_device
+    Wc = sp.csr_matrix(W.host if isinstance(W, Matrix) else W)
+    out_deg = np.asarray(Wc.sum(axis=1)).ravel()
+    dangle = out_deg == 0
+    inv = np.where(dangle, 0.0, 1.0 / np.where(dangle, 1.0, out_deg))
+    # column-stochastic transpose pack: y = Wᵀ D⁻¹ x
+    S = sp.csr_matrix(Wc.T @ sp.diags(inv))
+    dtype = np.dtype(getattr(W, "device_dtype", None) or np.float32)
+    Sd = pack_device(S, 1, dtype)
+    return ImplicitOperator(
+        base=Sd, diag=Sd.diag * alpha,
+        aux=(jnp.asarray(alpha, dtype), jnp.asarray(dangle)),
+        n_rows=Sd.n_rows, n_cols=Sd.n_cols, kind="pagerank")
+
+
+class SolverOperator:
+    """A configured solver as a linear operator v ↦ solve(A, v)
+    (``solve_operator.cu`` / ``solver_operator.cu``) — host-driven
+    composition; each apply runs the inner solver's whole-solve jit."""
+
+    def __init__(self, solver):
+        self.solver = solver
+
+    def apply(self, v):
+        return self.solver.solve(v).x
+
+
+def as_operator(obj) -> Optional[ImplicitOperator]:
+    return obj if isinstance(obj, ImplicitOperator) else None

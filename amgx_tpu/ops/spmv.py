@@ -30,6 +30,10 @@ def spmv(A, x: jax.Array) -> jax.Array:
         # Galerkin composition R·(A·(P·x)) — three DIA streams instead
         # of one low-fill embedded matrix (core.matrix.ComposedDIA)
         return spmv(A.R, spmv(A.A, spmv(A.P, x)))
+    if A.fmt == "op":
+        # implicit operator (operators.ImplicitOperator — the
+        # operator.h:37-80 Operator::apply analog)
+        return A.apply(x)
     if A.fmt == "dia":
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
