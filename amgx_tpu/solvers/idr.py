@@ -102,5 +102,57 @@ class IDRSolver(_PrecondMixin, Solver):
 
 @register_solver("IDRMSYNC")
 class IDRMSyncSolver(IDRSolver):
-    """Minimal-synchronisation IDR(s) (``idrmsync_solver.cu``) — same
-    algorithm; all reductions already fuse into one XLA computation."""
+    """Minimal-synchronisation IDR(s) (``idrmsync_solver.cu``,
+    Collignon & van Gijzen's restructuring).
+
+    The plain IDR(s) inner loop re-projects against the shadow space
+    after EVERY Gram-Schmidt elimination (``pg = P @ g_new`` inside the
+    j-loop) — O(s²) global reductions per cycle.  The m-sync variant
+    performs ONE shadow projection per direction and maintains every
+    other quantity algebraically:
+
+    * the elimination coefficients come from one triangular solve
+      against the already-known strictly-lower block of M (in exact
+      arithmetic identical to the sequential eliminations);
+    * the projected residual ``f`` and the projection ``pg`` update by
+      the same triangular algebra instead of fresh P·r / P·g products.
+
+    s+2 reductions per cycle instead of O(s²) — on a distributed mesh
+    each avoided reduction is an avoided ``psum`` collective (on one
+    chip XLA fuses either way; the count matters at scale)."""
+
+    def solve_iteration(self, b, x, state, iter_idx):
+        import jax.scipy.linalg as jsl
+        s = self.s
+        r, G, U, M, om = state
+        f = self.P @ r                        # sync 1 of the cycle
+        for k in range(s):
+            c = jnp.linalg.solve(
+                M + jnp.eye(s, dtype=M.dtype) * 1e-30, f)
+            v = r - (c[:, None] * G).sum(0)
+            v = self._apply_M(v)
+            u_new = om * v + (c[:, None] * U).sum(0)
+            g_new = spmv(self.Ad, u_new)
+            pg = self.P @ g_new               # the ONE projection
+            if k:
+                Mk = M[:k, :k] + jnp.eye(k, dtype=M.dtype) * 1e-30
+                alpha = jsl.solve_triangular(Mk, pg[:k], lower=True)
+                g_new = g_new - alpha @ G[:k]
+                u_new = u_new - alpha @ U[:k]
+                # P·g updates algebraically: P(g − Σ αⱼ Gⱼ) = pg − M·α
+                pg = pg - M[:, :k] @ alpha
+            G = G.at[k].set(g_new)
+            U = U.at[k].set(u_new)
+            M = M.at[:, k].set(pg)
+            beta = f[k] / jnp.where(pg[k] == 0, 1.0, pg[k])
+            r = r - beta * g_new
+            x = x + beta * u_new
+            f = f - beta * pg                 # algebraic, no sync
+        v = self._apply_M(r)
+        t = spmv(self.Ad, v)
+        tt = blas.dot(t, t)
+        om = jnp.where(tt != 0, blas.dot(t, r) /
+                       jnp.where(tt == 0, 1.0, tt), 0.0)
+        x = x + om * v
+        r = r - om * t
+        return x, _IDRState(r=r, G=G, U=U, M=M, om=om)
