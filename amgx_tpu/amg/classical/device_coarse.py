@@ -211,7 +211,7 @@ def _cf_stats_fn(nb: int, K: int):
 @functools.lru_cache(maxsize=128)
 def _interp_fn(nb: int, K: int, Kc: int, Kfs: int, Kp: int,
                dtype_str: str, interp_d2: bool, trunc_factor: float,
-               max_elements: int):
+               max_elements: int, n_chunks: int = 1):
     """jit: (cols, vals, S, cf) →
     (P_cols (nb, Kp) i32 coarse-local, P_vals, cnum (nb,) i32,
     kmax i32).
@@ -221,7 +221,13 @@ def _interp_fn(nb: int, K: int, Kc: int, Kfs: int, Kp: int,
     gathers of the compacted W rows, deduped with sort+scan (the
     is-C-column flag rides the scan as a summed lane), then
     D1-with-ALL-strength on Â — the exact host ``D2Interpolator``
-    composition."""
+    composition.
+
+    ``n_chunks``: the D2 expansion materialises (rows, K + Kfs·Kc)
+    blocks several times over (sort + take_alongs + scans) — at the
+    128³ level 1 that is ~8 GB at once.  The expansion half runs as a
+    ``lax.map`` over row chunks (W rows stay whole — they are the
+    gather target), bounding the transient footprint."""
     import jax
     import jax.numpy as jnp
 
@@ -313,42 +319,62 @@ def _interp_fn(nb: int, K: int, Kc: int, Kfs: int, Kp: int,
             wc, wv, _ = compact_by(cols, wrow, sc_mask, Kc)
             fc, fv, fl = compact_by(cols, vals, fs_mask, Kfs)
             fcc = jnp.where(fl, fc, 0)
-            # ROW gathers of the compacted W rows of each strong F
-            # neighbour — the fast gather shape
-            gw_c = wc[fcc]                       # (n, Kfs, Kc)
-            gw_v = wv[fcc]
-            path_c = jnp.where(fl[:, :, None], gw_c, -1)
-            path_v = jnp.where(fl[:, :, None] & (gw_c >= 0),
-                               fv[:, :, None] * gw_v, 0.0)
             # direct part of Â: A − A_Fs (diagonal kept; its column is
             # the own row, excluded from C candidates below)
             dir_keep = present & ~fs_mask
             dir_c = jnp.where(dir_keep, cols, -1)
             dir_v = jnp.where(dir_keep, vals, 0.0)
             dir_isc = jnp.where(dir_keep, cfc.astype(dt), 0.0)
-            path_isc = jnp.where(fl[:, :, None] & (gw_c >= 0) &
-                                 (gw_v != 0),
-                                 jnp.asarray(1.0, dt), 0.0)
             W2 = K + Kfs * Kc
-            ac = jnp.concatenate(
-                [dir_c, path_c.reshape(n, Kfs * Kc)], axis=1)
-            av = jnp.concatenate(
-                [dir_v, path_v.reshape(n, Kfs * Kc)], axis=1)
-            aisc = jnp.concatenate(
-                [dir_isc, path_isc.reshape(n, Kfs * Kc)], axis=1)
-            hc, (hv, hisc), hl = dedup_rows(ac, [av, aisc], W2)
-            hpresent = hl & (hv != 0)
-            hoff = hpresent & (hc != rown)
-            row_neg = jnp.sum(jnp.where(hoff & (hv < 0), hv, 0.0),
-                              axis=1)
-            row_pos = jnp.sum(jnp.where(hoff & (hv > 0), hv, 0.0),
-                              axis=1)
-            in_ci = hoff & (hisc > 0)
-            # Â diag == A diag (distribution paths land on C columns;
-            # weights only matter for F rows)
-            w = d1_on(hc, jnp.where(in_ci, hv, 0.0), in_ci, diag,
-                      row_neg, row_pos, cf)
-            pc, pv = truncate(jnp.where(in_ci, hc, -1), w)
+
+            def expand(args):
+                """Expansion + dedup + weights of one row chunk (W rows
+                whole in closure — they are the gather target)."""
+                (fcc_c, fv_c, fl_c, dc_c, dv_c, di_c, diag_c, cf_c,
+                 rows_g) = args
+                nc_rows = fcc_c.shape[0]
+                gw_c = wc[fcc_c]                 # (chunk, Kfs, Kc)
+                gw_v = wv[fcc_c]
+                path_c = jnp.where(fl_c[:, :, None], gw_c, -1)
+                path_v = jnp.where(fl_c[:, :, None] & (gw_c >= 0),
+                                   fv_c[:, :, None] * gw_v, 0.0)
+                path_isc = jnp.where(fl_c[:, :, None] & (gw_c >= 0) &
+                                     (gw_v != 0),
+                                     jnp.asarray(1.0, dt), 0.0)
+                ac = jnp.concatenate(
+                    [dc_c, path_c.reshape(nc_rows, Kfs * Kc)], axis=1)
+                av = jnp.concatenate(
+                    [dv_c, path_v.reshape(nc_rows, Kfs * Kc)], axis=1)
+                aisc = jnp.concatenate(
+                    [di_c, path_isc.reshape(nc_rows, Kfs * Kc)],
+                    axis=1)
+                hc, (hv, hisc), hl = dedup_rows(ac, [av, aisc], W2)
+                hpresent = hl & (hv != 0)
+                hoff = hpresent & (hc != rows_g[:, None])
+                row_neg = jnp.sum(jnp.where(hoff & (hv < 0), hv, 0.0),
+                                  axis=1)
+                row_pos = jnp.sum(jnp.where(hoff & (hv > 0), hv, 0.0),
+                                  axis=1)
+                in_ci = hoff & (hisc > 0)
+                # Â diag == A diag (distribution paths land on C
+                # columns; weights only matter for F rows)
+                w = d1_on(hc, jnp.where(in_ci, hv, 0.0), in_ci,
+                          diag_c, row_neg, row_pos, cf_c)
+                return truncate(jnp.where(in_ci, hc, -1), w)
+
+            rows_all = jnp.arange(n, dtype=jnp.int32)
+            chunk_args = (fcc, fv, fl, dir_c, dir_v, dir_isc, diag,
+                          cf, rows_all)
+            if n_chunks > 1:
+                ck = n // n_chunks
+                chunked = tuple(
+                    a.reshape((n_chunks, ck) + a.shape[1:])
+                    for a in chunk_args)
+                pc, pv = jax.lax.map(expand, chunked)
+                pc = pc.reshape((n,) + pc.shape[2:])
+                pv = pv.reshape((n,) + pv.shape[2:])
+            else:
+                pc, pv = expand(chunk_args)
         live = pv != 0
         pcc = jnp.where(live, cnum[jnp.maximum(pc, 0)], -1)
         kmax = jnp.max(jnp.sum(live.astype(jnp.int32), axis=1))
@@ -502,9 +528,17 @@ def coarsen_compact(cols, vals, n_logical: int, *, theta: float,
     Kc = width_bucket(max(k_c, 1))
     Kfs = width_bucket(max(k_fs, 1))
     Kp = max_elements if max_elements > 0 else K
+    # chunk the D2 expansion so its transient block stays ≲1 GB
+    # (several copies live through sort+scan+take_along)
+    n_chunks = 1
+    if interp_d2:
+        foot = nb * (K + Kfs * Kc) * dt.itemsize
+        while foot // n_chunks > (1 << 30) and n_chunks < 16 and \
+                nb % (2 * n_chunks) == 0:
+            n_chunks *= 2
     interp = _interp_fn(nb, K, Kc, Kfs, int(Kp), dt.str,
                         bool(interp_d2), float(trunc_factor),
-                        int(max_elements))
+                        int(max_elements), n_chunks)
     pc, pv, cnum, _pk = interp(cols, vals, S, cf)
 
     # P with the identity column of C rows folded in — the RAP operand

@@ -754,6 +754,10 @@ class AMGHierarchy:
                 P=P0, A=curd, R=R0, diag=res.diag, l1row=res.l1row,
                 n_rows=n, n_cols=n))
             A1m._dinv_dev = (np.dtype(A1m.device_dtype), res.dinv)
+            # the materialised embedded block (~1.7 GB at 128³) has
+            # served its purpose (diag/l1/compaction): free it before
+            # the compact levels allocate their expansion blocks
+            res.A_vals = None
         A1m.logical_rows = res.nc
         A1m._nnz_hint = nnz1
         self.levels.append(lvl0)

@@ -276,3 +276,38 @@ def test_device_winpack_matches_host_pack():
         decode(dm.win_blocks, dm.win_codes, tile_h)[m], ct[m])
     assert np.array_equal(np.asarray(dm.win_vals).ravel(),
                           np.asarray(win_vals_pack(vals, tile_h)).ravel())
+
+
+def test_interp_chunking_invariant():
+    """The chunked D2 expansion (HBM bound at the 128³ level 1) must
+    produce exactly the un-chunked interpolation."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.amg.classical.device_coarse import (_interp_fn,
+                                                      _strength_pmis_fn)
+    from amgx_tpu.amg.classical.device_fine import pmis_multiplier
+    from amgx_tpu.amg.classical.device_pipeline import \
+        coarsen_fine_embedded
+    nx = 10
+    A = sp.csr_matrix(poisson7pt(nx, nx, nx)).astype(np.float64)
+    n = A.shape[0]
+    offs, vals = dia_arrays(A, max_diags=16)
+    res = coarsen_fine_embedded(
+        offs, jnp.asarray(vals), n, theta=THETA, max_row_sum=0.9,
+        strength_all=False, interp_d2=True, trunc_factor=0.0,
+        max_elements=4, seed=7, compact_step=256)
+    nb, K = res.cols.shape
+    sp_fn = _strength_pmis_fn(nb, K, "<f8", THETA, 0.9, False, 7)
+    cf, S, stats = sp_fn(res.cols, res.vals, jnp.int32(res.nc),
+                         jnp.int64(pmis_multiplier(res.nc)))
+    import jax
+    _, k_c, k_fs = (int(x) for x in jax.device_get(stats))
+    from amgx_tpu.amg.classical.device_pipeline import width_bucket
+    Kc, Kfs = width_bucket(k_c), width_bucket(k_fs)
+    outs = []
+    for chunks in (1, 2):
+        fn = _interp_fn(nb, K, Kc, Kfs, 4, "<f8", True, 0.0, 4,
+                        chunks)
+        outs.append(fn(res.cols, res.vals, S, cf))
+    for a, b in zip(outs[0][:2], outs[1][:2]):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
