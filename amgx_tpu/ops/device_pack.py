@@ -121,7 +121,11 @@ def device_ell_matrix(cols, vals, n_rows: int, n_cols: int,
             jnp.dtype(vals.dtype) == jnp.float32:
         sblk, new, maxb = _win_stats_fn(nb, K, tile)(cols)
         B = -(-int(jax.device_get(maxb)) // 8) * 8
-        if B <= _MAX_BLOCKS and \
+        # the kernel is generic in B; the VMEM guard is the real
+        # feasibility gate (the host pack's B ≤ 64 heuristic would
+        # push a 90k×72 classical level-2 onto the ~0.1 G lookup/s
+        # gather path — catastrophic in the solve)
+        if B <= 2 * _MAX_BLOCKS and \
                 tile * K * (272 + 4 * B) <= (12 << 20):
             blocks, codes, wv = _win_build_fn(nb, K, tile, B)(
                 cols, vals, sblk, new)
