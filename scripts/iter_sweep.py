@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Iteration-growth study for the classical bench config (CPU host
+path; hierarchies identical to TPU)."""
+import os
+import sys
+import time
+
+os.environ["AMGX_NO_DEVICE_PIPELINE"] = "1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+
+BASE = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+    "amg:interpolator=D2, amg:max_iters=1, "
+    "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+    "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+    "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+    "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER, "
+    "determinism_flag=1")
+
+variants = {
+    "base": "",
+    "trunc0.2": ", amg:interp_truncation_factor=0.2",
+    "maxel0": ", amg:interp_max_elements=0",
+    "theta0.5": ", amg:strength_threshold=0.5",
+    "relax0.8": ", sm:relaxation_factor=0.8",
+}
+sizes = [24, 32, 40]
+sel = sys.argv[1:] if len(sys.argv) > 1 else list(variants)
+
+for name in sel:
+    extra = variants[name]
+    row = []
+    for nx in sizes:
+        A = poisson7pt(nx, nx, nx)
+        m = amgx.Matrix(A)
+        slv = amgx.create_solver(amgx.AMGConfig(BASE + extra))
+        t0 = time.perf_counter()
+        slv.setup(m)
+        res = slv.solve(np.ones(A.shape[0]))
+        hier = slv.preconditioner.hierarchy
+        opc = sum(l.A.nnz for l in hier.levels) + hier.coarsest.nnz
+        row.append((nx, int(res.iterations), int(res.status),
+                    round(opc / hier.levels[0].A.nnz, 2),
+                    len(hier.levels) + 1))
+    print(name, row, flush=True)

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""TPU iteration sweep for the classical config at 64/128 (task 3)."""
+import sys
+import time
+
+import numpy as np
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+
+BASE = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+    "amg:interpolator=D2, amg:max_iters=1, "
+    "amg:interp_max_elements=4, amg:max_row_sum=0.9, "
+    "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
+    "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+    "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER")
+
+variants = {
+    "base": "",
+    "trunc0.2": ", amg:interp_truncation_factor=0.2",
+    "fcycle": ", amg:cycle=F",
+    "pre1post1x2sm": (", amg:presweeps=1, amg:postsweeps=1, "
+                      "sm:relaxation_factor=0.9"),
+    "maxel6": ", amg:interp_max_elements=6",
+}
+
+sizes = [int(s) for s in (sys.argv[1] or "64").split(",")] \
+    if len(sys.argv) > 1 else [64, 128]
+names = sys.argv[2].split(",") if len(sys.argv) > 2 else list(variants)
+
+for name in names:
+    for nx in sizes:
+        A = poisson7pt(nx, nx, nx)
+        m = amgx.Matrix(A)
+        m.device_dtype = np.float32
+        slv = amgx.create_solver(amgx.AMGConfig(BASE + variants[name]))
+        t0 = time.perf_counter()
+        slv.setup(m)
+        t_setup = time.perf_counter() - t0
+        import jax.numpy as jnp
+        b = jnp.ones(A.shape[0], jnp.float32)
+        res = slv.solve(b)          # warm
+        t0 = time.perf_counter()
+        res = slv.solve(b)
+        t_solve = time.perf_counter() - t0
+        x = np.asarray(res.x, np.float64)
+        bb = np.ones(A.shape[0])
+        rr = float(np.linalg.norm(bb - A @ x) / np.linalg.norm(bb))
+        print(f"{name} {nx}^3: iters={int(res.iterations)} "
+              f"status={int(res.status)} setup={t_setup:.2f}s "
+              f"solve={t_solve:.2f}s relres={rr:.2e}", flush=True)
