@@ -105,7 +105,11 @@ def _pack_value_maps(Ac: sp.csr_matrix, dtype):
         diag_map[:] = dd
         return meta, {"vals": dmap, "diag": diag_map}
     maps = {}
-    for name in ("vals", "win_vals", "diag", "sh_vals"):
+    # bn_vals (binned sliced-ELL planes, ops/pallas_csr.py) maps like
+    # the others: the chunk layout is PATTERN-only (explicit zeros keep
+    # their lanes), so probe and template structures agree by
+    # construction and only the value plane needs refreshing
+    for name in ("vals", "win_vals", "diag", "sh_vals", "bn_vals"):
         if arrays.get(name) is not None:
             maps[name] = np.rint(np.asarray(arrays[name],
                                             dtype=np.float64)
@@ -289,7 +293,8 @@ def assemble_refreshed_matrix(plan: LevelPlan, vAc, fields, dtype):
     from ...core.matrix import Matrix
     tmpl = plan.template
     repl = {name: fields[name].astype(tmpl.diag.dtype)
-            for name in ("vals", "win_vals", "diag", "sh_vals")
+            for name in ("vals", "win_vals", "diag", "sh_vals",
+                         "bn_vals")
             if name in fields and getattr(tmpl, name) is not None}
     pack = dataclasses.replace(tmpl, **repl)
     m = Matrix()

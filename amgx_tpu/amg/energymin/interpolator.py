@@ -46,6 +46,15 @@ _MC = 16
 @register_interpolator("EM")
 class EnergyMinInterpolator(_InterpolatorBase):
 
+    #: F rows per batch of dense local solves.  The match tensors are
+    #: (chunk, mF, K, mF+mC) — at 10⁶ F rows unchunked they cost
+    #: several GB; a fixed chunk bounds them to tens of MB while the
+    #: per-row solves are unchanged (each row's system is independent,
+    #: so the result is chunking-invariant).  None = adaptive from
+    #: ``_CHUNK_BUDGET`` elements.
+    f_chunk = None
+    _CHUNK_BUDGET = 1 << 26
+
     def compute(self, A, S, cf_map):
         A = sp.csr_matrix(A)
         if A.dtype != np.float64:
@@ -88,6 +97,38 @@ class EnergyMinInterpolator(_InterpolatorBase):
             idx = np.argsort(-score, axis=1, kind="stable")[:, :m]
             ok = np.take_along_axis(score, idx, axis=1) > 0
             return idx, ok
+
+        # F rows process in fixed-size CHUNKS: every tensor below is
+        # per-F-row independent, so chunking only bounds the (chunk,
+        # mF, K, mF+mC) match-tensor footprint — results are identical
+        # for any chunk size (tests assert the invariance)
+        chunk = self.f_chunk or max(
+            256, int(self._CHUNK_BUDGET
+                     // max(_MF * max(K, 1) * (_MF + _MC), 1)))
+        Pi_parts, Pj_parts, Pv_parts = [], [], []
+        for lo in range(0, nF, chunk):
+            f_c = f_rows[lo:lo + chunk]
+            Pi_c, Pj_c, Pv_c = self._f_rows_weights(
+                f_c, ecols, evals, estrong, ecolC, topk, cnum)
+            Pi_parts.append(Pi_c)
+            Pj_parts.append(Pj_c)
+            Pv_parts.append(Pv_c)
+        c_rows = np.flatnonzero(cf > 0)
+        Pi = np.concatenate(Pi_parts + [c_rows])
+        Pj = np.concatenate(Pj_parts + [cnum[c_rows]])
+        Pv = np.concatenate(Pv_parts + [np.ones(len(c_rows))])
+        P = sp.csr_matrix((Pv, (Pi, Pj)), shape=(n, nc))
+        P.sum_duplicates()
+        return truncate_and_scale(P, self.trunc_factor,
+                                  self.max_elements)
+
+    @staticmethod
+    def _f_rows_weights(f_rows, ecols, evals, estrong, ecolC,
+                        topk, cnum):
+        """Energy-minimal weights of ONE chunk of F rows — the dense
+        local solves of the original unchunked path, verbatim, over a
+        row slice.  Returns the chunk's (Pi, Pj, Pv) triplets."""
+        nF = len(f_rows)
 
         # local F set: the row + its strongest strong-F couplings
         fmask = estrong[f_rows] & ~ecolC[f_rows]
@@ -161,11 +202,4 @@ class EnergyMinInterpolator(_InterpolatorBase):
         Pj = cnum[np.maximum(Cset, 0)].reshape(-1)
         Pv = w.reshape(-1)
         livee = (Cset >= 0).reshape(-1) & (Pv != 0)
-        c_rows = np.flatnonzero(cf > 0)
-        Pi = np.concatenate([Pi[livee], c_rows])
-        Pj = np.concatenate([Pj[livee], cnum[c_rows]])
-        Pv = np.concatenate([Pv[livee], np.ones(len(c_rows))])
-        P = sp.csr_matrix((Pv, (Pi, Pj)), shape=(n, nc))
-        P.sum_duplicates()
-        return truncate_and_scale(P, self.trunc_factor,
-                                  self.max_elements)
+        return Pi[livee], Pj[livee], Pv[livee]

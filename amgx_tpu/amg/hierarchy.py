@@ -46,6 +46,25 @@ from .structured import (coarse_dims, decompose_offsets, infer_grid_dims,
 _PAIRWISE_FALLBACK = object()
 
 
+def _tiebreak_seed(cfg: AMGConfig) -> int:
+    """THE PMIS/coarsening tie-break seed — one definition for BOTH the
+    device classical pipeline and the host/fallback classical paths, so
+    pipeline-on/off A/B runs select the SAME coarse grids and differ
+    only in representation.
+
+    Always the deterministic value 7, whatever ``determinism_flag``
+    says: several compiled programs are keyed on the REALIZED coarse
+    offset sets, which follow the PMIS outcome — a fixed seed makes
+    them identical run to run, so the persistent compile cache always
+    hits.  (determinism_flag=0 promises nothing about ordering; a
+    deterministic select is a valid instance of it, the same reasoning
+    as utils.determinism.SESSION_SEED.)  ``cfg`` is taken on purpose:
+    the signature documents that the flag deliberately does NOT alter
+    the value."""
+    del cfg
+    return 7
+
+
 def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     """A hierarchy child matrix inheriting the parent's device dtype
     (mixed precision flows down the whole hierarchy)."""
@@ -711,13 +730,9 @@ class AMGHierarchy:
         from ..ops.device_pack import device_ell_matrix
         from .classical.device_coarse import coarsen_compact
         from .classical.device_pipeline import coarsen_fine_embedded
-        # ALWAYS the deterministic tie-break seed: several compiled
-        # programs are keyed on the REALIZED coarse offset sets, which
-        # follow the PMIS outcome — a fixed seed makes them identical
-        # run to run, so the persistent compile cache always hits.
-        # (determinism_flag=0 promises nothing about ordering; a
-        # deterministic select is a valid instance of it.)
-        seed = 7
+        # shared tie-break seed (_tiebreak_seed documents the
+        # compile-cache rationale; the fallback paths read the same one)
+        seed = _tiebreak_seed(self.cfg)
         n = cur.n_block_rows
         dvals = curd.vals if keep is None else curd.vals[keep]
         with cpu_profiler("classical_device_fine_embedded"):
@@ -870,9 +885,9 @@ class AMGHierarchy:
         if interp_name == "D2" and len(ahat_plan(offs)[0]) > 48:
             return None
         dvals = curd.vals if keep is None else curd.vals[keep]
-        from ..utils.determinism import SESSION_SEED
-        seed = 7 if bool(self.cfg.get("determinism_flag")) \
-            else SESSION_SEED
+        # same seed as the device pipeline (_tiebreak_seed): pipeline
+        # on/off A/B runs must differ only in representation
+        seed = _tiebreak_seed(self.cfg)
         g = lambda p: self.cfg.get(p, self.scope)
         with cpu_profiler("classical_fine_device"):
             cf_map, P_host = classical_fine_device(
@@ -1040,7 +1055,6 @@ class AMGHierarchy:
             return None
         from ..distributed.matrix import shard_matrix_from_blocks
         from ..distributed.partition import build_partition_from_blocks
-        from ..utils.determinism import SESSION_SEED
         from .classical.distributed import (RankExtended,
                                             coarse_numbering_distributed,
                                             interpolate_distributed,
@@ -1055,8 +1069,9 @@ class AMGHierarchy:
         blocks = self._rank_blocks(cur, offsets)
         part = build_partition_from_blocks(blocks, offsets, n_rings=2)
         exts = [RankExtended(p, blocks, part) for p in range(n_parts)]
-        seed = 7 if bool(self.cfg.get("determinism_flag")) \
-            else SESSION_SEED
+        # same seed as the device pipeline (_tiebreak_seed): pipeline
+        # on/off A/B runs must differ only in representation
+        seed = _tiebreak_seed(self.cfg)
         S_U = strength_distributed(exts, [strength] * n_parts)
         cf_loc, ex = pmis_distributed(exts, S_U, n, seed)
         nc = int(sum(int(c.sum()) for c in cf_loc))

@@ -87,6 +87,23 @@ class _ColoringBase:
         raise NotImplementedError
 
 
+def _exact_color_leftovers(indptr, indices, colors: np.ndarray) -> None:
+    """Sequential exact first-fit for the nodes the vectorized 63-bit
+    used-color masks could not place: on graphs needing more than 63
+    colors the mask saturates (``free == 0``), and lumping the leftovers
+    into one shared color would be an IMPROPER coloring.  Per node the
+    smallest color absent from its neighbourhood is exact for any color
+    count; the leftover set is tiny (the saturated tail), so the python
+    loop is negligible."""
+    for v in np.flatnonzero(colors < 0):
+        nb = colors[indices[indptr[v]:indptr[v + 1]]]
+        used = set(int(c) for c in nb[nb >= 0])
+        c = 0
+        while c in used:
+            c += 1
+        colors[v] = c
+
+
 def _jones_plassmann(G: sp.csr_matrix, seed: int, max_hash_rounds: int = 64
                      ) -> MatrixColoring:
     """Jones-Plassmann with hashed weights: a node takes the smallest color
@@ -99,8 +116,9 @@ def _jones_plassmann(G: sp.csr_matrix, seed: int, max_hash_rounds: int = 64
     h = ((np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
           np.uint64(seed)) % np.uint64(1 << 30)).astype(np.int64)
     colors = np.full(n, -1, dtype=np.int64)
+    deferred = np.zeros(n, dtype=bool)
     for _ in range(max_hash_rounds):
-        un = colors < 0
+        un = (colors < 0) & ~deferred
         if not un.any():
             break
         both = un[rows] & un[indices]
@@ -120,11 +138,18 @@ def _jones_plassmann(G: sp.csr_matrix, seed: int, max_hash_rounds: int = 64
         np.bitwise_or.at(bits, rows[e],
                          np.int64(1) << np.minimum(colors[indices[e]], 62))
         free = (~bits) & ~(~np.int64(0) << 63)
-        # index of lowest set bit of `free`
+        # index of lowest set bit of `free`; a SATURATED mask (free==0,
+        # >63 neighbour colors) must not color via log2(0) — DEFER the
+        # node to the exact pass and drop it from the competition, or a
+        # saturated hub that keeps the max hash would stall its whole
+        # uncolored neighbourhood until the round cap (guard analog of
+        # _recolor_compact's lowbit>0 check)
         lowbit = free & -free
-        colors[winners] = np.round(np.log2(lowbit[winners].astype(
+        ok = winners & (lowbit > 0)
+        colors[ok] = np.round(np.log2(lowbit[ok].astype(
             np.float64))).astype(np.int64)
-    colors[colors < 0] = colors.max() + 1 if (colors >= 0).any() else 0
+        deferred |= winners & (lowbit == 0)
+    _exact_color_leftovers(indptr, indices, colors)
     return MatrixColoring(colors=colors.astype(np.int32),
                           num_colors=int(colors.max()) + 1)
 
@@ -179,10 +204,11 @@ def _priority_greedy_color(G: sp.csr_matrix, prio: np.ndarray,
             np.uint64(max(n, 1))).astype(np.int64)
     p = prio.astype(np.int64) * np.int64(n) + perm
     colors = np.full(n, -1, dtype=np.int64)
+    deferred = np.zeros(n, dtype=bool)
     h = ((np.arange(n, dtype=np.uint64) * np.uint64(2654435761) +
           np.uint64(seed)) % np.uint64(1 << 30)).astype(np.int64)
     for rnd in range(2 * max_rounds):
-        un = colors < 0
+        un = (colors < 0) & ~deferred
         if not un.any():
             break
         if rnd == max_rounds:
@@ -200,10 +226,19 @@ def _priority_greedy_color(G: sp.csr_matrix, prio: np.ndarray,
                          np.int64(1) << np.minimum(colors[indices[e]],
                                                    62))
         free = (~bits) & ~(~np.int64(0) << 63)
+        # saturated 63-bit masks (>63-color graphs, e.g. large cliques)
+        # yield free==0: log2(0) would leave those nodes "uncolorable"
+        # and the old leftover-lumping gave them ONE shared color — a
+        # silently improper coloring.  Guard like _recolor_compact,
+        # DEFER the saturated winners out of the competition (a
+        # saturated high-priority hub must not stall its neighbourhood
+        # until the round cap), and place them in the exact pass.
         lowbit = free & -free
-        colors[winners] = np.round(np.log2(lowbit[winners].astype(
+        ok = winners & (lowbit > 0)
+        colors[ok] = np.round(np.log2(lowbit[ok].astype(
             np.float64))).astype(np.int64)
-    colors[colors < 0] = colors.max() + 1 if (colors >= 0).any() else 0
+        deferred |= winners & (lowbit == 0)
+    _exact_color_leftovers(indptr, indices, colors)
     return MatrixColoring(colors=colors.astype(np.int32),
                           num_colors=int(colors.max()) + 1)
 

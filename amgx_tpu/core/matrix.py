@@ -36,9 +36,10 @@ from ..errors import BadParametersError
 @functools.partial(
     jax.tree_util.register_dataclass,
     data_fields=["cols", "vals", "diag", "row_ids", "win_blocks",
-                 "win_codes", "win_vals", "sh_vals", "sh_meta"],
+                 "win_codes", "win_vals", "sh_vals", "sh_meta",
+                 "bn_codes", "bn_vals", "bn_meta", "bn_pos"],
     meta_fields=["n_rows", "n_cols", "block_dim", "fmt", "ell_width",
-                 "dia_offsets", "win_tile", "sh_dims"],
+                 "dia_offsets", "win_tile", "sh_dims", "bn_dims"],
 )
 @dataclasses.dataclass(frozen=True)
 class DeviceMatrix:
@@ -78,6 +79,16 @@ class DeviceMatrix:
     sh_vals: Optional[jax.Array] = None
     sh_meta: Optional[jax.Array] = None
     sh_dims: tuple = ()
+    #: binned sliced-ELL metadata (ops/pallas_csr.py): chunk planes of
+    #: segment-local codes/values + the scalar-prefetch chunk map and
+    #: the bin row permutation; None when the pack's padding exceeded
+    #: the kernel's efficiency budget.  Block matrices carry the pack of
+    #: their SCALAR expansion (bn_dims holds scalar shapes).
+    bn_codes: Optional[jax.Array] = None
+    bn_vals: Optional[jax.Array] = None
+    bn_meta: Optional[jax.Array] = None
+    bn_pos: Optional[jax.Array] = None
+    bn_dims: tuple = ()
 
     @property
     def n(self) -> int:
@@ -99,7 +110,9 @@ class DeviceMatrix:
             win_vals=(None if self.win_vals is None
                       else self.win_vals.astype(dtype)),
             sh_vals=(None if self.sh_vals is None
-                     else self.sh_vals.astype(dtype)))
+                     else self.sh_vals.astype(dtype)),
+            bn_vals=(None if self.bn_vals is None
+                     else self.bn_vals.astype(dtype)))
 
     def ell_vals_view(self):
         """Row-major (n, K) ELL values — direct, or reconstructed from
@@ -187,6 +200,27 @@ class ComposedDIA:
     @property
     def dtype(self):
         return self.diag.dtype
+
+
+def pack_kind(Ad) -> str:
+    """Human-readable pack/kernel selection of a device matrix — the
+    SpMV dispatch order made visible (bench prints it per case so a
+    dispatch regression shows up in BENCH logs, not just as a slower
+    number)."""
+    fmt = getattr(Ad, "fmt", "?")
+    if fmt == "ell":
+        if getattr(Ad, "sh_vals", None) is not None:
+            return "ell/shift"
+        if getattr(Ad, "win_codes", None) is not None:
+            return "ell/window"
+        if getattr(Ad, "bn_codes", None) is not None:
+            return "ell/binned"
+        return "ell/gather"
+    if fmt == "csr":
+        if getattr(Ad, "bn_codes", None) is not None:
+            return "csr/binned"
+        return "csr/segsum"
+    return fmt
 
 
 def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
@@ -702,6 +736,48 @@ class Matrix:
 _DENSE_MAX = 3072
 
 
+def _try_binned(indptr, indices, data, n_cols: int, dtype, arrays,
+                meta) -> bool:
+    """Attach the binned sliced-ELL arrays (ops/pallas_csr.py) to a
+    pack when the kernel can run on this backend and the plan fits its
+    padding budget.  Returns True when attached."""
+    import jax as _jax
+
+    from ..ops import pallas_csr
+    if not (_jax.default_backend() == "tpu" or pallas_csr._INTERPRET):
+        return False
+    np_dtype = np.dtype(dtype)
+    if not np.issubdtype(np_dtype, np.floating):
+        return False
+    if np_dtype != np.float32 and not pallas_csr._INTERPRET:
+        return False          # f64 rides the kernel only when interpreted
+    out = pallas_csr.csr_binned_pack(
+        indptr, indices, np.asarray(data).astype(dtype, copy=False),
+        n_cols, dtype)
+    if out is None:
+        return False
+    bn_arrays, dims = out
+    arrays.update(bn_arrays)
+    meta.update(bn_dims=dims)
+    return True
+
+
+def _try_binned_scalar_block(bsr: sp.bsr_matrix, dtype, arrays,
+                             meta) -> bool:
+    """Binned pack of a BLOCK matrix's scalar expansion: b×b systems
+    (BiCGStab+DILU class configs) then ride the same kernel — the
+    scalar CSR view is built only when the backend gate passes."""
+    import jax as _jax
+
+    from ..ops import pallas_csr
+    if not (_jax.default_backend() == "tpu" or pallas_csr._INTERPRET):
+        return False
+    scsr = sp.csr_matrix(bsr)
+    scsr.sort_indices()
+    return _try_binned(scsr.indptr, scsr.indices, scsr.data,
+                       scsr.shape[1], dtype, arrays, meta)
+
+
 def _dense_pack_enabled() -> bool:
     """Dense fallback only helps where gathers are catastrophic (TPU);
     the CPU backend's native gathers are fine.  AMGX_DENSE_PACK=1
@@ -821,6 +897,24 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
         if dense_ok and "sh_vals" not in arrays and \
                 "win_codes" not in arrays and _dense_pack_enabled():
             meta.update(fmt="dense")
+        elif "sh_vals" not in arrays and "win_codes" not in arrays:
+            # general-sparsity fast path: matrices past the shift and
+            # window gates (scattered uploads, ungated coarse levels)
+            # get the binned sliced-ELL planes instead of falling to
+            # the XLA gather (ops/pallas_csr.py)
+            if b == 1:
+                attached = _try_binned(indptr, indices, vals, n_cols,
+                                       dtype, arrays, meta)
+                if attached and lean_win:
+                    # lean binned pack: re-emit as a lean CSR pack —
+                    # the planes carry the matrix and the
+                    # binned_entries_view serves every fallback/view
+                    # consumer; shipping the (n, K) ELL cols/vals too
+                    # would double hierarchy upload bytes
+                    del arrays["cols"], arrays["vals"]
+                    meta.update(fmt="csr", ell_width=0)
+            else:
+                _try_binned_scalar_block(bsr, dtype, arrays, meta)
         return arrays, meta
     if dense_ok and _dense_pack_enabled():
         cols = np.zeros((n_rows, k), dtype=np.int32)
@@ -830,8 +924,20 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
         meta.update(fmt="dense", ell_width=k)
         return ({"cols": cols, "vals": ell_vals, "diag": diag}, meta)
     meta.update(fmt="csr", ell_width=0)
-    return ({"cols": indices.astype(np.int32), "vals": vals.astype(dtype),
-             "diag": diag, "row_ids": for_rows.astype(np.int32)}, meta)
+    arrays = {"cols": indices.astype(np.int32), "vals": vals.astype(dtype),
+              "diag": diag, "row_ids": for_rows.astype(np.int32)}
+    if b == 1:
+        attached = _try_binned(indptr, indices, vals, n_cols, dtype,
+                               arrays, meta)
+        if attached and lean_win:
+            # lean binned-CSR pack: the planes carry the values and
+            # (segment-local) columns; binned_entries_view reconstructs
+            # the gather-form triplets for fallback/abs_rowsum/densify
+            # consumers — shipping both would double hierarchy bytes
+            del arrays["cols"], arrays["vals"], arrays["row_ids"]
+    else:
+        _try_binned_scalar_block(bsr, dtype, arrays, meta)
+    return arrays, meta
 
 
 def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
@@ -869,7 +975,12 @@ def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
         win_tile=meta.get("win_tile", 0),
         sh_vals=arrays.get("sh_vals"),
         sh_meta=arrays.get("sh_meta"),
-        sh_dims=meta.get("sh_dims", ()))
+        sh_dims=meta.get("sh_dims", ()),
+        bn_codes=arrays.get("bn_codes"),
+        bn_vals=arrays.get("bn_vals"),
+        bn_meta=arrays.get("bn_meta"),
+        bn_pos=arrays.get("bn_pos"),
+        bn_dims=meta.get("bn_dims", ()))
 
 
 def pack_device(host: sp.spmatrix, block_dim: int, dtype,

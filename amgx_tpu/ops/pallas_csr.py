@@ -1,0 +1,394 @@
+"""Pallas TPU kernel: row-binned sliced-ELL SpMV for ARBITRARY sparsity.
+
+The structured kernels carry most AMG workloads, but each has a gate:
+the DIA kernel wants few distinct diagonals, the tile-DIA shift kernel a
+small per-tile diff-class count (pallas_shift.py), the windowed one-hot
+kernel ≤ 64 distinct 128-column blocks per row tile (pallas_ell.py).
+Everything else — uploaded MatrixMarket systems, web graphs, scattered
+coarse operators — used to fall onto XLA's TPU gather lowering, a
+scalar loop three orders of magnitude under the roofline.  This kernel
+has NO structural gate, only an efficiency budget:
+
+* pack time buckets rows into power-of-two nnz **bins** and permutes
+  rows so each tile of T = 128 rows holds near-uniform-degree rows (the
+  sliced-ELL / SELL-C-σ idea: padding per tile tracks the tile's max
+  degree, not the global max),
+* the **column space is tiled into segments** of ``_SB``·128 columns —
+  small enough that a segment of x always fits VMEM, with no constraint
+  on how many segments a row touches,
+* each (row-tile × column-segment) pair's entries are repacked into
+  fixed-width **chunk planes** (``_W`` slot-columns of T lanes, entry
+  codes = global column; per-row slots stay column-sorted), padded rows
+  ride as zero-value lanes,
+* the kernel grid is the flat chunk list: per chunk the pipeline stages
+  the segment's (``_SB``, 128) x block into VMEM (consecutive chunks on
+  one segment reuse it), the per-entry read is the gather-free **lane
+  one-hot MXU contraction** of pallas_ell.py against that window (the
+  bf16×3 split reproduces the f32 product exactly), a segment-local
+  block select keeps entries of other segments at zero, and the (1, T)
+  row partial sums ACCUMULATE in the VMEM-resident output block across
+  the tile's chunks (scalar-prefetched output indices keep a tile's
+  chunks on one resident block).
+
+Cost model: ~3·128·``_SB`` MXU MACs per padded lane — ~8× less pick
+redundancy than the windowed kernel's worst case, and the only quality
+knob is the PADDING factor (padded lanes / nnz), which the pack refuses
+above ``_PAD_CAP`` (the caller falls back to the segment-sum path).
+Uniform scatter pads by the tile-max of a small Poisson count (~3-6×);
+locally clustered matrices approach 1×.
+
+Reference analog: the any-sparsity CSR vector kernels of
+``base/src/multiply.cu:75-196`` / ``generic_spmv_csr.h`` — same
+contract, mapped to segment-streamed one-hot contractions instead of
+warp-per-row gathers.  f64 runs only under the interpreter (CPU test
+tier — Mosaic has no emulated f64); block matrices pack their SCALAR
+expansion, so b×b systems ride the same kernel.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_spmv import _INTERPRET
+
+#: rows per tile — the (1, T) output block's lane dim must be
+#: 128-divisible; 128 keeps the per-tile padding (max over T rows of the
+#: per-segment count) tight
+_T = 128
+#: x-segment size in 128-lane blocks (segment = _SB·128 columns): the
+#: per-lane pick cost is 3·128·_SB MXU MACs, so SMALL segments win —
+#: 8 blocks ≈ the knee where chunk-count overhead stops paying back
+_SB = 8
+#: slot-columns per chunk plane (plane = _W·_T lanes)
+_W = 8
+#: refuse the pack when padded lanes exceed this × nnz — beyond it the
+#: padded one-hot work approaches the plain gather's cost and the
+#: segment-sum fallback is the honest choice
+_PAD_CAP = 10.0
+#: never refuse tiny matrices on the ratio alone (fixed costs dominate)
+_PAD_FLOOR = 1 << 16
+
+
+def _bin_ids(deg: np.ndarray) -> np.ndarray:
+    """Power-of-two nnz bin per row (deg 0 and 1 share bin 0)."""
+    bid = np.zeros(len(deg), dtype=np.int64)
+    nz = deg > 1
+    bid[nz] = np.ceil(np.log2(deg[nz])).astype(np.int64)
+    return bid
+
+
+def _plan(indptr: np.ndarray, indices: np.ndarray, n_cols: int):
+    """The layout plan shared by the packer and the budget probe.
+
+    Returns None when the matrix is empty, or a tuple
+    (perm, identity, rows_p, ent, seg, n_seg, run arrays..., chunk
+    geometry) — everything short of materialising the planes.
+    """
+    n = len(indptr) - 1
+    nnz = len(indices)
+    if n == 0 or nnz == 0:
+        return None
+    deg = np.diff(indptr).astype(np.int64)
+    bid = _bin_ids(deg)
+    # stable sort groups rows by bin and keeps upload order (locality)
+    # inside each bin; already-sorted degree profiles keep identity —
+    # then the final y gather degenerates to a slice
+    identity = bool(np.all(bid[1:] >= bid[:-1]))
+    perm = np.arange(n, dtype=np.int64) if identity else \
+        np.argsort(bid, kind="stable")
+    deg_p = deg[perm]
+    indptr_p = np.concatenate([[0], np.cumsum(deg_p)])
+    rows_p = np.repeat(np.arange(n, dtype=np.int64), deg_p)
+    # entry source index: permuted-row-major, column-sorted within rows
+    ent = np.repeat(indptr[perm].astype(np.int64) - indptr_p[:-1],
+                    deg_p) + np.arange(nnz, dtype=np.int64)
+    S = _SB * 128
+    n_seg = max(1, -(-int(n_cols) // S))
+    seg = indices[ent].astype(np.int64) // S
+    tile = rows_p // _T
+    n_tiles = -(-n // _T)
+    # (row, segment) runs — entries are (row, col)-sorted, so each run
+    # is contiguous; its length is the row's entry count in that segment
+    start = np.ones(nnz, dtype=bool)
+    start[1:] = (rows_p[1:] != rows_p[:-1]) | (seg[1:] != seg[:-1])
+    run_first = np.flatnonzero(start)
+    run_id = np.cumsum(start) - 1
+    q = np.arange(nnz, dtype=np.int64) - run_first[run_id]
+    run_len = np.diff(np.append(run_first, nnz))
+    # group runs by (tile, segment): the chunk plane width for a group
+    # is the tile's MAX run length, rounded up to _W-slot chunks
+    gkey = tile[run_first] * n_seg + seg[run_first]
+    order = np.argsort(gkey, kind="stable")
+    gs = gkey[order]
+    gnew = np.ones(len(gs), dtype=bool)
+    gnew[1:] = gs[1:] != gs[:-1]
+    g_of_run = np.empty(len(gs), dtype=np.int64)
+    g_of_run[order] = np.cumsum(gnew) - 1
+    group_key = gs[gnew]
+    gmax = np.zeros(len(group_key), dtype=np.int64)
+    np.maximum.at(gmax, g_of_run, run_len)
+    chunks_per_group = -(-gmax // _W)
+    return (perm, identity, n, nnz, n_seg, n_tiles, run_id, q,
+            g_of_run, group_key, chunks_per_group, ent, seg)
+
+
+def binned_pad_factor(indptr, indices, n_cols: int) -> Optional[float]:
+    """Padded-lane factor (plane lanes / nnz) of the binned plan, or
+    None for an empty matrix.  The ``solvers.base`` reorder gate uses
+    this to skip the RCM permute when the binned kernel already carries
+    the matrix efficiently."""
+    plan = _plan(np.asarray(indptr), np.asarray(indices), n_cols)
+    if plan is None:
+        return None
+    nnz = plan[3]
+    n_real = int(plan[10].sum())
+    return n_real * (_W * _T) / max(nnz, 1)
+
+
+def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
+                    ) -> Optional[Tuple[dict, tuple]]:
+    """Host-side binned sliced-ELL pack of a SCALAR CSR matrix.
+
+    Returns ``(arrays, bn_dims)`` or None when the matrix is empty, its
+    padding exceeds the ``_PAD_CAP`` budget, or its columns overflow the
+    int32 code space.  ``arrays``:
+
+    * ``bn_codes`` (1, L) int32 — global column per lane (padding 0),
+    * ``bn_vals``  (1, L) dtype — values (padding 0),
+    * ``bn_meta``  (4·C,) int32 — per chunk: output tile, plane block,
+      segment, first-chunk-of-tile flag (scalar prefetch),
+    * ``bn_pos``   (n,) int32 — original row → padded position, or
+      absent when the bin permutation is the identity.
+
+    ``bn_dims`` (static): (C, n_tiles, n_seg, T, SB, W, identity, n,
+    n_cols).
+    """
+    indptr = np.asarray(indptr)
+    indices = np.asarray(indices)
+    data = np.asarray(data)
+    if int(n_cols) >= (1 << 31):
+        return None
+    plan = _plan(indptr, indices, n_cols)
+    if plan is None:
+        return None
+    (perm, identity, n, nnz, n_seg, n_tiles, run_id, q, g_of_run,
+     group_key, chunks_per_group, ent, seg) = plan
+    Wp = _W * _T
+    n_real = int(chunks_per_group.sum())
+    L = n_real * Wp
+    if L > max(_PAD_CAP * nnz, _PAD_FLOOR) or L >= (1 << 31):
+        return None
+    chunk_off = np.concatenate([[0], np.cumsum(chunks_per_group)[:-1]])
+    # entry placement: entry q of its (row, segment) run lands in chunk
+    # q // _W at slot q % _W, lane = slot·T + (row mod T) — column-major
+    # per chunk so the kernel's row reduction is _W static T-slices
+    g_e = g_of_run[run_id]
+    chunk_e = chunk_off[g_e] + q // _W
+    rows_p = np.repeat(np.arange(n, dtype=np.int64),
+                       np.diff(indptr)[perm])
+    lane = chunk_e * Wp + (q % _W) * _T + (rows_p % _T)
+    codes = np.zeros(L, dtype=np.int32)
+    vals = np.zeros(L, dtype=dtype)
+    codes[lane] = indices[ent].astype(np.int32)
+    vals[lane] = data[ent]
+    c_tile = np.repeat(group_key // n_seg, chunks_per_group)
+    c_seg = np.repeat(group_key % n_seg, chunks_per_group)
+    c_blk = np.arange(n_real, dtype=np.int64)
+    # tiles with no entries (all-padding rows, zero-degree bins) still
+    # need their output block INITIALISED — one dummy chunk on a shared
+    # all-zero plane block
+    have = np.zeros(n_tiles, dtype=bool)
+    have[c_tile] = True
+    miss = np.flatnonzero(~have)
+    if len(miss):
+        codes = np.concatenate([codes, np.zeros(Wp, dtype=np.int32)])
+        vals = np.concatenate([vals, np.zeros(Wp, dtype=dtype)])
+        c_tile = np.concatenate([c_tile, miss])
+        c_seg = np.concatenate([c_seg, np.zeros(len(miss), np.int64)])
+        c_blk = np.concatenate([c_blk,
+                                np.full(len(miss), n_real, np.int64)])
+        order2 = np.argsort(c_tile, kind="stable")
+        c_tile, c_seg, c_blk = c_tile[order2], c_seg[order2], \
+            c_blk[order2]
+    C = len(c_tile)
+    first = np.ones(C, dtype=np.int64)
+    first[1:] = c_tile[1:] != c_tile[:-1]
+    meta = np.concatenate([c_tile, c_blk, c_seg, first]).astype(np.int32)
+    arrays = {"bn_codes": codes.reshape(1, -1),
+              "bn_vals": vals.reshape(1, -1),
+              "bn_meta": meta}
+    if not identity:
+        pos = np.empty(n, dtype=np.int32)
+        pos[perm] = np.arange(n, dtype=np.int32)
+        arrays["bn_pos"] = pos
+    dims = (C, int(n_tiles), int(n_seg), _T, _SB, _W,
+            1 if identity else 0, int(n), int(n_cols))
+    return arrays, dims
+
+
+def binned_supported(Ad) -> bool:
+    """Dispatch gate: binned arrays present and the kernel can run here
+    (TPU for f32; the interpreter also carries f64 for the CPU parity
+    tier — Mosaic itself has no f64)."""
+    if getattr(Ad, "bn_codes", None) is None:
+        return False
+    if not (jax.default_backend() == "tpu" or _INTERPRET):
+        return False
+    return jnp.dtype(Ad.dtype) == jnp.float32 or _INTERPRET
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _binned_call(meta, codes, vals, x2, dims):
+    C, n_tiles, n_seg, T, Sb, w, _ident, _n, _m = dims
+    Wp = w * T
+    f32 = vals.dtype == jnp.float32
+
+    def kernel(m_ref, x_ref, codes_ref, vals_ref, y_ref):
+        c = pl.program_id(0)
+        codes_t = codes_ref[...]                       # (1, Wp) int32
+        lane = jnp.bitwise_and(codes_t, jnp.asarray(127, codes_t.dtype))
+        blk = jax.lax.shift_right_logical(
+            codes_t, jnp.asarray(7, codes_t.dtype))
+        # segment-local block id: entries of other segments (a chunk's
+        # slot window can straddle a boundary) fall outside [0, Sb) and
+        # select nothing — no separate mask needed
+        local = blk - m_ref[2 * C + c] * Sb
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (128, Wp), 0)
+        oh = lane == iota_l                            # (128, Wp)
+        xs = x_ref[...]                                # (Sb, 128)
+        dims_dg = (((1,), (0,)), ((), ()))
+        if f32:
+            # bf16×3 split of the window: 0/1 one-hot is exact in bf16,
+            # three default-precision MXU passes rebuild the f32 product
+            ohT = oh.astype(jnp.bfloat16)
+            h1 = xs.astype(jnp.bfloat16)
+            r1 = xs - h1.astype(jnp.float32)
+            h2 = r1.astype(jnp.bfloat16)
+            h3 = (r1 - h2.astype(jnp.float32)).astype(jnp.bfloat16)
+            pick = (jax.lax.dot_general(
+                        h1, ohT, dims_dg,
+                        preferred_element_type=jnp.float32)
+                    + jax.lax.dot_general(
+                        h2, ohT, dims_dg,
+                        preferred_element_type=jnp.float32)
+                    + jax.lax.dot_general(
+                        h3, ohT, dims_dg,
+                        preferred_element_type=jnp.float32))
+        else:
+            # interpreter-only dtypes (f64 parity tier): one exact pass
+            pick = jax.lax.dot_general(
+                xs, oh.astype(xs.dtype), dims_dg,
+                preferred_element_type=xs.dtype)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Sb, Wp), 0)
+        sel = jnp.sum(jnp.where(local == iota_b,
+                                pick.astype(vals_ref.dtype), 0),
+                      axis=0, keepdims=True)           # (1, Wp)
+        p = vals_ref[...] * sel
+        # column-major plane: the per-row reduction is w static T-slices
+        acc = p[:, 0:T]
+        for k in range(1, w):
+            acc = acc + p[:, k * T:(k + 1) * T]
+        first = m_ref[3 * C + c]
+
+        # the output block stays VMEM-resident across a tile's chunks
+        # (consecutive identical output indices): initialise on the
+        # tile's first chunk, accumulate after
+        @pl.when(first == 1)
+        def _init():
+            y_ref[...] = acc
+
+        @pl.when(first == 0)
+        def _accum():
+            y_ref[...] = y_ref[...] + acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(C,),
+        in_specs=[
+            # x segment: the pipeline stages (Sb, 128) of x2 per chunk
+            # and skips the copy when consecutive chunks share a segment
+            pl.BlockSpec((Sb, 128), lambda c, m: (m[2 * C + c],
+                                                  jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Wp), lambda c, m: (jnp.int32(0),
+                                                m[C + c]),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Wp), lambda c, m: (jnp.int32(0),
+                                                m[C + c]),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, T), lambda c, m: (jnp.int32(0),
+                                                     m[c]),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((1, n_tiles * T), vals.dtype),
+        grid_spec=grid_spec,
+        interpret=_INTERPRET,
+    )(meta, x2, codes, vals)
+
+
+def binned_spmv(Ad, x: jax.Array) -> jax.Array:
+    """y = A @ x via the binned sliced-ELL kernel.  ``x`` is the flat
+    scalar vector (block matrices packed their scalar expansion)."""
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    m_pad = n_seg * Sb * 128
+    x2 = jnp.pad(x, (0, m_pad - m_sc)).reshape(-1, 128)
+    y = _binned_call(Ad.bn_meta, Ad.bn_codes, Ad.bn_vals, x2,
+                     Ad.bn_dims).reshape(-1)
+    if ident:
+        return y[:n_sc]
+    # the bin permutation scatter: an n-element take — two orders of
+    # magnitude under the nnz-element gather this kernel replaces
+    return y[Ad.bn_pos]
+
+
+def _row_pad_of_lane(Ad):
+    """Padded row id per plane LANE.  Chunk order is tile-sorted and
+    dummy chunks share one zero block, so the per-chunk meta is mapped
+    back to plane blocks through the chunk→block column (the zero
+    block's attribution is irrelevant: its values are all 0)."""
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    Wp = w * T
+    L = Ad.bn_codes.size
+    tile_of_blk = jnp.zeros((L // Wp,), jnp.int32).at[
+        Ad.bn_meta[C:2 * C]].set(Ad.bn_meta[:C])
+    lane = jnp.arange(L, dtype=jnp.int32)
+    return tile_of_blk[lane // Wp] * T + (lane % Wp) % T
+
+
+def binned_entries_view(Ad):
+    """(rows, cols, vals) flat entry triplets reconstructed from the
+    planes — ORIGINAL scalar row ids; padding lanes carry value 0 on
+    row 0.  Serves the segment-sum fallback, ``abs_rowsum`` and host
+    densification on a lean pack (kernel layouts are the only arrays)."""
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    row_pad = _row_pad_of_lane(Ad)
+    if ident:
+        rows = jnp.where(row_pad < n_sc, row_pad, 0)
+    else:
+        inv = jnp.zeros((n_tiles * T,), jnp.int32).at[Ad.bn_pos].set(
+            jnp.arange(n_sc, dtype=jnp.int32))
+        rows = inv[row_pad]
+    live = Ad.bn_vals.reshape(-1) != 0
+    rows = jnp.where(live, rows, 0)
+    return rows, Ad.bn_codes.reshape(-1), Ad.bn_vals.reshape(-1)
+
+
+def binned_abs_rowsum(Ad) -> jax.Array:
+    """Σ_j |A[i, j]| per scalar row from the planes alone (padding
+    contributes 0) — L1-Jacobi / Gershgorin on a lean binned pack."""
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    row_pad = _row_pad_of_lane(Ad)
+    rs = jax.ops.segment_sum(jnp.abs(Ad.bn_vals.reshape(-1)), row_pad,
+                             num_segments=n_tiles * T)
+    if ident:
+        return rs[:n_sc]
+    return rs[Ad.bn_pos]

@@ -126,6 +126,20 @@ def _window_fits(csr) -> "Optional[bool]":
     return ell_window_pack(cols) is not None
 
 
+def _binned_fits(csr) -> bool:
+    """Does the binned sliced-ELL plan (ops/pallas_csr.py) carry this
+    matrix efficiently?  True when the plan's padded-lane factor is
+    small enough that the binned kernel's throughput class matches or
+    beats the windowed kernel an RCM permute could rescue — AUTO
+    reordering then skips the O(nnz log n) RCM pass (and the permuted
+    solve boundary) entirely.  The probe re-runs the pack's layout plan
+    (one extra O(nnz log nnz) host pass at setup); that is the price of
+    the decision, still well under the RCM+repack it avoids."""
+    from ..ops.pallas_csr import binned_pad_factor
+    pf = binned_pad_factor(csr.indptr, csr.indices, csr.shape[1])
+    return pf is not None and pf <= 2.0
+
+
 class Solver:
     """Base solver: common parameter handling + generic solve driver.
 
@@ -268,8 +282,13 @@ class Solver:
             dtype = np.dtype(A.device_dtype or A.dtype)
             if dtype != np.float32 or A.dia_cache(48) is not None:
                 return None
-            if _window_fits(A.scalar_csr()) is not False:
+            csr0 = A.scalar_csr()
+            if _window_fits(csr0) is not False:
                 return None     # already window-eligible (or too wide)
+            if _binned_fits(csr0):
+                # the binned sliced-ELL kernel already carries this
+                # matrix at windowed-kernel class or better — no RCM
+                return None
         from scipy.sparse.csgraph import reverse_cuthill_mckee
         csr = A.scalar_csr()
         perm = np.asarray(reverse_cuthill_mckee(csr,
@@ -641,6 +660,15 @@ class Solver:
             Ad64 = dataclasses.replace(
                 Ad64, vals=Ad64.ell_vals_view(), cols=Ad64.ell_cols_view(),
                 win_blocks=None, win_codes=None, win_vals=None)
+        if Ad64.bn_codes is not None and Ad64.vals is not None:
+            # the wide pack must dispatch on the CORRECTED gather-form
+            # vals: under the interpreter the binned kernel serves f64
+            # too and would read the UN-corrected bn_vals planes,
+            # silently dropping the _refine_lo residue the refinement
+            # residual exists for
+            Ad64 = dataclasses.replace(
+                Ad64, bn_codes=None, bn_vals=None, bn_meta=None,
+                bn_pos=None, bn_dims=())
         Ad64 = Ad64.astype(jnp.float64)
         if self._refine_lo is not None:
             Ad64 = dataclasses.replace(

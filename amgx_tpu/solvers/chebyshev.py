@@ -139,10 +139,27 @@ class ChebyshevSolver(_PrecondMixin, Solver):
             lmin_r, lmax = _lanczos_spectrum(
                 lambda v: self._apply_M(spmv(self.Ad, v)),
                 self.Ad.n, self.Ad.dtype)
-            # Ritz λmin approaches from above; keep it positive and
-            # below the smoothing band for safety
-            lmin = min(max(lmin_r, 1e-12), 0.5 * lmax) \
-                if lmax > 0 else 0.125 * lmax
+            if lmax <= 0:
+                # degenerate Lanczos estimate (indefinite/garbage Ritz
+                # values): the old fallback set lmin = 0.125·lmax >
+                # lmax — an INVERTED Chebyshev interval that turns the
+                # smoother into an amplifier.  Re-estimate on the
+                # power/Gershgorin path instead, and refuse outright if
+                # the spectrum top still comes out non-positive.
+                lmax = self._power_lmax() if not no_pre \
+                    else self._gershgorin_lmax()
+                if lmax <= 0:
+                    from ..errors import BadParametersError
+                    raise BadParametersError(
+                        "CHEBYSHEV: non-positive spectrum-top estimate "
+                        "(Lanczos and power/Gershgorin both ≤ 0) — the "
+                        "operator is not SPD-like; choose another "
+                        "smoother or supply cheby_max/min_lambda")
+                lmin = 0.125 * lmax
+            else:
+                # Ritz λmin approaches from above; keep it positive and
+                # below the smoothing band for safety
+                lmin = min(max(lmin_r, 1e-12), 0.5 * lmax)
         elif self.lambda_mode == 1 or \
                 (self.lambda_mode == 2 and not no_pre):
             lmax = self._power_lmax()

@@ -76,9 +76,19 @@ def _densify_device(Ad) -> np.ndarray:
         # view methods reconstruct the gather-form arrays on lean packs
         vals = np.asarray(Ad.ell_vals_view())
         cols = np.asarray(Ad.ell_cols_view())
+    elif Ad.fmt == "csr" and Ad.vals is None:
+        # lean binned pack: the planes are the only arrays — the view
+        # reconstructs the gather-form triplets (padding rides as zeros)
+        from ..ops.pallas_csr import binned_entries_view
+        rows_v, cols_v, vals_v = binned_entries_view(Ad)
+        vals = np.asarray(vals_v)
+        cols = np.asarray(cols_v)
+        row_ids = np.asarray(rows_v)
     else:
         vals = np.asarray(Ad.vals)
         cols = np.asarray(Ad.cols) if Ad.cols is not None else None
+        row_ids = np.asarray(Ad.row_ids) if Ad.row_ids is not None \
+            else None
     out = np.zeros((n, m), dtype=vals.dtype)
     if Ad.fmt == "ell":
         for i in range(Ad.n_rows):
@@ -90,7 +100,7 @@ def _densify_device(Ad) -> np.ndarray:
                 else:
                     out[i * b:(i + 1) * b, j * b:(j + 1) * b] += v
     else:
-        rows = np.asarray(Ad.row_ids)
+        rows = row_ids
         for e in range(len(rows)):
             i, j = rows[e], cols[e]
             if b == 1:

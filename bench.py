@@ -95,6 +95,7 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None,
     import numpy as np
 
     import amgx_tpu as amgx
+    from amgx_tpu.core.matrix import pack_kind
 
     slv = amgx.create_solver(cfg)
     if sync_shape is not None:
@@ -104,8 +105,12 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None,
     t0 = time.perf_counter()
     m = make_matrix()
     Ad = m.device()
-    _sync(Ad.vals)
+    _sync(Ad.vals if Ad.vals is not None else Ad.diag)
     upload_t = time.perf_counter() - t0
+    # the CHOSEN pack per case, straight in the log: a dispatch
+    # regression (a case silently sliding off its kernel) then shows in
+    # BENCH diffs, not only as a slower number
+    print(f"[bench] fine-level pack: {pack_kind(Ad)}", file=sys.stderr)
     n = m.shape[0]
     t0 = time.perf_counter()
     slv.setup(m)
@@ -149,7 +154,8 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None,
             "setup_drain_s": round(setup_drain_s, 4),
             "solve_s": round(solve_t, 4),
             "relres": relres, "iterations": int(res.iterations),
-            "status": int(res.status), "n": int(n)}
+            "status": int(res.status), "n": int(n),
+            "pack": pack_kind(Ad)}
 
 
 def main():
@@ -244,9 +250,18 @@ def main():
             T, n_tiles, Dpad, _pad, _L = Adf.sh_dims
             bytes_moved = (n_tiles * Dpad * (T + (T // 128 + 1) * 128)
                            + nr) * itemsize
-        elif Adf.fmt == "ell":  # values + int32 column indices
+        elif Adf.fmt == "ell" and Adf.bn_codes is None:
+            # values + int32 column indices
             bytes_moved = (Adf.ell_width + 2) * nr * itemsize + \
                 Adf.ell_width * nr * 4
+        elif Adf.bn_codes is not None:
+            # binned sliced-ELL kernel: codes+vals planes stream once,
+            # one (Sb, 128) x segment per chunk, y once
+            L = int(Adf.bn_codes.size)
+            C = int(Adf.bn_dims[0])
+            Sb = int(Adf.bn_dims[4])
+            bytes_moved = L * (4 + itemsize) + \
+                C * Sb * 128 * itemsize + nr * itemsize
         else:  # CSR: nnz vals + int32 cols/row_ids + x/y vectors
             bytes_moved = nnz * (itemsize + 8) + 2 * nr * itemsize
         return t, 2.0 * nnz / t / 1e9, bytes_moved / t / 1e9
@@ -304,6 +319,64 @@ def main():
         except Exception as e:
             fmt_stats["ell_rcm_rescued"] = None
             print(f"[bench] rcm rescue measurement failed: {e}",
+                  file=sys.stderr)
+
+    # general-sparsity binned kernel (ops/pallas_csr.py): a ~1%
+    # scattered random matrix and an uploaded MatrixMarket system —
+    # neither fits the DIA/shift/window gates, so these track the
+    # binned path's GFLOPS class per round
+    if on_tpu:
+        from amgx_tpu.core.matrix import pack_kind
+
+        def bench_scattered(label, Ax, seed):
+            import scipy.sparse as sp
+            Ax = sp.csr_matrix(Ax)
+            Adx = pack_device(Ax, 1, dtype, dia_max_diags=0)
+            print(f"[bench] {label} pack: {pack_kind(Adx)}",
+                  file=sys.stderr)
+            xv = jnp.asarray(np.random.default_rng(seed)
+                             .standard_normal(Ax.shape[1]), dtype)
+            _, gf, gbs = measure(Adx, target_s=0.5, kmax=4000, kcal=16,
+                                 nnz=Ax.nnz, nr=Ax.shape[0], xv=xv)
+            fmt_stats[label] = round(gf, 2)
+            fmt_stats[label + "_pack"] = pack_kind(Adx)
+            return gbs
+
+        try:
+            import scipy.sparse as sp
+            ns = 16384
+            As = sp.random(ns, ns, density=0.01, random_state=8,
+                           format="csr", dtype=np.float64)
+            gbs = bench_scattered("binned_scattered_1pct", As, 9)
+            fmt_stats["binned_scattered_eff_gbs"] = round(gbs, 1)
+        except Exception as e:
+            fmt_stats["binned_scattered_1pct"] = None
+            print(f"[bench] scattered binned measurement failed: {e}",
+                  file=sys.stderr)
+        try:
+            # uploaded-MatrixMarket path: write + read through the real
+            # reader (io/matrix_market.py, the AMGX_read_system analog)
+            # so the measured operator took the full upload route
+            import tempfile
+
+            import scipy.sparse as sp
+            from amgx_tpu.io.matrix_market import (read_matrix_market,
+                                                   write_matrix_market)
+            nm = 8192
+            rngm = np.random.default_rng(12)
+            Am = (sp.random(nm, nm, density=0.004, random_state=12,
+                            format="csr", dtype=np.float64)
+                  + sp.diags(rngm.uniform(4.0, 5.0, nm))).tocsr()
+            with tempfile.NamedTemporaryFile("w", suffix=".mtx",
+                                             delete=False) as fh:
+                path_mm = fh.name
+            write_matrix_market(path_mm, Am)
+            sysd = read_matrix_market(path_mm)
+            bench_scattered("binned_mm_uploaded", sysd.A, 13)
+            os.unlink(path_mm)
+        except Exception as e:
+            fmt_stats["binned_mm_uploaded"] = None
+            print(f"[bench] matrixmarket binned measurement failed: {e}",
                   file=sys.stderr)
 
     # ---------------- FGMRES + aggregation AMG ----------------
@@ -572,6 +645,7 @@ def main():
             "spmv_s": round(spmv_t, 8),
             "spmv_gflops_by_format": fmt_stats,
             "matrix_fmt": Ad.fmt,
+            "headline_pack": case.get("pack"),
             "device_dtype": str(dtype),
             **({"poisson256": big} if big else {}),
             **extra_cases,

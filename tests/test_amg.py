@@ -444,3 +444,30 @@ def test_energymin_beats_d1_on_anisotropic():
     it_em = int(r_em.iterations)
     it_d1 = int(r_d1.iterations) if r_d1.status == 0 else 81
     assert it_em < it_d1, (it_em, it_d1)
+
+
+def test_energymin_chunking_invariance():
+    """The EM interpolator processes F rows in fixed-size chunks (the
+    (nF, mF, K, ·) match tensors used to cost GB at 10⁶ rows); the
+    per-row local solves are independent, so P must be IDENTICAL for
+    any chunk size."""
+    from amgx_tpu.amg.energymin.interpolator import EnergyMinInterpolator
+
+    A = sp.csr_matrix(poisson5pt(14, 11)).astype(np.float64)
+    cfg = AMGConfig()
+    S = create_strength("AHAT", cfg, "default").compute(A)
+    cf = _pmis(S, seed=3)
+
+    def run(chunk):
+        interp = create_interpolator("EM", cfg, "default")
+        assert isinstance(interp, EnergyMinInterpolator)
+        interp.f_chunk = chunk
+        return interp.compute(A, S, cf).tocsr()
+
+    P_big = run(1 << 20)         # one chunk
+    for chunk in (1, 7, 64):
+        P_c = run(chunk)
+        assert (P_big != P_c).nnz == 0, chunk
+        assert np.array_equal(P_big.indptr, P_c.indptr)
+        assert np.array_equal(P_big.indices, P_c.indices)
+        assert np.array_equal(P_big.data, P_c.data)

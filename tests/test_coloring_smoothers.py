@@ -391,3 +391,20 @@ def test_million_row_greedy_under_two_seconds():
     assert agg.min() >= 0 and len(agg) == A.shape[0]
     assert t_color < 2.0, t_color
     assert t_agg < 2.0, t_agg
+
+
+def test_70_clique_proper_coloring():
+    """Regression: graphs needing >63 colors used to saturate the
+    63-bit used-color masks (free==0 → log2(0)) and the leftovers were
+    lumped into ONE shared color — a silently improper coloring.  A
+    70-clique needs exactly 70 colors; every scheme must now deliver a
+    PROPER coloring via the exact leftover pass."""
+    n = 70
+    A = sp.csr_matrix(np.ones((n, n)) - np.eye(n))
+    cfg = AMGConfig("determinism_flag=1")
+    for scheme in ("PARALLEL_GREEDY", "MIN_MAX", "GREEDY_RECOLOR"):
+        col = create_coloring(scheme, cfg, "default").color(A)
+        assert check_coloring(A, col) == 0.0, scheme
+        # a clique admits no repeated color at all
+        assert col.num_colors == n, (scheme, col.num_colors)
+        assert len(np.unique(col.colors)) == n, scheme

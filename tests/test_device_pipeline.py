@@ -146,11 +146,11 @@ def test_anisotropic_d1_strength_mask_parity():
     rows = np.repeat(np.arange(A3.shape[0]), np.diff(A3.indptr))
     zdiff = np.abs(A3.indices - rows) == nx * nx
     A3.data = np.where(zdiff, A3.data * 0.01, A3.data)
-    # keep it SPD-ish/consistent: also bump the diagonal accordingly
-    diag_fix = np.bincount(rows[zdiff],
-                           weights=0.99 * -A3.data[zdiff] * 100,
-                           minlength=A3.shape[0])
-    A3 = sp.csr_matrix(A3 + sp.diags(-0.0 * diag_fix))
+    # the unscaled diagonal stays: the operator keeps (extra) diagonal
+    # dominance, which is all the strength-mask parity check needs — a
+    # row-sum-preserving diagonal compensation was once computed here
+    # but applied as `-0.0 * diag_fix`, a no-op; the dead code is gone
+    A3 = sp.csr_matrix(A3)
     n = A3.shape[0]
     offs, vals = dia_arrays(A3, max_diags=16)
     res = coarsen_fine_embedded(

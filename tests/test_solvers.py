@@ -407,3 +407,25 @@ def test_idrmsync_distinct_and_converges():
     # same algorithm class: comparable cycle counts
     assert abs(its["IDR"] - its["IDRMSYNC"]) <= max(
         3, its["IDR"] // 2), its
+
+
+def test_chebyshev_degenerate_lanczos_interval(monkeypatch):
+    """Regression: when the Lanczos λmax estimate came out ≤ 0, the old
+    fallback set lmin = 0.125·λmax > λmax — an INVERTED Chebyshev
+    interval.  The solver must re-estimate on the power/Gershgorin path
+    and end with a proper positive interval."""
+    import scipy.sparse as sp
+
+    import amgx_tpu as amgx
+    from amgx_tpu.solvers import chebyshev as _cheb
+
+    monkeypatch.setattr(_cheb, "_lanczos_spectrum",
+                        lambda *a, **k: (0.5, -2.0))
+    A = sp.csr_matrix(poisson5pt(12, 12))
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=CHEBYSHEV, out:max_iters=5, "
+        "out:chebyshev_lambda_estimate_mode=0, "
+        "out:preconditioner(p)=NOSOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    assert 0 < slv.lmin < slv.lmax
