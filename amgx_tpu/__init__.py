@@ -51,6 +51,7 @@ from .core import Matrix, DeviceMatrix
 from .ops import blas, spmv, spmm
 from .solvers import Solver, SolverFactory, SolveResult
 from . import io
+from . import telemetry
 from .utils import register_print_callback, amgx_output
 
 _initialized = False
@@ -87,6 +88,6 @@ __all__ = [
     "initialize", "finalize", "get_api_version", "create_solver",
     "AMGConfig", "Matrix", "DeviceMatrix", "Solver", "SolverFactory",
     "SolveResult", "Mode", "parse_mode", "PUBLIC_MODES", "RC", "SolveStatus",
-    "AMGXError", "blas", "spmv", "spmm", "io", "register_print_callback",
-    "amgx_output",
+    "AMGXError", "blas", "spmv", "spmm", "io", "telemetry",
+    "register_print_callback", "amgx_output",
 ]

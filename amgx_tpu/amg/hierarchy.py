@@ -23,6 +23,7 @@ from typing import List, Optional
 import numpy as np
 import scipy.sparse as sp
 
+from .. import telemetry
 from ..config import AMGConfig
 from ..core.matrix import Matrix
 from ..errors import BadConfigurationError
@@ -226,8 +227,12 @@ class AMGHierarchy:
             self.levels = []
             raise
         self.setup_time = time.perf_counter() - t0
+        if telemetry.is_enabled():
+            self._emit_telemetry()
         if self.print_grid_stats:
-            amgx_output(self.grid_stats())
+            # informational table: verbosity level 2 (the reference
+            # prints it through the same gated output stream)
+            amgx_output(self.grid_stats(), level=2)
         return self
 
     def _setup_fresh(self, A: Matrix):
@@ -1326,15 +1331,43 @@ class AMGHierarchy:
     def num_levels(self):
         return len(self.levels) + 1
 
+    def level_sizes(self) -> List[tuple]:
+        """(rows, nnz) per level, fine to coarsest — the single source
+        for the grid-stats table and the hierarchy telemetry gauges
+        (per-level logical sizing lives in ``AMGLevel.level_stats``)."""
+        sizes = [l.level_stats() for l in self.levels]
+        sizes.append((self.coarsest.n_block_rows, self.coarsest.nnz))
+        return sizes
+
+    def _emit_telemetry(self):
+        """Hierarchy gauges: per-level rows/nnz plus operator and grid
+        complexity — the structured twin of the grid-stats table (the
+        data every serious AMG user reads before trusting a solve)."""
+        sizes = self.level_sizes()
+        tot_rows = sum(n for n, _ in sizes)
+        tot_nnz = sum(z for _, z in sizes)
+        op_cmpl = tot_nnz / max(sizes[0][1], 1)
+        grid_cmpl = tot_rows / max(sizes[0][0], 1)
+        telemetry.gauge_set("amgx_hierarchy_levels", len(sizes))
+        # a shallower re-setup must not leave the previous hierarchy's
+        # deeper levels dangling in the registry snapshot
+        telemetry.registry().gauge_clear("amgx_level_rows")
+        telemetry.registry().gauge_clear("amgx_level_nnz")
+        for i, (n, nnz) in enumerate(sizes):
+            telemetry.gauge_set("amgx_level_rows", n, level=i)
+            telemetry.gauge_set("amgx_level_nnz", nnz, level=i)
+        telemetry.gauge_set("amgx_operator_complexity", op_cmpl)
+        telemetry.gauge_set("amgx_grid_complexity", grid_cmpl)
+        telemetry.event("hierarchy", levels=len(sizes),
+                        operator_complexity=round(op_cmpl, 6),
+                        grid_complexity=round(grid_cmpl, 6),
+                        setup_s=round(self.setup_time, 6))
+
     def grid_stats(self) -> str:
         """Grid-stats table mirroring the reference README sample output."""
         rows = []
         tot_rows = tot_nnz = 0
-        # device-pipeline levels report their LOGICAL size (the embedded
-        # level-1 pack is fine-grid sized; pads aren't rows)
-        all_levels = [(getattr(l.A, "logical_rows", None) or
-                       l.Ad.n_rows, l.A.nnz) for l in self.levels]
-        all_levels.append((self.coarsest.n_block_rows, self.coarsest.nnz))
+        all_levels = self.level_sizes()
         for i, (n, nnz) in enumerate(all_levels):
             sprs = nnz / max(n * n, 1)
             rows.append(f"         {i}(D)  {n:12d}  {nnz:12d} "

@@ -67,6 +67,14 @@ class AMGLevel:
     def nnz(self):
         return self.A.nnz
 
+    def level_stats(self) -> tuple:
+        """(rows, nnz) of this level for grid stats and the telemetry
+        gauges (``amgx_level_rows``/``amgx_level_nnz``).  Device-pipeline
+        levels report their LOGICAL size — the embedded level-1 pack is
+        fine-grid sized and pads aren't rows."""
+        return (getattr(self.A, "logical_rows", None) or self.Ad.n_rows,
+                self.A.nnz)
+
 
 class AggregationLevel(AMGLevel):
     """Implicit piecewise-constant transfer over ``aggregates``."""

@@ -519,6 +519,30 @@ def AMGX_solver_calculate_residual_norm(slv: SolverHandle,
     return float(np.linalg.norm(r))
 
 
+@_catches(1)
+def AMGX_solver_get_setup_time(slv: SolverHandle):
+    """Wall seconds of the last ``AMGX_solver_setup``/``_resetup`` —
+    the same value the telemetry registry records as
+    ``amgx_last_setup_seconds``."""
+    return float(getattr(slv.solver, "setup_time", 0.0))
+
+
+@_catches(1)
+def AMGX_solver_get_solve_time(slv: SolverHandle):
+    """Wall seconds of the last ``AMGX_solver_solve`` (telemetry gauge
+    ``amgx_last_solve_seconds``)."""
+    return (0.0 if slv.last_result is None
+            else float(slv.last_result.solve_time))
+
+
+@_catches(1)
+def AMGX_solver_get_telemetry_snapshot(slv: SolverHandle):
+    """Prometheus text-format snapshot of the telemetry registry (empty
+    until a config with ``telemetry=1`` enabled recording)."""
+    from . import telemetry
+    return telemetry.prometheus_text()
+
+
 # ----------------------------------------------------------------------- io
 def _resolve_rhs(sysdata, mtx: MatrixHandle):
     if sysdata.rhs is not None:

@@ -281,6 +281,16 @@ def register_default_parameters():
       "override device matrix dtype <default|float64|float32|bfloat16>")
     R("tpu_ell_max_width", int, 2048,
       "max padded row width before SpMV falls back to CSR segment-sum")
+    # structured telemetry (amgx_tpu/telemetry/): process-global
+    # recording enabled from any solver whose config sets telemetry=1;
+    # enabling also keeps the residual history so per-iteration
+    # residual records can be emitted
+    R("telemetry", int, 0,
+      "enable structured telemetry (spans/events/metrics)", _BOOL)
+    R("telemetry_path", str, "",
+      "JSONL trace file; appended incrementally after setup/solve")
+    R("telemetry_ring_size", int, 65536,
+      "max telemetry records held in the in-memory ring buffer")
 
 
 register_default_parameters()

@@ -17,6 +17,21 @@ import jax
 import jax.numpy as jnp
 
 from ..core.matrix import DeviceMatrix
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import recorder as _trecorder
+
+
+def _tel_pack(pack: str, fallback: str = None):
+    """Pack-selection telemetry: count the dispatch decision (and, when
+    a packed kernel layout had to take a generic path, the fallback).
+    SpMV dispatch runs at trace time, so this is host-side and free in
+    the compiled program; one attribute check when telemetry is off."""
+    if not _trecorder.is_enabled():
+        return
+    _tmetrics.counter_inc("amgx_spmv_dispatch_total", pack=pack)
+    if fallback is not None:
+        _tmetrics.counter_inc("amgx_spmv_fallback_total", pack=pack,
+                              reason=fallback)
 
 
 def spmv(A, x: jax.Array) -> jax.Array:
@@ -27,20 +42,25 @@ def spmv(A, x: jax.Array) -> jax.Array:
     """
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
+        _tel_pack("sharded")
         return dist_spmv(A, x)
     if A.fmt == "dia3":
         # Galerkin composition R·(A·(P·x)) — three DIA streams instead
         # of one low-fill embedded matrix (core.matrix.ComposedDIA)
+        _tel_pack("dia3")
         return spmv(A.R, spmv(A.A, spmv(A.P, x)))
     if A.fmt == "op":
         # implicit operator (operators.ImplicitOperator — the
         # operator.h:37-80 Operator::apply analog)
+        _tel_pack("op")
         return A.apply(x)
     if A.fmt == "dia":
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
                 and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)):
+            _tel_pack("dia/kernel")
             return dia_spmv(A, x)
+        _tel_pack("dia/slices")
         # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
         # padded copy of x — no gathers (reference SpMV kernel dispatch
         # multiply.cu:94-110; this is the TPU-optimal stencil path)
@@ -58,6 +78,7 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.fmt == "dense":
         # small scattered coarse operator: one MXU matvec (HIGHEST
         # precision keeps the f32 product exact — the matrices are tiny)
+        _tel_pack("dense")
         return jnp.dot(A.vals, x,
                        precision=jax.lax.Precision.HIGHEST)
     if A.fmt == "ell":
@@ -66,29 +87,42 @@ def spmv(A, x: jax.Array) -> jax.Array:
             if shift_supported(A):
                 # tile-DIA shift kernel: VPU shift-aligned streams, no
                 # per-entry column data (locally-banded matrices)
+                _tel_pack("ell/shift")
                 return shift_spmv(A, x)
             from .pallas_ell import ell_window_spmv, ell_window_supported
             if ell_window_supported(A):
                 # gather-free windowed one-hot kernel (XLA lowers the
                 # x[cols] gather to a scalar loop — ~100× slower)
+                _tel_pack("ell/window")
                 return ell_window_spmv(A, x)
             from .pallas_csr import binned_spmv, binned_supported
             if binned_supported(A):
                 # general-sparsity binned sliced-ELL kernel: scattered
                 # matrices past the shift/window gates stay off the
                 # gather cliff (ops/pallas_csr.py)
+                _tel_pack("ell/binned")
                 return binned_spmv(A, x)
             # cols: (n, K); vals: (n, K); x: (m,) — via the views so a
             # LEAN shift/window pack (vals/cols deleted; the kernel
             # layouts carry them) still falls back correctly when the
             # kernel gate rejects it (advisor finding, round 4)
+            _tel_pack("ell/gather",
+                      fallback="kernel_gate_rejected"
+                      if (getattr(A, "sh_vals", None) is not None
+                          or getattr(A, "win_codes", None) is not None
+                          or getattr(A, "bn_codes", None) is not None)
+                      else None)
             return jnp.sum(A.ell_vals_view() * x[A.ell_cols_view()],
                            axis=1)
         from .pallas_csr import binned_spmv, binned_supported
         if binned_supported(A):
             # the pack carries the block matrix's SCALAR expansion —
             # x is already the flat scalar vector
+            _tel_pack("ell/binned")
             return binned_spmv(A, x)
+        _tel_pack("ell/block-gather",
+                  fallback="kernel_gate_rejected"
+                  if getattr(A, "bn_codes", None) is not None else None)
         xb = x.reshape(A.n_cols, b)
         xg = xb[A.cols]                      # (n, K, b)
         y = jnp.einsum("nkab,nkb->na", A.vals, xg,
@@ -98,17 +132,23 @@ def spmv(A, x: jax.Array) -> jax.Array:
     from .pallas_csr import (binned_entries_view, binned_spmv,
                              binned_supported)
     if binned_supported(A):
+        _tel_pack("csr/binned")
         return binned_spmv(A, x)
     if b == 1:
         if A.vals is None:
             # lean binned pack on a backend the kernel cannot serve:
             # reconstruct the gather-form triplets from the planes
+            _tel_pack("csr/segsum-lean", fallback="kernel_gate_rejected")
             rows, cols, vals = binned_entries_view(A)
             prod = vals * x[cols]
             return jax.ops.segment_sum(prod, rows,
                                        num_segments=A.n_rows)
+        _tel_pack("csr/segsum",
+                  fallback="kernel_gate_rejected"
+                  if getattr(A, "bn_codes", None) is not None else None)
         prod = A.vals * x[A.cols]
         return jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
+    _tel_pack("csr/block-segsum")
     xb = x.reshape(A.n_cols, b)
     prod = jnp.einsum("eab,eb->ea", A.vals, xb[A.cols],
                       preferred_element_type=A.vals.dtype)

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import telemetry
 from ..config import AMGConfig
 from ..core.matrix import DeviceMatrix, Matrix
 from ..errors import BadConfigurationError, SolveStatus
@@ -166,6 +167,19 @@ class Solver:
         self.print_solve_stats = bool(g("print_solve_stats"))
         self.obtain_timings = bool(g("obtain_timings"))
         self.relaxation_factor = float(g("relaxation_factor"))
+        # structured telemetry (amgx_tpu/telemetry/): the knob enables
+        # the process-global recorder; keeping the residual history is
+        # what makes per-iteration residual records available post-solve
+        self.telemetry_path = str(g("telemetry_path"))
+        if int(g("telemetry")):
+            telemetry.enable(int(g("telemetry_ring_size")))
+            self.store_res_history = True
+        # an EXPLICIT verbosity_level drives the level-gated output
+        # stream; the registry default must not clobber a verbosity the
+        # host application set programmatically
+        if cfg.has("verbosity_level", scope):
+            from ..utils.logging import set_verbosity
+            set_verbosity(int(g("verbosity_level")))
         self.A: Optional[Matrix] = None
         self.Ad: Optional[DeviceMatrix] = None
         self.scaler = None
@@ -178,7 +192,29 @@ class Solver:
     def setup(self, A: "Matrix | DeviceMatrix"):
         """Host-side setup (reference ``Solver::setup``, solver.cu:380-556):
         optional scaling → solver-specific setup."""
+        phase = "resetup" if getattr(self, "_numeric_resetup", False) \
+            else "setup"
+        # nested solvers (smoothers, coarse solver, preconditioner)
+        # re-enter setup(): their spans nest in the trace (that is how
+        # "where did setup time go" reads), but the phase METRICS are
+        # top-level only — 8 overlapping amgx_setup_seconds samples per
+        # user-facing setup would inflate every aggregate
+        toplevel = bool(getattr(self, "_toplevel", False))
         t0 = time.perf_counter()
+        with telemetry.span(phase, solver=self.config_name,
+                            scope=self.scope, toplevel=toplevel):
+            self._setup_impl(A)
+        self.setup_time = time.perf_counter() - t0
+        if toplevel and telemetry.is_enabled():
+            telemetry.hist_observe(f"amgx_{phase}_seconds",
+                                   self.setup_time)
+            telemetry.gauge_set("amgx_last_setup_seconds",
+                                self.setup_time)
+            if self.telemetry_path:
+                telemetry.flush_jsonl(self.telemetry_path)
+        return self
+
+    def _setup_impl(self, A: "Matrix | DeviceMatrix"):
         self.scaler = None
         self._reorder = None
         scaling = str(self.cfg.get("scaling", self.scope))
@@ -234,8 +270,6 @@ class Solver:
             # solve rebuilds it (and the bindings that carry it)
             if hasattr(self, "_refine_lo"):
                 del self._refine_lo
-        self.setup_time = time.perf_counter() - t0
-        return self
 
     def resetup(self, A: "Matrix | DeviceMatrix"):
         """Numeric refresh after ``replace_coefficients``: same structure,
@@ -487,7 +521,9 @@ class Solver:
             self._refined_fn = None
 
         t0 = time.perf_counter()
-        with cpu_profiler(f"solve:{self.config_name}"):
+        with telemetry.span("solve", solver=self.config_name,
+                            scope=self.scope, refined=bool(refine)), \
+                cpu_profiler(f"solve:{self.config_name}"):
             if refine:
                 # refinement must see the caller's full-precision
                 # rhs/guess — the dtype-cast b/x0 above would fold the
@@ -553,9 +589,47 @@ class Solver:
                         f"    solve: {solve_time:10.6f} s\n"
                         f"    solve(per iteration): "
                         f"{solve_time / max(iters, 1):10.6f} s\n")
+        if telemetry.is_enabled():
+            self._emit_solve_telemetry(iters, nrm, nrm_ini_np, status,
+                                       history_np, solve_time)
         return SolveResult(x=x, iterations=iters, status=status,
                            residual_norm=nrm, residual_history=history_np,
                            setup_time=self.setup_time, solve_time=solve_time)
+
+    def _emit_solve_telemetry(self, iters, nrm, nrm_ini, status,
+                              history, solve_time):
+        """Per-solve telemetry: phase duration, iteration count, final
+        relative residual, convergence-rate estimate, divergence event
+        and the per-iteration residual trajectory (iteration 0 = the
+        initial residual, matching ``AMGX_solver_get_iteration_residual``
+        indexing)."""
+        telemetry.hist_observe("amgx_solve_seconds", solve_time)
+        telemetry.gauge_set("amgx_last_solve_seconds", solve_time)
+        telemetry.gauge_set("amgx_solve_iterations", iters)
+        # NOT_CONVERGED aliases DIVERGED in the reference enum (both 2);
+        # distinguish by the non-finite check the status was derived from
+        diverged = bool(np.any(~np.isfinite(np.asarray(nrm))))
+        label = ("SUCCESS" if status == SolveStatus.SUCCESS else
+                 ("DIVERGED" if diverged else "NOT_CONVERGED"))
+        telemetry.counter_inc("amgx_solves_total", status=label)
+        if self.monitor_residual:
+            nrm_m = float(np.max(nrm))
+            ini_m = float(np.max(nrm_ini))
+            relres = nrm_m / ini_m if ini_m > 0 else nrm_m
+            telemetry.gauge_set("amgx_solve_final_relres", relres)
+            if iters > 0 and np.isfinite(relres) and relres > 0:
+                telemetry.gauge_set("amgx_solve_convergence_rate",
+                                    relres ** (1.0 / iters))
+            if diverged:
+                telemetry.counter_inc("amgx_solve_diverged_total")
+                telemetry.event("divergence", solver=self.config_name,
+                                iteration=iters, norm=nrm_m)
+            if history is not None:
+                for i, row in enumerate(np.atleast_2d(history)):
+                    telemetry.event("residual", iteration=i,
+                                    norm=float(np.max(row)))
+        if self.telemetry_path:
+            telemetry.flush_jsonl(self.telemetry_path)
 
     def _host_norm(self, v: np.ndarray):
         """Numpy twin of ops.blas.norm — outer refinement norms must match

@@ -1,0 +1,50 @@
+"""Structured solver telemetry.
+
+One structured layer over what the reference scattered across the CPU
+profiler tree (``amgx_timer.h``), per-level ``levelProfile`` timers and
+the grid-stats table:
+
+* **spans/events** (:mod:`.recorder`): ``span(name, **attrs)`` phase
+  markers (which also aggregate into the legacy profiler tree and the
+  ``jax.profiler.TraceAnnotation`` forwarding) and ``event(name,
+  **attrs)`` point records, appended to a bounded ring buffer;
+* **metrics** (:mod:`.metrics`): counters/gauges/histograms under
+  stable, versioned names (``METRICS``) — SpMV pack-selection counts,
+  jit recompiles, phase durations, hierarchy complexities, per-solve
+  iteration/residual gauges;
+* **exporters** (:mod:`.export`): JSONL traces (``dump_jsonl`` /
+  incremental ``flush_jsonl``) and a Prometheus text snapshot
+  (``prometheus_text``), plus the schema validator used by
+  ``scripts/telemetry_check.py``.
+
+Everything is **off by default** and compiled down to one attribute
+check per instrument; enable globally with :func:`enable`, per config
+with the ``telemetry=1`` knob (plus ``telemetry_path`` /
+``telemetry_ring_size``), or scoped with :func:`capture` in tests.
+"""
+from __future__ import annotations
+
+from . import export, metrics, recorder
+from .export import (dump_jsonl, flush_jsonl, prometheus_text,
+                     validate_jsonl, validate_record)
+from .metrics import (METRICS, counter_inc, gauge_set, hist_observe,
+                      registry)
+from .recorder import (SCHEMA_VERSION, Capture, capture, clear, disable,
+                       enable, event, is_enabled, records, span)
+
+__all__ = [
+    "SCHEMA_VERSION", "METRICS", "Capture",
+    "enable", "disable", "is_enabled", "capture", "clear", "records",
+    "span", "event",
+    "counter_inc", "gauge_set", "hist_observe", "registry",
+    "dump_jsonl", "flush_jsonl", "prometheus_text",
+    "validate_record", "validate_jsonl",
+    "reset",
+]
+
+
+def reset():
+    """Drop buffered records and zero the metrics registry (test/bench
+    isolation helper; recording stays in whatever on/off state it was)."""
+    recorder.clear()
+    metrics.registry().reset()

@@ -1,0 +1,237 @@
+"""Telemetry exporters: JSONL traces and Prometheus text snapshots.
+
+JSONL: one JSON object per line.  Every writing session starts with a
+``meta`` record declaring the schema version (a file appended by two
+processes holds one ``meta`` per session, and ``seq`` restarts after
+each); every other line is a ring record (see
+:mod:`amgx_tpu.telemetry.recorder` for the field contract).  Non-finite
+numbers are encoded as the strings ``"NaN"``/``"Infinity"``/
+``"-Infinity"`` so every line is strict JSON (``json.dumps`` would
+otherwise emit bare ``NaN`` tokens that jq/JS/Go reject — divergence
+events carry exactly such norms).  :func:`validate_record` is the
+single schema authority — tests and ``scripts/telemetry_check.py``
+both call it, so a drifting field shows up as a failing check, not a
+silently unreadable trace.
+
+Prometheus: the standard text exposition format (``# TYPE`` /
+``# HELP`` headers from the versioned :data:`~.metrics.METRICS` list,
+``_bucket``/``_sum``/``_count`` series for histograms), suitable for a
+node-exporter-style textfile collector or a scrape handler.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import IO, Iterable, List, Optional, Union
+
+from . import metrics, recorder
+
+_KINDS = ("meta", "span_begin", "span_end", "event", "counter", "gauge",
+          "hist")
+
+_flush_lock = threading.Lock()
+#: per-path high-water sequence number for incremental flushes
+_flushed_seq = {}
+
+
+def _meta_record() -> dict:
+    return {"kind": "meta", "name": "amgx-telemetry",
+            "schema": recorder.SCHEMA_VERSION}
+
+
+_NONFINITE = {"NaN": math.nan, "Infinity": math.inf,
+              "-Infinity": -math.inf}
+
+
+def _sanitize(v):
+    """Strict-JSON encoding of non-finite floats as string tokens."""
+    if isinstance(v, float) and not math.isfinite(v):
+        if math.isnan(v):
+            return "NaN"
+        return "Infinity" if v > 0 else "-Infinity"
+    if isinstance(v, dict):
+        return {k: _sanitize(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_sanitize(x) for x in v]
+    return v
+
+
+def _json_line(rec: dict) -> str:
+    return json.dumps(_sanitize(rec), allow_nan=False)
+
+
+def validate_record(rec: dict):
+    """Raise ``ValueError`` when ``rec`` does not conform to the
+    documented schema (version ``recorder.SCHEMA_VERSION``)."""
+    def need(cond, msg):
+        if not cond:
+            raise ValueError(f"telemetry record schema: {msg}: {rec!r}")
+
+    need(isinstance(rec, dict), "record is not an object")
+    kind = rec.get("kind")
+    need(kind in _KINDS, f"unknown kind {kind!r}")
+    need(isinstance(rec.get("name"), str) and rec["name"],
+         "missing/empty name")
+    if kind == "meta":
+        need(rec.get("schema") == recorder.SCHEMA_VERSION,
+             f"schema version {rec.get('schema')!r} != "
+             f"{recorder.SCHEMA_VERSION}")
+        return
+    need(isinstance(rec.get("seq"), int) and rec["seq"] > 0,
+         "missing seq")
+    need(isinstance(rec.get("t"), (int, float)), "missing t")
+    need(isinstance(rec.get("tid"), int), "missing tid")
+    if kind in ("span_begin", "span_end"):
+        need(isinstance(rec.get("sid"), int), "span missing sid")
+        need(rec.get("parent") is None or isinstance(rec["parent"], int),
+             "bad span parent")
+        if kind == "span_begin":
+            need(isinstance(rec.get("attrs"), dict), "span missing attrs")
+        else:
+            need(isinstance(rec.get("dur"), (int, float))
+                 and rec["dur"] >= 0.0, "span_end missing dur")
+    elif kind == "event":
+        need(isinstance(rec.get("attrs"), dict), "event missing attrs")
+        need(rec.get("sid") is None or isinstance(rec["sid"], int),
+             "bad event sid")
+    else:   # counter / gauge / hist
+        need(isinstance(rec.get("labels"), dict), "metric missing labels")
+        v = rec.get("value")
+        need((isinstance(v, (int, float)) and not isinstance(v, bool))
+             or v in _NONFINITE,
+             "metric missing numeric value")
+
+
+def validate_jsonl(lines: Iterable[str]) -> int:
+    """Validate an iterable of JSONL lines; returns the record count.
+    The first non-empty line must be the meta header; ``seq`` must be
+    strictly increasing within a session (each appending session
+    restates the meta header, after which ``seq`` may restart)."""
+    n = 0
+    last_seq = 0
+    first = True
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        def _bare(tok):
+            # the whole point of the string encoding is that strict
+            # consumers (jq/JS/Go) can parse every line — a bare token
+            # is exactly the drift this validator exists to catch
+            raise ValueError(
+                f"bare {tok} token is not strict JSON; non-finite "
+                "values must be string-encoded")
+        rec = json.loads(line, parse_constant=_bare)
+        validate_record(rec)
+        if first:
+            if rec.get("kind") != "meta":
+                raise ValueError("first JSONL record must be the meta "
+                                 "header")
+            first = False
+        elif rec["kind"] == "meta":
+            last_seq = 0    # a new writing session starts here
+        else:
+            if rec["seq"] <= last_seq:
+                raise ValueError(
+                    f"seq not increasing: {rec['seq']} after {last_seq}")
+            last_seq = rec["seq"]
+        n += 1
+    if first:
+        raise ValueError("empty trace: no records")
+    return n
+
+
+def dump_jsonl(path_or_file: Union[str, IO],
+               records: Optional[List[dict]] = None) -> int:
+    """Write ``records`` (default: the current ring contents) with a
+    meta header; returns the number of records written."""
+    recs = recorder.records() if records is None else list(records)
+
+    def write(f):
+        f.write(_json_line(_meta_record()) + "\n")
+        for r in recs:
+            f.write(_json_line(r) + "\n")
+
+    if isinstance(path_or_file, str):
+        with open(path_or_file, "w") as f:
+            write(f)
+    else:
+        write(path_or_file)
+    return len(recs)
+
+
+def flush_jsonl(path: str) -> int:
+    """Append the records produced since the last flush to ``path``;
+    returns the number of records appended.  Solvers call this after
+    setup/solve when ``telemetry_path`` is configured, so a
+    long-running process streams its trace instead of holding it all
+    in the ring.  The meta header is written on this process's FIRST
+    flush to ``path`` — even when the file already has content from an
+    earlier process — because ``seq`` restarts with the process and the
+    header is what marks the session boundary for the validator."""
+    with _flush_lock:
+        first_flush = path not in _flushed_seq
+        last = _flushed_seq.get(path, 0)
+        recs = [r for r in recorder.records() if r["seq"] > last]
+        if first_flush or recs:
+            with open(path, "a") as f:
+                if first_flush:
+                    f.write(_json_line(_meta_record()) + "\n")
+                for r in recs:
+                    f.write(_json_line(r) + "\n")
+        _flushed_seq[path] = recs[-1]["seq"] if recs else last
+        return len(recs)
+
+
+def _prom_num(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v))
+
+
+def _prom_labels(lk) -> str:
+    if not lk:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in lk) + "}"
+
+
+def prometheus_text() -> str:
+    """Registry snapshot in the Prometheus text exposition format."""
+    counters, gauges, hists = metrics.registry().items()
+    out: List[str] = []
+    seen = set()
+
+    def header(name, mtype):
+        if name in seen:
+            return
+        seen.add(name)
+        t, h = metrics.METRICS.get(name, (mtype, ""))
+        if h:
+            out.append(f"# HELP {name} {h}")
+        out.append(f"# TYPE {name} {t}")
+
+    for (name, lk), v in sorted(counters.items()):
+        header(name, "counter")
+        out.append(f"{name}{_prom_labels(lk)} {_prom_num(v)}")
+    for (name, lk), v in sorted(gauges.items()):
+        header(name, "gauge")
+        out.append(f"{name}{_prom_labels(lk)} {_prom_num(v)}")
+    for (name, lk), (bounds, counts, total, count) in sorted(
+            hists.items()):
+        header(name, "histogram")
+
+        def bucket_labels(le):
+            return _prom_labels(sorted(dict(lk, le=le).items()))
+
+        cum = 0
+        for b, c in zip(bounds, counts):
+            cum += c
+            out.append(f"{name}_bucket"
+                       f"{bucket_labels(_prom_num(float(b)))} {cum}")
+        out.append(f"{name}_bucket{bucket_labels('+Inf')} {count}")
+        out.append(f"{name}_sum{_prom_labels(lk)} {_prom_num(total)}")
+        out.append(f"{name}_count{_prom_labels(lk)} {count}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,203 @@
+"""Metrics registry: counters, gauges and wall-clock histograms.
+
+The aggregated twin of the ring buffer: instruments update both — the
+ring keeps raw samples for the JSONL trace, the registry keeps the
+aggregate state the Prometheus snapshot renders.
+
+Metric names are a **stable, versioned contract** (see :data:`METRICS`
+and the README "Observability" section); renames are schema changes.
+Instruments are cheap no-ops unless telemetry is enabled
+(``recorder.is_enabled()``), so hot paths — SpMV dispatch runs at trace
+time inside ``jax.jit`` — pay a single attribute check when it is off.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from . import recorder
+
+#: v1 metric-name registry: name -> (type, help).  ``{label}`` names in
+#: the help string document the label keys each metric carries.
+METRICS: Dict[str, Tuple[str, str]] = {
+    "amgx_spmv_dispatch_total":
+        ("counter", "SpMV dispatch decisions by chosen pack {pack}"),
+    "amgx_spmv_fallback_total":
+        ("counter", "SpMV calls where a packed kernel layout fell back "
+                    "to a generic path {pack,reason}"),
+    "amgx_jit_trace_total":
+        ("counter", "jax.jit python-cache misses (retraces), process-wide"),
+    "amgx_jit_compile_total":
+        ("counter", "XLA backend compiles (jit recompiles), process-wide"),
+    "amgx_solves_total":
+        ("counter", "completed solves by final status {status}"),
+    "amgx_solve_diverged_total":
+        ("counter", "solves that ended with a non-finite residual"),
+    "amgx_hierarchy_levels":
+        ("gauge", "levels in the last AMG hierarchy setup"),
+    "amgx_level_rows":
+        ("gauge", "rows of one hierarchy level {level}"),
+    "amgx_level_nnz":
+        ("gauge", "stored nonzeros of one hierarchy level {level}"),
+    "amgx_operator_complexity":
+        ("gauge", "sum(level nnz) / fine nnz of the last hierarchy"),
+    "amgx_grid_complexity":
+        ("gauge", "sum(level rows) / fine rows of the last hierarchy"),
+    "amgx_solve_iterations":
+        ("gauge", "iterations of the last solve"),
+    "amgx_solve_final_relres":
+        ("gauge", "final true relative residual of the last solve"),
+    "amgx_solve_convergence_rate":
+        ("gauge", "geometric-mean per-iteration residual reduction of "
+                  "the last solve"),
+    "amgx_last_setup_seconds":
+        ("gauge", "wall seconds of the last solver setup"),
+    "amgx_last_solve_seconds":
+        ("gauge", "wall seconds of the last solve"),
+    "amgx_setup_seconds":
+        ("histogram", "solver setup wall seconds"),
+    "amgx_resetup_seconds":
+        ("histogram", "solver numeric-resetup wall seconds"),
+    "amgx_solve_seconds":
+        ("histogram", "solve wall seconds"),
+    "amgx_jit_compile_seconds":
+        ("histogram", "XLA backend compile wall seconds"),
+}
+
+#: wall-clock histogram bucket upper bounds (seconds)
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   60.0)
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(recorder._jsonable(v)))
+                        for k, v in labels.items()))
+
+
+class _Hist:
+    __slots__ = ("bounds", "counts", "total", "count")
+
+    def __init__(self, bounds=DEFAULT_BUCKETS):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)   # +1: +Inf bucket
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float):
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.total += v
+        self.count += 1
+
+
+class MetricsRegistry:
+    """Thread-safe aggregate store keyed by (name, sorted labels)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, _LabelKey], float] = {}
+        self._gauges: Dict[Tuple[str, _LabelKey], float] = {}
+        self._hists: Dict[Tuple[str, _LabelKey], _Hist] = {}
+
+    # ------------------------------------------------------------- update
+    def counter_inc(self, name: str, value: float = 1.0, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[key] = value
+
+    def gauge_clear(self, name: str):
+        """Drop every labeled series of one gauge — used before
+        re-emitting a label family whose cardinality may shrink (a
+        shallower hierarchy must not leave stale deep-level gauges in
+        the Prometheus snapshot)."""
+        with self._lock:
+            for key in [k for k in self._gauges if k[0] == name]:
+                del self._gauges[key]
+
+    def hist_observe(self, name: str, value: float, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = _Hist()
+            h.observe(value)
+
+    # -------------------------------------------------------------- query
+    def get_counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def get_gauge(self, name: str, default=None, **labels):
+        with self._lock:
+            return self._gauges.get((name, _label_key(labels)), default)
+
+    def snapshot(self) -> dict:
+        """Plain-python copy: {"counters": {...}, "gauges": {...},
+        "histograms": {...}} with ``name{k=v,...}`` string keys."""
+        def fmt(name, lk):
+            if not lk:
+                return name
+            return name + "{" + ",".join(f"{k}={v}" for k, v in lk) + "}"
+        with self._lock:
+            return {
+                "counters": {fmt(n, lk): v for (n, lk), v
+                             in sorted(self._counters.items())},
+                "gauges": {fmt(n, lk): v for (n, lk), v
+                           in sorted(self._gauges.items())},
+                "histograms": {fmt(n, lk): {"count": h.count,
+                                            "sum": h.total}
+                               for (n, lk), h
+                               in sorted(self._hists.items())},
+            }
+
+    def items(self):
+        """Locked copy of the raw stores (used by the Prometheus
+        renderer): (counters, gauges, hists)."""
+        with self._lock:
+            return (dict(self._counters), dict(self._gauges),
+                    {k: (h.bounds, tuple(h.counts), h.total, h.count)
+                     for k, h in self._hists.items()})
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+# ------------------------------------------------- gated module instruments
+def counter_inc(name: str, value: float = 1.0, **labels):
+    if not recorder.is_enabled():
+        return
+    _registry.counter_inc(name, value, **labels)
+    recorder.metric_sample("counter", name, value, labels)
+
+
+def gauge_set(name: str, value, **labels):
+    if not recorder.is_enabled():
+        return
+    value = float(value)
+    _registry.gauge_set(name, value, **labels)
+    recorder.metric_sample("gauge", name, value, labels)
+
+
+def hist_observe(name: str, value: float, **labels):
+    if not recorder.is_enabled():
+        return
+    value = float(value)
+    _registry.hist_observe(name, value, **labels)
+    recorder.metric_sample("hist", name, value, labels)

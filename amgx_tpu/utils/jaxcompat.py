@@ -7,6 +7,14 @@ upgrade moves it, the fallback conservatively reports "tracing", which
 disables caching in the lazy properties — correctness is preserved
 because the Matrix handles cache their packs themselves and the binding
 machinery swaps tracers into those slots.
+
+``install_compile_counter`` hooks ``jax.monitoring`` duration events to
+count jit cache misses for the telemetry registry: a retrace fires
+``.../jaxpr_trace_duration`` (python-cache miss), an actual XLA backend
+compile fires ``.../backend_compile_duration`` (persistent-compile-
+cache hits do NOT fire it, matching what "recompile" means
+operationally).  The listener is process-wide and permanent — JAX has
+no unregister — so it is a no-op unless telemetry is enabled.
 """
 from __future__ import annotations
 
@@ -15,3 +23,41 @@ try:
 except ImportError:      # pragma: no cover - depends on the jax version
     def trace_state_clean() -> bool:
         return False
+
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_compile_listener_installed = False
+
+
+def install_compile_counter() -> bool:
+    """Register the jit cache-miss listener (idempotent); returns True
+    when a listener is in place.  Counts land in
+    ``amgx_jit_trace_total`` / ``amgx_jit_compile_total`` and compile
+    durations in the ``amgx_jit_compile_seconds`` histogram."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+
+    def _on_duration(event, duration, **kwargs):
+        try:
+            from ..telemetry import metrics, recorder
+            if not recorder.is_enabled():
+                return
+            if event == _TRACE_EVENT:
+                metrics.counter_inc("amgx_jit_trace_total")
+            elif event == _COMPILE_EVENT:
+                metrics.counter_inc("amgx_jit_compile_total")
+                metrics.hist_observe("amgx_jit_compile_seconds",
+                                     float(duration))
+        except Exception:   # a metrics bug must never break compilation
+            pass
+
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:    # pragma: no cover - depends on the jax version
+        return False
+    _compile_listener_installed = True
+    return True

@@ -4,6 +4,12 @@ The reference routes all library output through a host-app-registered
 callback (``AMGX_register_print_callback``, ``amgx_c.h:212``;
 ``amgx_output`` / ``error_output`` / ``amgx_distributed_output``,
 ``base/include/misc.h:33-36``).  Same indirection here.
+
+Output is level-gated: each message declares a verbosity ``level``
+(1 = essential solver output, 2 = informational tables such as grid
+stats, 3 = chatty diagnostics) and is emitted only when the configured
+``_verbosity`` is at least that level — previously any nonzero
+verbosity printed everything.  ``error_output`` is never gated.
 """
 from __future__ import annotations
 
@@ -24,8 +30,14 @@ def set_verbosity(level: int):
     _verbosity = int(level)
 
 
-def amgx_output(msg: str):
-    if _verbosity <= 0:
+def get_verbosity() -> int:
+    return _verbosity
+
+
+def amgx_output(msg: str, level: int = 1):
+    """Emit ``msg`` through the registered callback (or stdout) when the
+    configured verbosity is at least ``level``."""
+    if _verbosity <= 0 or _verbosity < int(level):
         return
     if _print_callback is not None:
         _print_callback(msg)
@@ -40,7 +52,7 @@ def error_output(msg: str):
         sys.stderr.write(msg)
 
 
-def amgx_distributed_output(msg: str, rank: int = 0):
+def amgx_distributed_output(msg: str, rank: int = 0, level: int = 1):
     """Only rank 0 prints (reference amgx_distributed_output)."""
     if rank == 0:
-        amgx_output(msg)
+        amgx_output(msg, level=level)

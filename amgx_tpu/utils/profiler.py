@@ -6,12 +6,21 @@ Reference: ``base/include/amgx_timer.h`` — ``Profiler_tree`` /
 the ``TimerMap``.  Here: nested context-manager markers aggregated in a
 tree, plus optional forwarding to ``jax.profiler.TraceAnnotation`` so
 markers show up in XLA profiles.
+
+Every marker also doubles as a telemetry span: when the structured
+telemetry layer (:mod:`amgx_tpu.telemetry`) is enabled, ``scope()``
+appends typed ``span_begin``/``span_end`` records to its ring buffer —
+one instrumentation point, two consumers (the in-process aggregate tree
+and the exportable trace).
 """
 from __future__ import annotations
 
 import contextlib
 import time
+import warnings
 from typing import Dict, Optional
+
+from ..telemetry import recorder as _telemetry
 
 _forward_to_jax = False
 
@@ -45,22 +54,32 @@ class ProfilerTree:
         self._stack = [self.root]
 
     @contextlib.contextmanager
-    def scope(self, name: str):
+    def scope(self, name: str, _attrs: Optional[dict] = None):
         entry = self._stack[-1].child(name)
         self._stack.append(entry)
-        t0 = time.perf_counter()
-        ann = None
-        if _forward_to_jax:
-            import jax
-            ann = jax.profiler.TraceAnnotation(name)
-            ann.__enter__()
         try:
-            yield entry
+            # annotation setup BEFORE the timer starts: an import/enter
+            # failure here must neither corrupt the stack depth (the
+            # outer finally pops) nor charge its cost to the entry
+            ann = None
+            if _forward_to_jax:
+                import jax
+                ann = jax.profiler.TraceAnnotation(name)
+                ann.__enter__()
+            try:
+                sid = _telemetry.span_begin(name, _attrs) \
+                    if _telemetry.is_enabled() else None
+                t0 = time.perf_counter()
+                try:
+                    yield entry
+                finally:
+                    entry.total += time.perf_counter() - t0
+                    entry.count += 1
+                    _telemetry.span_end(sid, name)
+            finally:
+                if ann is not None:
+                    ann.__exit__(None, None, None)
         finally:
-            if ann is not None:
-                ann.__exit__(None, None, None)
-            entry.total += time.perf_counter() - t0
-            entry.count += 1
             self._stack.pop()
 
     def report(self) -> str:
@@ -101,6 +120,11 @@ def cpu_profiler(name: str):
     return profiler_tree().scope(name)
 
 
+#: warn-once latch for TimerMap.toc-without-tic (module-wide: the
+#: mistake is a call-site bug, not per-instance state)
+_TOC_WARNED = False
+
+
 class TimerMap:
     """Named wall-clock timers (reference TimerMap, amgx_timer.h:435)."""
 
@@ -112,7 +136,19 @@ class TimerMap:
         self._starts[name] = time.perf_counter()
 
     def toc(self, name) -> float:
-        dt = time.perf_counter() - self._starts.pop(name, time.perf_counter())
+        t0 = self._starts.pop(name, None)
+        if t0 is None:
+            # toc without tic: report 0.0 without polluting the
+            # aggregate map (the old default-now() pop silently
+            # recorded a ~0 entry), and warn once per process
+            global _TOC_WARNED
+            if not _TOC_WARNED:
+                _TOC_WARNED = True
+                warnings.warn(
+                    f"TimerMap.toc({name!r}) called without a matching "
+                    "tic(); returning 0.0", RuntimeWarning, stacklevel=2)
+            return 0.0
+        dt = time.perf_counter() - t0
         self._timers[name] = self._timers.get(name, 0.0) + dt
         return dt
 

@@ -707,6 +707,30 @@ AMGX_RC AMGX_solver_get_status(AMGX_solver_handle slv,
     return rc;
 }
 
+AMGX_RC AMGX_solver_get_setup_time(AMGX_solver_handle slv, double *t) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_solver_get_setup_time", PyTuple_Pack(1, obj(slv))),
+        &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *t = PyFloat_AsDouble(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
+AMGX_RC AMGX_solver_get_solve_time(AMGX_solver_handle slv, double *t) {
+    Gil gil;
+    std::vector<PyObject *> outs;
+    AMGX_RC rc = unpack_rc(
+        call("AMGX_solver_get_solve_time", PyTuple_Pack(1, obj(slv))),
+        &outs);
+    if (rc == AMGX_RC_OK && !outs.empty())
+        *t = PyFloat_AsDouble(outs[0]);
+    for (auto *o : outs) Py_DECREF(o);
+    return rc;
+}
+
 /* ----------------------------------------------------------------- io */
 AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,
                          AMGX_vector_handle sol, const char *filename) {

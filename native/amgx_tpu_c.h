@@ -162,6 +162,8 @@ AMGX_RC AMGX_solver_get_iteration_residual(AMGX_solver_handle slv, int it,
                                            int idx, double *res);
 AMGX_RC AMGX_solver_get_status(AMGX_solver_handle slv,
                                AMGX_SOLVE_STATUS *st);
+AMGX_RC AMGX_solver_get_setup_time(AMGX_solver_handle slv, double *t);
+AMGX_RC AMGX_solver_get_solve_time(AMGX_solver_handle slv, double *t);
 
 /* io */
 AMGX_RC AMGX_read_system(AMGX_matrix_handle mtx, AMGX_vector_handle rhs,

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Telemetry schema smoke check.
+
+Runs one AMG solve with telemetry enabled, writes the JSONL trace, and
+validates every record against the documented schema
+(``amgx_tpu.telemetry.export.validate_record`` — the same authority the
+tests use).  Exits nonzero on any drift: a missing required span, a
+record that stopped validating, a metric name that left the versioned
+``METRICS`` list.  Cheap enough for CI (runs on CPU in seconds).
+
+Usage: python scripts/telemetry_check.py [trace.jsonl]
+       (default: a temp file, removed on success)
+"""
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def fail(msg: str):
+    print(f"telemetry_check: FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main():
+    import numpy as np
+    import scipy.sparse as sp
+
+    import amgx_tpu as amgx
+    from amgx_tpu import telemetry
+
+    keep = len(sys.argv) > 1
+    if keep:
+        path = sys.argv[1]
+    else:
+        fd, path = tempfile.mkstemp(suffix=".jsonl",
+                                    prefix="amgx_telemetry_")
+        os.close(fd)
+        os.unlink(path)     # solver appends; start from nothing
+
+    n = 24
+    I = sp.identity(n)
+    T = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n))
+    A = sp.csr_matrix(sp.kron(I, T) + sp.kron(T, I))
+
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        f"out:telemetry=1, out:telemetry_path={path}")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    res = slv.solve(np.ones(A.shape[0]))
+    if int(res.status) != 0:
+        fail(f"smoke solve did not converge (status {res.status})")
+
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        fail(f"trace file was not written: {e}")
+
+    # 1. every record validates, header first, seq strictly increasing
+    try:
+        n_rec = telemetry.validate_jsonl(lines)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(str(e))
+    recs = [json.loads(l) for l in lines if l.strip()]
+
+    # 2. metric names are the versioned contract
+    for r in recs:
+        if r["kind"] in ("counter", "gauge", "hist") and \
+                r["name"] not in telemetry.METRICS:
+            fail(f"unregistered metric name {r['name']!r} "
+                 "(update telemetry.METRICS and the README list)")
+
+    # 3. required content of a telemetry=1 AMG solve
+    names_by_kind = {}
+    for r in recs:
+        names_by_kind.setdefault(r["kind"], set()).add(r["name"])
+    for kind, name in (("span_end", "setup"), ("span_end", "solve"),
+                       ("event", "hierarchy"), ("event", "residual"),
+                       ("counter", "amgx_spmv_dispatch_total"),
+                       ("gauge", "amgx_level_rows"),
+                       ("gauge", "amgx_level_nnz"),
+                       ("gauge", "amgx_operator_complexity"),
+                       ("gauge", "amgx_grid_complexity"),
+                       ("gauge", "amgx_solve_iterations"),
+                       ("gauge", "amgx_solve_final_relres")):
+        if name not in names_by_kind.get(kind, ()):
+            fail(f"trace is missing required {kind} {name!r}")
+
+    # 4. span begin/end pairing balances per sid
+    open_sids = set()
+    for r in recs:
+        if r["kind"] == "span_begin":
+            open_sids.add(r["sid"])
+        elif r["kind"] == "span_end":
+            if r["sid"] not in open_sids:
+                fail(f"span_end without begin: sid {r['sid']}")
+            open_sids.remove(r["sid"])
+    if open_sids:
+        fail(f"unclosed spans: sids {sorted(open_sids)}")
+
+    # 5. residual trail is consistent with the reported iterations
+    resid = [r for r in recs if r["kind"] == "event"
+             and r["name"] == "residual"]
+    if len(resid) != res.iterations + 1:
+        fail(f"{len(resid)} residual records for {res.iterations} "
+             "iterations (+1 initial expected)")
+
+    # 6. the Prometheus snapshot renders
+    text = telemetry.prometheus_text()
+    if "amgx_spmv_dispatch_total" not in text or "# TYPE" not in text:
+        fail("prometheus snapshot is missing expected series")
+
+    print(f"telemetry_check: OK — {n_rec} records validated "
+          f"({res.iterations} iterations, "
+          f"{len(names_by_kind.get('span_end', ()))} span names)")
+    if not keep:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    main()

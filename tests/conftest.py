@@ -35,6 +35,9 @@ def pytest_configure(config):
         "markers", "tpu: runs on the real TPU chip (pytest -m tpu)")
     config.addinivalue_line(
         "markers", "slow: nightly tier (pytest -m slow)")
+    config.addinivalue_line(
+        "markers", "telemetry: structured-telemetry fast tests "
+                   "(tier-1; pytest -m telemetry selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.
