@@ -1358,10 +1358,44 @@ class AMGHierarchy:
             telemetry.gauge_set("amgx_level_nnz", nnz, level=i)
         telemetry.gauge_set("amgx_operator_complexity", op_cmpl)
         telemetry.gauge_set("amgx_grid_complexity", grid_cmpl)
+        self._emit_cost_telemetry(sizes)
         telemetry.event("hierarchy", levels=len(sizes),
                         operator_complexity=round(op_cmpl, 6),
                         grid_complexity=round(grid_cmpl, 6),
                         setup_s=round(self.setup_time, 6))
+
+    def _emit_cost_telemetry(self, sizes):
+        """Per-level static cost descriptors (telemetry/costmodel.py):
+        modelled SpMV bytes/FLOPs and the padding-waste ratio of each
+        level's device pack — what turns recorded span durations into
+        achieved-vs-peak bandwidth fractions.  ``sizes`` is the
+        ``level_sizes()`` list, so the true nnz comes for free (no
+        device download just for telemetry)."""
+        from ..telemetry import costmodel
+        reg = telemetry.registry()
+        for name in ("amgx_level_spmv_bytes", "amgx_level_spmv_flops",
+                     "amgx_level_padding_waste"):
+            reg.gauge_clear(name)
+        # read packs only where they already exist (telemetry must not
+        # trigger a device upload as a side effect — `.Ad` would)
+        packs = [l._Ad if l._Ad is not None
+                 else getattr(l.A, "_device", None) for l in self.levels]
+        packs.append(getattr(self.coarsest, "_device", None))
+        for i, Ad in enumerate(packs):
+            if Ad is None:
+                continue
+            try:
+                cost = costmodel.spmv_cost(Ad, nnz=sizes[i][1])
+            except Exception:
+                continue      # a cost-model gap must never break setup
+            if cost.get("bytes_per_apply") is not None:
+                telemetry.gauge_set("amgx_level_spmv_bytes",
+                                    cost["bytes_per_apply"], level=i)
+                telemetry.gauge_set("amgx_level_spmv_flops",
+                                    cost["flops_per_apply"], level=i)
+                telemetry.gauge_set("amgx_level_padding_waste",
+                                    cost["padding_waste"], level=i)
+            telemetry.event("level_cost", level=i, **cost)
 
     def grid_stats(self) -> str:
         """Grid-stats table mirroring the reference README sample output."""

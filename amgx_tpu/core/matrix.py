@@ -223,6 +223,43 @@ def pack_kind(Ad) -> str:
     return fmt
 
 
+def padded_entries(Ad) -> Optional[int]:
+    """Stored-entry SLOTS of a device pack, padding included — the
+    denominator side of the padding-waste ratio
+    (``telemetry/costmodel.py``).  Every slot is read by the SpMV kernel
+    whether it holds a real nonzero or a pad zero, so slots − nnz is pure
+    wasted bandwidth.  None when the pack has no static slot count (an
+    implicit operator)."""
+    fmt = getattr(Ad, "fmt", "?")
+    if fmt == "dia":
+        return Ad.ell_width * Ad.n_rows          # nd diagonals × n rows
+    if fmt == "dia3":
+        return ((len(Ad.P.dia_offsets) * Ad.P.n_rows)
+                + (len(Ad.A.dia_offsets) * Ad.A.n_rows)
+                + (len(Ad.R.dia_offsets) * Ad.R.n_rows))
+    if fmt == "dense":
+        return Ad.n_rows * Ad.n_cols
+    if fmt == "sharded-ell":
+        return Ad.n_parts * Ad.n_loc * Ad.ell_width \
+            * Ad.block_dim * Ad.block_dim
+    if fmt == "ell":
+        b = Ad.block_dim
+        if getattr(Ad, "sh_vals", None) is not None:
+            T, n_tiles, Dpad, _pad, _L = Ad.sh_dims
+            return n_tiles * Dpad * T
+        if getattr(Ad, "bn_codes", None) is not None:
+            return int(Ad.bn_codes.size)
+        return Ad.n_rows * Ad.ell_width * b * b
+    if fmt == "csr":
+        if getattr(Ad, "bn_codes", None) is not None:
+            return int(Ad.bn_codes.size)
+        b = Ad.block_dim
+        ne = (Ad.vals.shape[0] if Ad.vals is not None
+              else (Ad.row_ids.shape[0] if Ad.row_ids is not None else 0))
+        return ne * b * b
+    return None
+
+
 def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
     """Row-aligned diagonal arrays of a CSR matrix: returns
     (offsets list, vals (nd, n)) with A[i, i+d_k] = vals[k, i], or None

@@ -44,7 +44,8 @@ from .partition import Partition, build_partition
                  "win_codes", "win_vals"],
     meta_fields=["n_global", "n_parts", "n_loc", "ell_width", "block_dim",
                  "axis", "dists", "dists2", "offsets", "win_tile",
-                 "mesh", "n_loc_cols", "col_offsets"],
+                 "mesh", "n_loc_cols", "col_offsets", "send_counts",
+                 "halo_counts", "halo_counts2", "bnd_counts"],
 )
 @dataclasses.dataclass(frozen=True)
 class ShardedMatrix:
@@ -90,6 +91,14 @@ class ShardedMatrix:
     #: own partition — halo exchange runs in that space; None ⇒ square
     n_loc_cols: Optional[int] = None
     col_offsets: Optional[tuple] = None
+    #: per-rank UNPADDED map sizes (static, from the Partition) — the
+    #: telemetry cost model reads these so halo byte counters report
+    #: both wire bytes (padded) and useful entries (analytic boundary
+    #: sizes); None on packs built before instrumentation cared
+    send_counts: Optional[tuple] = None
+    halo_counts: Optional[tuple] = None
+    halo_counts2: Optional[tuple] = None
+    bnd_counts: Optional[tuple] = None
 
     @property
     def n(self) -> int:
@@ -321,7 +330,11 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         dists=part.dists, dists2=r2.dists,
         offsets=tuple(int(o) for o in part.offsets), mesh=mesh,
         n_loc_cols=nlc if rect else None,
-        col_offsets=tuple(int(o) for o in col_offsets) if rect else None)
+        col_offsets=tuple(int(o) for o in col_offsets) if rect else None,
+        send_counts=tuple(int(c) for c in part.send_count),
+        halo_counts=tuple(int(c) for c in part.halo_count),
+        halo_counts2=tuple(int(c) for c in r2.halo_count),
+        bnd_counts=tuple(int(c) for c in part.bnd_count))
 
 
 def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
@@ -405,12 +418,91 @@ def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
         n_global=part.n_global, n_parts=n_parts, n_loc=n_loc,
         ell_width=K, block_dim=b, axis=axis,
         dists=part.dists, dists2=r2.dists,
-        offsets=tuple(int(o) for o in part.offsets), mesh=mesh)
+        offsets=tuple(int(o) for o in part.offsets), mesh=mesh,
+        send_counts=tuple(int(c) for c in part.send_count),
+        halo_counts=tuple(int(c) for c in part.halo_count),
+        halo_counts2=tuple(int(c) for c in r2.halo_count),
+        bnd_counts=tuple(int(c) for c in part.bnd_count))
 
 
 # --------------------------------------------------------------------------
 # distributed SpMV
 # --------------------------------------------------------------------------
+def uses_all_gather(dists: tuple, n_parts: int) -> bool:
+    """THE exchange-path predicate: dense link sets fall back from the
+    per-distance ppermute schedule to one all_gather.  Single authority
+    shared by the real exchange (:func:`_exchange`), the telemetry path
+    label (:func:`_tel_exchange`) and the cost model
+    (``telemetry.costmodel.halo_wire_bytes``) — three copies would
+    silently drift."""
+    return n_parts > 1 and len(dists) >= n_parts - 1
+
+
+def _tel_exchange(A: "ShardedMatrix", ring: int, op: str):
+    """Halo-exchange telemetry (one attribute check when off).
+
+    Like the SpMV dispatch counters (ops/spmv.py), this fires HOST-side
+    at dispatch/trace time — the compiled program is unchanged; under
+    ``jax.jit`` one traced exchange counts once per compilation, which
+    is exactly the static cost the comms PRs are judged by.  Wire bytes
+    count the PADDED send buffers every shard actually puts on the ICI
+    (one per ppermute hop, or P−1 under the all_gather fallback);
+    entries count the useful (analytic-boundary-size) halo values.
+    """
+    from ..telemetry import recorder as _trecorder
+    if not _trecorder.is_enabled():
+        return
+    from ..telemetry import costmodel as _tcost
+    from ..telemetry import metrics as _tmetrics
+    dists = A.dists if ring == 1 else A.dists2
+    path = "all_gather" if uses_all_gather(dists, A.n_parts) \
+        else "ppermute"
+    wire = _tcost.halo_wire_bytes(A, ring)
+    entries = _tcost.halo_entries(A, ring)
+    send_idx = A.send_idx if ring == 1 else A.send_idx2
+    _tmetrics.counter_inc("amgx_halo_exchange_total", ring=ring, op=op,
+                          path=path)
+    _tmetrics.counter_inc("amgx_halo_bytes_total", wire, ring=ring,
+                          op=op)
+    _tmetrics.counter_inc("amgx_halo_entries_total", entries, ring=ring,
+                          op=op)
+    _tmetrics.gauge_set("amgx_dist_ring_hops", len(dists), ring=ring)
+    counts = A.halo_counts if ring == 1 else A.halo_counts2
+    _trecorder.event(
+        "halo_exchange", op=op, ring=ring, path=path,
+        n_parts=A.n_parts, hops=len(dists),
+        send_buf=int(send_idx.shape[1]),
+        wire_bytes=int(wire), entries=int(entries),
+        per_rank_entries=None if counts is None else list(counts))
+
+
+def _tel_dist_spmv(A: "ShardedMatrix"):
+    """dist_spmv dispatch telemetry: the halo-exchange counters plus
+    per-device boundary/halo gauges (label ``device`` = shard index —
+    the SPMD program is identical per device; the per-rank numbers come
+    from the partition's static counts).  The interior-path choice is
+    carried by the dist_spmv span attrs."""
+    from ..telemetry import recorder as _trecorder
+    if not _trecorder.is_enabled():
+        return
+    from ..telemetry import metrics as _tmetrics
+    # NOTE: the dispatch counter (pack="sharded") is ops/spmv.py's job —
+    # incrementing it again here would double-count every distributed
+    # SpMV; the interior-path choice rides the span attrs instead
+    _tel_exchange(A, 1, "dist_spmv")
+    if A.bnd_counts is None:
+        return
+    offs = A.offsets
+    for p in range(A.n_parts):
+        rows = max((offs[p + 1] - offs[p]) if offs is not None
+                   else A.n_loc, 1)
+        _tmetrics.gauge_set("amgx_dist_boundary_fraction",
+                            A.bnd_counts[p] / rows, device=p)
+        if A.halo_counts is not None:
+            _tmetrics.gauge_set("amgx_dist_halo_entries",
+                                A.halo_counts[p], device=p)
+
+
 def _exchange(buf: jax.Array, dists: tuple, axis: str,
               n_parts: int) -> jax.Array:
     """Distance-wise neighbour exchange: rank p receives, for each d in
@@ -420,7 +512,7 @@ def _exchange(buf: jax.Array, dists: tuple, axis: str,
     one all_gather when the link set is dense."""
     if n_parts == 1:
         return buf
-    if len(dists) >= n_parts - 1:
+    if uses_all_gather(dists, n_parts):
         all_bufs = jax.lax.all_gather(buf, axis)        # (P, B[, b])
         i = jax.lax.axis_index(axis)
         order = (i + jnp.asarray(dists, jnp.int32)) % n_parts
@@ -442,21 +534,31 @@ def exchange_halo(A: ShardedMatrix, x: jax.Array, ring: int = 1
     ``exchange_halo``, rings machinery of ``vector.h:38-51``)."""
     if ring not in (1, 2):
         raise BadParametersError(f"halo ring must be 1 or 2, got {ring}")
-    axis = A.axis
-    send_idx = A.send_idx if ring == 1 else A.send_idx2
-    halo_src = A.halo_src if ring == 1 else A.halo_src2
-    dists = A.dists if ring == 1 else A.dists2
+    from ..telemetry import recorder as _trecorder
+    _tel_exchange(A, ring, "exchange_halo")
+    # span over the host-level call: real wall time when eager, the
+    # dispatch/trace cost under jit (the executed collective shows up in
+    # the device profile, not the host ring)
+    sid = _trecorder.span_begin("exchange_halo",
+                                {"ring": ring, "n_parts": A.n_parts})
+    try:
+        axis = A.axis
+        send_idx = A.send_idx if ring == 1 else A.send_idx2
+        halo_src = A.halo_src if ring == 1 else A.halo_src2
+        dists = A.dists if ring == 1 else A.dists2
 
-    def local(si, hs, xl):
-        buf = xl[si[0]]
-        got = _exchange(buf, dists, axis, A.n_parts)
-        return got[hs[0]][None]
+        def local(si, hs, xl):
+            buf = xl[si[0]]
+            got = _exchange(buf, dists, axis, A.n_parts)
+            return got[hs[0]][None]
 
-    return jax.shard_map(
-        local, mesh=A.mesh,
-        in_specs=(P(axis, None), P(axis, None), P(axis)),
-        out_specs=P(axis, None),
-    )(send_idx, halo_src, x)
+        return jax.shard_map(
+            local, mesh=A.mesh,
+            in_specs=(P(axis, None), P(axis, None), P(axis)),
+            out_specs=P(axis, None),
+        )(send_idx, halo_src, x)
+    finally:
+        _trecorder.span_end(sid, "exchange_halo")
 
 
 def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
@@ -478,6 +580,11 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     use_win = (A.win_blocks is not None
                and (A.mesh.devices.flat[0].platform == "tpu"
                     or _INTERPRET))
+    from ..telemetry import recorder as _trecorder
+    _tel_dist_spmv(A)
+    sid = _trecorder.span_begin(
+        "dist_spmv", {"n_parts": n_parts, "n_loc": A.n_loc,
+                      "interior": "win" if use_win else "gather"})
 
     def interior_gather(cols, vals, xfull0, _wb, _wc, _wv):
         return jnp.sum(vals * xfull0[cols], axis=1)
@@ -529,17 +636,21 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     wc = A.win_codes if A.win_codes is not None else zeros
     wv = A.win_vals if A.win_vals is not None else \
         jnp.zeros((n_parts, 1), A.vals.dtype)
-    return jax.shard_map(
-        local, mesh=A.mesh,
-        in_specs=(P(axis, None, None), P(axis, None, None),
-                  P(axis, None), P(axis, None), P(axis, None),
-                  P(axis, None), P(axis, None), P(axis, None),
-                  P(axis)),
-        out_specs=P(axis),
-        # the pallas_call's out_shape carries no varying-mesh-axes
-        # annotation — skip the vma check
-        check_vma=False,
-    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, wb, wc, wv, x)
+    try:
+        return jax.shard_map(
+            local, mesh=A.mesh,
+            in_specs=(P(axis, None, None), P(axis, None, None),
+                      P(axis, None), P(axis, None), P(axis, None),
+                      P(axis, None), P(axis, None), P(axis, None),
+                      P(axis)),
+            out_specs=P(axis),
+            # the pallas_call's out_shape carries no varying-mesh-axes
+            # annotation — skip the vma check
+            check_vma=False,
+        )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, wb, wc, wv,
+          x)
+    finally:
+        _trecorder.span_end(sid, "dist_spmv")
 
 
 def _dist_spmv_block(A: ShardedMatrix, x: jax.Array) -> jax.Array:
@@ -547,6 +658,11 @@ def _dist_spmv_block(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     exchange carries (B, b) block values, contractions are batched
     einsums (the b×b MXU path)."""
     axis, n_parts, b = A.axis, A.n_parts, A.block_dim
+    from ..telemetry import recorder as _trecorder
+    _tel_dist_spmv(A)
+    sid = _trecorder.span_begin(
+        "dist_spmv", {"n_parts": n_parts, "n_loc": A.n_loc,
+                      "interior": "block", "block_dim": b})
 
     def local(cols, vals, send_idx, halo_src, bnd_rows, xl):
         cols, vals = cols[0], vals[0]
@@ -571,12 +687,17 @@ def _dist_spmv_block(A: ShardedMatrix, x: jax.Array) -> jax.Array:
         yext = jnp.zeros((n_loc + 1, b), xl.dtype).at[bnd].add(hb)
         return (y0 + yext[:n_loc]).reshape(-1)
 
-    return jax.shard_map(
-        local, mesh=A.mesh,
-        in_specs=(P(axis, None, None), P(axis, None, None, None, None),
-                  P(axis, None), P(axis, None), P(axis, None), P(axis)),
-        out_specs=P(axis),
-    )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, x)
+    try:
+        return jax.shard_map(
+            local, mesh=A.mesh,
+            in_specs=(P(axis, None, None),
+                      P(axis, None, None, None, None),
+                      P(axis, None), P(axis, None), P(axis, None),
+                      P(axis)),
+            out_specs=P(axis),
+        )(A.cols, A.vals, A.send_idx, A.halo_src, A.bnd_rows, x)
+    finally:
+        _trecorder.span_end(sid, "dist_spmv")
 
 
 def vector_sharding(A: ShardedMatrix) -> NamedSharding:

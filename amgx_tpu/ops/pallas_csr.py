@@ -183,6 +183,18 @@ def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
     n_real = int(chunks_per_group.sum())
     L = n_real * Wp
     if L > max(_PAD_CAP * nnz, _PAD_FLOOR) or L >= (1 << 31):
+        # plan rejected: say WHY in the trace so the doctor can report
+        # "fell back to segment-sum: over padding budget by N×" (or the
+        # int32 index-space limit) instead of a bare fallback counter
+        from ..telemetry import recorder as _trecorder
+        if _trecorder.is_enabled():
+            over_pad = L > max(_PAD_CAP * nnz, _PAD_FLOOR)
+            _trecorder.event(
+                "binned_plan_rejected", rows=int(n), nnz=int(nnz),
+                padded=int(L), pad_cap=float(_PAD_CAP),
+                reason="padding_budget" if over_pad else "index_space",
+                over_budget=(round(L / max(_PAD_CAP * nnz, 1.0), 3)
+                             if over_pad else None))
         return None
     chunk_off = np.concatenate([[0], np.cumsum(chunks_per_group)[:-1]])
     # entry placement: entry q of its (row, segment) run lands in chunk

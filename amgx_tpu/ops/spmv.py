@@ -13,6 +13,8 @@ in :mod:`amgx_tpu.distributed.spmv`.
 """
 from __future__ import annotations
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 
@@ -21,17 +23,44 @@ from ..telemetry import metrics as _tmetrics
 from ..telemetry import recorder as _trecorder
 
 
-def _tel_pack(pack: str, fallback: str = None):
+#: operators whose cost descriptor was already emitted — id-keyed WEAK
+#: map checked by identity, so repeated dispatches of one live operator
+#: emit one op_cost event, while a recycled id from a dead pack (e.g.
+#: after resetup) correctly re-emits for the new operator.  (A WeakSet
+#: would need hashing, and frozen dataclasses holding jax arrays are
+#: unhashable.)
+_COST_SEEN = weakref.WeakValueDictionary()
+
+
+def _tel_pack(pack: str, fallback: str = None, A=None):
     """Pack-selection telemetry: count the dispatch decision (and, when
     a packed kernel layout had to take a generic path, the fallback).
     SpMV dispatch runs at trace time, so this is host-side and free in
-    the compiled program; one attribute check when telemetry is off."""
+    the compiled program; one attribute check when telemetry is off.
+
+    When the dispatched matrix is passed, its static cost descriptor
+    (telemetry/costmodel.py: bytes/FLOPs per apply, padding waste) is
+    emitted once per operator as an ``op_cost`` event — the doctor's
+    roofline arithmetic reads these straight from the trace."""
     if not _trecorder.is_enabled():
         return
     _tmetrics.counter_inc("amgx_spmv_dispatch_total", pack=pack)
     if fallback is not None:
         _tmetrics.counter_inc("amgx_spmv_fallback_total", pack=pack,
                               reason=fallback)
+    if A is None:
+        return
+    if _COST_SEEN.get(id(A)) is A:
+        return
+    try:
+        _COST_SEEN[id(A)] = A
+    except TypeError:
+        return          # non-weakref-able operator type: skip the event
+    try:
+        from ..telemetry import costmodel
+        _trecorder.event("op_cost", **costmodel.spmv_cost(A))
+    except Exception:
+        pass      # a cost-model gap must never break SpMV dispatch
 
 
 def spmv(A, x: jax.Array) -> jax.Array:
@@ -42,7 +71,7 @@ def spmv(A, x: jax.Array) -> jax.Array:
     """
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
-        _tel_pack("sharded")
+        _tel_pack("sharded", A=A)
         return dist_spmv(A, x)
     if A.fmt == "dia3":
         # Galerkin composition R·(A·(P·x)) — three DIA streams instead
@@ -58,9 +87,9 @@ def spmv(A, x: jax.Array) -> jax.Array:
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
                 and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)):
-            _tel_pack("dia/kernel")
+            _tel_pack("dia/kernel", A=A)
             return dia_spmv(A, x)
-        _tel_pack("dia/slices")
+        _tel_pack("dia/slices", A=A)
         # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
         # padded copy of x — no gathers (reference SpMV kernel dispatch
         # multiply.cu:94-110; this is the TPU-optimal stencil path)
@@ -78,7 +107,7 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.fmt == "dense":
         # small scattered coarse operator: one MXU matvec (HIGHEST
         # precision keeps the f32 product exact — the matrices are tiny)
-        _tel_pack("dense")
+        _tel_pack("dense", A=A)
         return jnp.dot(A.vals, x,
                        precision=jax.lax.Precision.HIGHEST)
     if A.fmt == "ell":
@@ -87,20 +116,20 @@ def spmv(A, x: jax.Array) -> jax.Array:
             if shift_supported(A):
                 # tile-DIA shift kernel: VPU shift-aligned streams, no
                 # per-entry column data (locally-banded matrices)
-                _tel_pack("ell/shift")
+                _tel_pack("ell/shift", A=A)
                 return shift_spmv(A, x)
             from .pallas_ell import ell_window_spmv, ell_window_supported
             if ell_window_supported(A):
                 # gather-free windowed one-hot kernel (XLA lowers the
                 # x[cols] gather to a scalar loop — ~100× slower)
-                _tel_pack("ell/window")
+                _tel_pack("ell/window", A=A)
                 return ell_window_spmv(A, x)
             from .pallas_csr import binned_spmv, binned_supported
             if binned_supported(A):
                 # general-sparsity binned sliced-ELL kernel: scattered
                 # matrices past the shift/window gates stay off the
                 # gather cliff (ops/pallas_csr.py)
-                _tel_pack("ell/binned")
+                _tel_pack("ell/binned", A=A)
                 return binned_spmv(A, x)
             # cols: (n, K); vals: (n, K); x: (m,) — via the views so a
             # LEAN shift/window pack (vals/cols deleted; the kernel
@@ -111,18 +140,19 @@ def spmv(A, x: jax.Array) -> jax.Array:
                       if (getattr(A, "sh_vals", None) is not None
                           or getattr(A, "win_codes", None) is not None
                           or getattr(A, "bn_codes", None) is not None)
-                      else None)
+                      else None, A=A)
             return jnp.sum(A.ell_vals_view() * x[A.ell_cols_view()],
                            axis=1)
         from .pallas_csr import binned_spmv, binned_supported
         if binned_supported(A):
             # the pack carries the block matrix's SCALAR expansion —
             # x is already the flat scalar vector
-            _tel_pack("ell/binned")
+            _tel_pack("ell/binned", A=A)
             return binned_spmv(A, x)
         _tel_pack("ell/block-gather",
                   fallback="kernel_gate_rejected"
-                  if getattr(A, "bn_codes", None) is not None else None)
+                  if getattr(A, "bn_codes", None) is not None else None,
+                  A=A)
         xb = x.reshape(A.n_cols, b)
         xg = xb[A.cols]                      # (n, K, b)
         y = jnp.einsum("nkab,nkb->na", A.vals, xg,
@@ -132,23 +162,25 @@ def spmv(A, x: jax.Array) -> jax.Array:
     from .pallas_csr import (binned_entries_view, binned_spmv,
                              binned_supported)
     if binned_supported(A):
-        _tel_pack("csr/binned")
+        _tel_pack("csr/binned", A=A)
         return binned_spmv(A, x)
     if b == 1:
         if A.vals is None:
             # lean binned pack on a backend the kernel cannot serve:
             # reconstruct the gather-form triplets from the planes
-            _tel_pack("csr/segsum-lean", fallback="kernel_gate_rejected")
+            _tel_pack("csr/segsum-lean",
+                      fallback="kernel_gate_rejected", A=A)
             rows, cols, vals = binned_entries_view(A)
             prod = vals * x[cols]
             return jax.ops.segment_sum(prod, rows,
                                        num_segments=A.n_rows)
         _tel_pack("csr/segsum",
                   fallback="kernel_gate_rejected"
-                  if getattr(A, "bn_codes", None) is not None else None)
+                  if getattr(A, "bn_codes", None) is not None else None,
+                  A=A)
         prod = A.vals * x[A.cols]
         return jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
-    _tel_pack("csr/block-segsum")
+    _tel_pack("csr/block-segsum", A=A)
     xb = x.reshape(A.n_cols, b)
     prod = jnp.einsum("eab,eb->ea", A.vals, xb[A.cols],
                       preferred_element_type=A.vals.dtype)

@@ -210,6 +210,22 @@ class Solver:
                                    self.setup_time)
             telemetry.gauge_set("amgx_last_setup_seconds",
                                 self.setup_time)
+            if self.Ad is not None:
+                # the fine operator's static cost descriptor
+                # (telemetry/costmodel.py): bytes/FLOPs per apply,
+                # padding waste, halo wire bytes when sharded — the
+                # doctor pairs it with span durations for
+                # achieved-vs-peak fractions
+                try:
+                    from ..telemetry import costmodel
+                    telemetry.event(
+                        "operator_cost", solver=self.config_name,
+                        **costmodel.spmv_cost(
+                            self.Ad,
+                            nnz=self.A.nnz if self.A is not None
+                            else None))
+                except Exception:
+                    pass    # a cost-model gap must never break setup
             if self.telemetry_path:
                 telemetry.flush_jsonl(self.telemetry_path)
         return self

@@ -13,9 +13,14 @@ the grid-stats table:
   jit recompiles, phase durations, hierarchy complexities, per-solve
   iteration/residual gauges;
 * **exporters** (:mod:`.export`): JSONL traces (``dump_jsonl`` /
-  incremental ``flush_jsonl``) and a Prometheus text snapshot
-  (``prometheus_text``), plus the schema validator used by
-  ``scripts/telemetry_check.py``.
+  incremental ``flush_jsonl``), a Prometheus text snapshot
+  (``prometheus_text``), the schema validator used by
+  ``scripts/telemetry_check.py``, and the multi-process session merger
+  (``aggregate_sessions`` — one mesh-wide view of per-rank traces);
+* **analysis** (PR 3): :mod:`.costmodel` (static bytes/FLOPs/padding
+  descriptors per SpMV pack, roofline fractions), :mod:`.tracefile`
+  (Chrome-trace export — view a solve in Perfetto), :mod:`.doctor`
+  (``python -m amgx_tpu.telemetry.doctor trace.jsonl`` diagnosis).
 
 Everything is **off by default** and compiled down to one attribute
 check per instrument; enable globally with :func:`enable`, per config
@@ -24,27 +29,36 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import export, metrics, recorder
-from .export import (dump_jsonl, flush_jsonl, prometheus_text,
-                     validate_jsonl, validate_record)
+from . import costmodel, export, metrics, recorder, tracefile
+from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
+                     prometheus_text, read_sessions, validate_jsonl,
+                     validate_record)
 from .metrics import (METRICS, counter_inc, gauge_set, hist_observe,
                       registry)
 from .recorder import (SCHEMA_VERSION, Capture, capture, clear, disable,
-                       enable, event, is_enabled, records, span)
+                       dropped_count, enable, event, is_enabled, records,
+                       span)
+from .tracefile import (chrome_trace, validate_chrome_trace,
+                        write_chrome_trace)
 
 __all__ = [
     "SCHEMA_VERSION", "METRICS", "Capture",
     "enable", "disable", "is_enabled", "capture", "clear", "records",
-    "span", "event",
+    "span", "event", "dropped_count",
     "counter_inc", "gauge_set", "hist_observe", "registry",
     "dump_jsonl", "flush_jsonl", "prometheus_text",
     "validate_record", "validate_jsonl",
+    "read_sessions", "aggregate_sessions",
+    "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
+    "costmodel",
     "reset",
 ]
 
 
 def reset():
-    """Drop buffered records and zero the metrics registry (test/bench
-    isolation helper; recording stays in whatever on/off state it was)."""
+    """Drop buffered records, zero the metrics registry and the
+    ring-overflow counter (test/bench isolation helper; recording stays
+    in whatever on/off state it was)."""
     recorder.clear()
+    recorder.reset_dropped()
     metrics.registry().reset()

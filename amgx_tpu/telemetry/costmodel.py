@@ -1,0 +1,211 @@
+"""Static per-op cost descriptors: bytes moved and FLOPs per apply.
+
+The ROADMAP north star is "as fast as the hardware allows" — which is a
+statement about bytes and FLOPs, not wall seconds.  This module
+translates every SpMV pack the dispatcher can choose
+(:func:`amgx_tpu.core.matrix.pack_kind`: dia / dia3 Galerkin
+composition / tile-DIA shift / windowed one-hot / binned sliced-ELL /
+ELL gather / CSR segment-sum / dense / sharded) into a hardware-terms
+descriptor:
+
+* ``bytes_per_apply`` — HBM traffic of one ``y = A·x`` (value planes +
+  index planes + the x/y vectors), using the same per-layout formulas
+  ``bench.py`` uses for its effective-GB/s numbers;
+* ``flops_per_apply`` — ``2·nnz`` useful flops (pad slots multiply
+  zeros: bandwidth waste, not compute);
+* ``padding_waste`` — stored SLOTS ÷ nnz (1.0 = no padding; the
+  binned-ELL plan's padding budget is exactly a bound on this);
+* for sharded packs additionally ``halo_bytes_per_apply`` — the ICI
+  wire bytes of one halo exchange (padded send buffers, every ring).
+
+Pair a descriptor with a recorded span duration to get achieved
+bandwidth and roofline fraction (:func:`achieved_gbs`,
+:func:`roofline_fraction`) — the numbers every perf PR is judged with.
+
+Everything here is host-side arithmetic on pack SHAPES (no device
+compute, no transfers), so it is safe to call at setup time under
+telemetry.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+#: public TPU v5e HBM roofline (16 GB @ 819 GB/s) — bench.py's number
+HBM_PEAK_GBS = 819.0
+#: per-link ICI bandwidth class of a v5e (one direction, GB/s) — used
+#: for halo-exchange roofline fractions; override per topology
+ICI_PEAK_GBS = 186.0
+
+_INDEX_BYTES = 4          # int32 column/row ids
+
+
+def _vec_bytes(n_rows, n_cols, itemsize):
+    """x read once + y written once (gather-free layouts stream them)."""
+    return (n_rows + n_cols) * itemsize
+
+
+def spmv_cost(Ad, nnz: Optional[int] = None) -> dict:
+    """Cost descriptor of one ``y = A·x`` on device pack ``Ad``.
+
+    ``nnz``: the true stored-nonzero count when the caller knows it
+    (host Matrix levels do); estimated from the padded slot count
+    otherwise (``estimated=True`` in the result then flags every
+    nnz-derived field as an upper bound).
+    """
+    from ..core.matrix import pack_kind, padded_entries
+    fmt = getattr(Ad, "fmt", "?")
+    pack = pack_kind(Ad)
+    itemsize = np.dtype(Ad.dtype).itemsize
+    slots = padded_entries(Ad)
+    estimated = nnz is None
+    if nnz is None:
+        nnz = slots
+    out = {"pack": pack, "fmt": fmt, "dtype": str(np.dtype(Ad.dtype)),
+           "itemsize": itemsize, "estimated": estimated,
+           "nnz": None if nnz is None else int(nnz),
+           "padded_entries": None if slots is None else int(slots)}
+    if fmt == "op" or slots is None:
+        out.update(bytes_per_apply=None, flops_per_apply=None,
+                   padding_waste=None)
+        return out
+    out["flops_per_apply"] = 2 * int(nnz)
+    out["padding_waste"] = round(slots / max(int(nnz), 1), 4)
+
+    if fmt == "dia":
+        n = Ad.n_rows
+        byt = (Ad.ell_width + 2) * n * itemsize
+    elif fmt == "dia3":
+        # Galerkin composition R·(A·(P·x)): each factor's diagonal rows
+        # stream once, plus the two intermediates and x/y
+        nd3 = (len(Ad.P.dia_offsets) + len(Ad.A.dia_offsets)
+               + len(Ad.R.dia_offsets) + 6)
+        byt = nd3 * Ad.n_rows * itemsize
+    elif fmt == "dense":
+        byt = (Ad.n_rows * Ad.n_cols) * itemsize \
+            + _vec_bytes(Ad.n_rows, Ad.n_cols, itemsize)
+    elif fmt == "sharded-ell":
+        return _sharded_cost(Ad, out, itemsize, slots)
+    elif fmt == "ell" and getattr(Ad, "sh_vals", None) is not None:
+        # tile-DIA shift kernel: class-value rows + per-class x windows
+        # + y; no per-entry column data at all
+        T, n_tiles, Dpad, _pad, _L = Ad.sh_dims
+        byt = (n_tiles * Dpad * (T + (T // 128 + 1) * 128)
+               + Ad.n_rows) * itemsize
+    elif fmt == "ell" and getattr(Ad, "win_codes", None) is not None:
+        # windowed one-hot kernel: int16 codes + values + block ids +
+        # the VMEM-staged x tiles + y
+        K, T = Ad.ell_width, Ad.win_tile
+        n_pad = Ad.win_codes.size // K if Ad.win_codes.ndim == 1 \
+            else Ad.win_codes.shape[0]
+        byt = (n_pad * K * (itemsize + Ad.win_codes.dtype.itemsize)
+               + Ad.win_blocks.size * _INDEX_BYTES
+               + _vec_bytes(Ad.n_rows, Ad.n_cols, itemsize))
+    elif getattr(Ad, "bn_codes", None) is not None:
+        # binned sliced-ELL kernel: codes+vals planes stream once, one
+        # (Sb, 128) x segment per chunk, y once
+        L = int(Ad.bn_codes.size)
+        C = int(Ad.bn_dims[0])
+        Sb = int(Ad.bn_dims[4])
+        byt = L * (_INDEX_BYTES + itemsize) \
+            + C * Sb * 128 * itemsize + Ad.n_rows * itemsize
+    elif fmt == "ell":
+        # gather form: values + int32 columns + x/y
+        byt = slots * itemsize \
+            + Ad.n_rows * Ad.ell_width * _INDEX_BYTES \
+            + _vec_bytes(Ad.n, Ad.n_cols * Ad.block_dim, itemsize)
+    else:
+        # CSR segment-sum: vals + int32 cols/row_ids + x/y
+        byt = slots * itemsize \
+            + (slots // max(Ad.block_dim ** 2, 1)) * 2 * _INDEX_BYTES \
+            + _vec_bytes(Ad.n, Ad.n_cols * Ad.block_dim, itemsize)
+    out["bytes_per_apply"] = int(byt)
+    return out
+
+
+def _sharded_cost(A, out: dict, itemsize: int, slots: int) -> dict:
+    """Sharded-ELL descriptor: per-shard local streaming + the halo
+    exchange's ICI wire bytes (padded send buffers — what actually
+    crosses the links, not just the useful entries)."""
+    P = A.n_parts
+    # local interior/boundary compute: per-shard ELL gather (or the
+    # windowed kernel — same value/index planes) over [local | halo]
+    byt = slots * itemsize \
+        + P * A.n_loc * A.ell_width * _INDEX_BYTES \
+        + 2 * P * A.n_loc * A.block_dim * itemsize
+    out["bytes_per_apply"] = int(byt)
+    out["halo_bytes_per_apply"] = int(halo_wire_bytes(A, ring=1))
+    out["halo_entries_per_apply"] = int(halo_entries(A, ring=1))
+    out["n_parts"] = P
+    return out
+
+
+# ----------------------------------------------------------- halo costs
+def _ring_arrays(A, ring: int):
+    if ring == 1:
+        return A.send_idx, A.halo_src, A.dists
+    return A.send_idx2, A.halo_src2, A.dists2
+
+
+def halo_wire_bytes(A, ring: int = 1) -> int:
+    """ICI bytes one ring-``ring`` exchange moves, mesh-wide: every
+    shard sends its full PADDED (B,) buffer once per ppermute distance
+    (or P−1 times under the all_gather fallback) — padding crosses the
+    wire, which is why this is the counter the MULTICHIP bench series
+    watches."""
+    send_idx, _, dists = _ring_arrays(A, ring)
+    P = A.n_parts
+    if P == 1:
+        return 0
+    from ..distributed.matrix import uses_all_gather
+    B = send_idx.shape[1]
+    itemsize = np.dtype(A.dtype).itemsize * max(A.block_dim, 1)
+    hops = (P - 1) if uses_all_gather(dists, P) else len(dists)
+    return P * hops * B * itemsize
+
+
+def halo_entries(A, ring: int = 1) -> int:
+    """USEFUL halo values gathered per exchange (unpadded, mesh-wide):
+    the analytic boundary size of the partition when the pack carries
+    per-rank counts, else the padded H upper bound."""
+    counts = A.halo_counts if ring == 1 else A.halo_counts2
+    if counts is not None:
+        return int(sum(counts))
+    _, halo_src, _ = _ring_arrays(A, ring)
+    return A.n_parts * halo_src.shape[1]
+
+
+# ------------------------------------------------------------- rollups
+def hierarchy_cost(levels_costs) -> dict:
+    """Roll per-level descriptors (one :func:`spmv_cost` dict per
+    level, fine→coarse) into hierarchy totals: one V-cycle visits every
+    level's operator, so the totals bound the per-cycle traffic."""
+    byt = [c.get("bytes_per_apply") for c in levels_costs]
+    flp = [c.get("flops_per_apply") for c in levels_costs]
+    nnz = [c.get("nnz") for c in levels_costs]
+    slots = [c.get("padded_entries") for c in levels_costs]
+    tot_nnz = sum(z for z in nnz if z)
+    tot_slots = sum(s for s in slots if s)
+    return {
+        "levels": list(levels_costs),
+        "total_bytes_per_cycle": sum(b for b in byt if b),
+        "total_flops_per_cycle": sum(f for f in flp if f),
+        "padding_waste": round(tot_slots / max(tot_nnz, 1), 4),
+        "halo_bytes_per_cycle": sum(
+            c.get("halo_bytes_per_apply", 0) or 0 for c in levels_costs),
+    }
+
+
+# ---------------------------------------------------- achieved vs peak
+def achieved_gbs(bytes_moved: float, duration_s: float) -> float:
+    """Achieved bandwidth of ``bytes_moved`` in ``duration_s``."""
+    if not duration_s or duration_s <= 0:
+        return 0.0
+    return bytes_moved / duration_s / 1e9
+
+
+def roofline_fraction(gbs: float, peak_gbs: float = HBM_PEAK_GBS
+                      ) -> float:
+    """Fraction of a bandwidth roofline actually achieved."""
+    return gbs / peak_gbs if peak_gbs > 0 else 0.0

@@ -1,0 +1,431 @@
+"""Solve doctor: turn a JSONL telemetry trace into a diagnosis.
+
+``python -m amgx_tpu.telemetry.doctor trace.jsonl [more.jsonl ...]``
+reads one or more trace files (multi-process sessions merge into one
+mesh-wide view via :func:`amgx_tpu.telemetry.export.aggregate_sessions`)
+and prints what a performance engineer would ask the trace first:
+
+* where the wall time went (phase histograms + the span table),
+* what SpMV packs were chosen and what fell back, with the cost-model
+  view (bytes/flops per level, padding waste),
+* the distributed picture: halo wire bytes vs local work, boundary
+  fractions, ring hops,
+* the convergence trajectory: iterations, final residual, and
+  plateau/stall detection over the per-iteration residual events,
+* concrete hints ("level 3 fell back to segment-sum: over padding
+  budget by 2.1×", "trace truncated: raise telemetry_ring_size", ...).
+
+``--json`` prints the machine-readable diagnosis instead.  Everything
+is host-side file parsing — no device work, no compiles.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional, Tuple
+
+from .export import aggregate_sessions
+
+#: trailing per-iteration reduction factor above which the residual is
+#: considered plateaued (a healthy AMG-preconditioned solve reduces
+#: ~10× per iteration; 0.97 ≈ 3%/iter is going nowhere)
+PLATEAU_FACTOR = 0.97
+PLATEAU_MIN_ITERS = 5
+#: padding-waste ratio past which a level pack earns a hint
+WASTE_HINT = 2.0
+#: levels smaller than this never earn a padding hint (tiny coarse
+#: grids pad by construction and cost microseconds)
+WASTE_MIN_ROWS = 4096
+#: halo-vs-local byte ratio past which the solve reads comms-bound
+HALO_HINT = 0.5
+
+
+def _label_get(labels: Tuple, key: str):
+    for k, v in labels:
+        if k == key:
+            return v
+    return None
+
+
+def _fmt_bytes(b) -> str:
+    if b is None:
+        return "?"
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(b) < 1024.0:
+            return f"{b:.1f} {unit}" if unit != "B" else f"{int(b)} B"
+        b /= 1024.0
+    return f"{b:.1f} TB"
+
+
+def _residual_trails(agg) -> List[List[Tuple[int, float]]]:
+    """Per-solve residual trajectories: the residual events of each
+    session, split into trails wherever iteration restarts at 0."""
+    trails: List[List[Tuple[int, float]]] = []
+    for s in agg["sessions"]:
+        cur: List[Tuple[int, float]] = []
+        for r in s["records"]:
+            if r["kind"] != "event" or r["name"] != "residual":
+                continue
+            it = r["attrs"].get("iteration")
+            nrm = r["attrs"].get("norm")
+            if not isinstance(it, int):
+                continue
+            if isinstance(nrm, str):      # "Infinity"/"NaN" tokens
+                nrm = float(nrm.replace("Infinity", "inf")
+                            .replace("NaN", "nan"))
+            if it == 0 and cur:
+                trails.append(cur)
+                cur = []
+            cur.append((it, float(nrm)))
+        if cur:
+            trails.append(cur)
+    return trails
+
+
+def _plateau(trail: List[Tuple[int, float]]) -> Optional[dict]:
+    """Longest trailing run of per-iteration reduction factors above
+    PLATEAU_FACTOR (stall = factor ≥ 1).  None when converging fine."""
+    if len(trail) < PLATEAU_MIN_ITERS + 1:
+        return None
+    norms = [n for _, n in trail]
+    run = 0
+    stalled = 0
+    for a, b in zip(norms[-2::-1], norms[:0:-1]):   # backwards pairs
+        if a <= 0:
+            break
+        f = b / a
+        if f > PLATEAU_FACTOR:
+            run += 1
+            if f >= 1.0:
+                stalled += 1
+        else:
+            break
+    if run >= PLATEAU_MIN_ITERS:
+        return {"iterations": run, "from_iteration": trail[-1 - run][0],
+                "stalled": stalled, "norm": norms[-1]}
+    return None
+
+
+def diagnose(paths: List[str]) -> dict:
+    """Machine-readable diagnosis of one or more JSONL traces."""
+    agg = aggregate_sessions(paths)
+    counters, gauges = agg["counters"], agg["gauges"]
+
+    def csum(name, **match):
+        tot = 0.0
+        by = {}
+        for (n, lk), v in counters.items():
+            if n != name:
+                continue
+            if any(_label_get(lk, k) != str(mv)
+                   for k, mv in match.items()):
+                continue
+            tot += v
+            by[",".join(f"{k}={v2}" for k, v2 in lk) or "_"] = v
+        return tot, by
+
+    def glast(name):
+        out = {}
+        for (n, lk), v in gauges.items():
+            if n == name:
+                out[lk] = v
+        return out
+
+    # ---- phases (top-level only: the hist samples) ------------------
+    phases = {}
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] != "hist" or not r["name"].startswith("amgx_") \
+                    or not r["name"].endswith("_seconds"):
+                continue
+            key = r["name"][len("amgx_"):-len("_seconds")]
+            d = phases.setdefault(key, {"count": 0, "total_s": 0.0})
+            d["count"] += 1
+            d["total_s"] = round(d["total_s"] + float(r["value"]), 6)
+
+    # ---- packs + fallbacks ------------------------------------------
+    _, packs = csum("amgx_spmv_dispatch_total")
+    _, fallbacks = csum("amgx_spmv_fallback_total")
+
+    # ---- hierarchy + cost model -------------------------------------
+    levels = {}
+    for lk, v in glast("amgx_level_rows").items():
+        levels.setdefault(str(_label_get(lk, "level")), {})["rows"] = v
+    for lk, v in glast("amgx_level_nnz").items():
+        levels.setdefault(str(_label_get(lk, "level")), {})["nnz"] = v
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] == "event" and r["name"] == "level_cost":
+                lvl = str(r["attrs"].get("level"))
+                levels.setdefault(lvl, {}).update(
+                    {k: v for k, v in r["attrs"].items()
+                     if k != "level"})
+    op_cost = None
+    op_costs = {}              # pack -> last dispatched cost descriptor
+    rejected = []
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] != "event":
+                continue
+            if r["name"] == "operator_cost":
+                op_cost = r["attrs"]
+            elif r["name"] == "op_cost":
+                # keep the LARGEST operator per pack — a hierarchy
+                # dispatches many dia levels and the fine one is the
+                # number worth showing next to the dispatch count
+                pk = str(r["attrs"].get("pack", "?"))
+                prev = op_costs.get(pk)
+                if prev is None or (r["attrs"].get("bytes_per_apply")
+                                    or 0) > (prev.get("bytes_per_apply")
+                                             or 0):
+                    op_costs[pk] = r["attrs"]
+            elif r["name"] == "binned_plan_rejected":
+                rejected.append(r["attrs"])
+
+    # ---- distributed ------------------------------------------------
+    halo_bytes, halo_by = csum("amgx_halo_bytes_total")
+    halo_entries, _ = csum("amgx_halo_entries_total")
+    exchanges, _ = csum("amgx_halo_exchange_total")
+    bnd = {str(_label_get(lk, "device")): v
+           for lk, v in glast("amgx_dist_boundary_fraction").items()}
+    local_bytes = sum(float(d.get("bytes_per_apply") or 0)
+                      for d in levels.values())
+    if not local_bytes and op_cost:
+        local_bytes = float(op_cost.get("bytes_per_apply") or 0)
+    halo_per_apply = None
+    if op_cost and op_cost.get("halo_bytes_per_apply"):
+        halo_per_apply = float(op_cost["halo_bytes_per_apply"])
+    halo_local_ratio = None
+    if halo_per_apply and local_bytes:
+        halo_local_ratio = round(halo_per_apply / local_bytes, 4)
+
+    # ---- convergence ------------------------------------------------
+    conv = {}
+    for name, key in (("amgx_solve_iterations", "iterations"),
+                      ("amgx_solve_final_relres", "final_relres"),
+                      ("amgx_solve_convergence_rate", "rate")):
+        g = glast(name)
+        if g:
+            conv[key] = list(g.values())[-1]
+    trails = _residual_trails(agg)
+    plateau = _plateau(trails[-1]) if trails else None
+    divergences = agg["events"].get("divergence", 0)
+
+    # ---- hints ------------------------------------------------------
+    hints: List[str] = []
+    if agg["dropped_records"]:
+        hints.append(
+            f"trace truncated: {int(agg['dropped_records'])} records "
+            "dropped by ring overflow — raise telemetry_ring_size (or "
+            "flush more often via telemetry_path)")
+    for lbl, cnt in sorted(fallbacks.items()):
+        hints.append(f"SpMV fallback {lbl}: {int(cnt)}× — a packed "
+                     "kernel layout took a generic path")
+    for rej in rejected:
+        over = rej.get("over_budget")
+        lvl = rej.get("level")
+        where = f"level {lvl}" if lvl is not None else \
+            f"a {rej.get('rows', '?')}-row operator"
+        if isinstance(over, (int, float)):
+            hints.append(f"{where} fell back to segment-sum: over "
+                         f"padding budget by {over:.1f}×")
+        elif rej.get("reason") == "index_space":
+            hints.append(f"{where} fell back to segment-sum: the "
+                         "binned plan exceeds the int32 index space")
+        else:
+            hints.append(f"{where} fell back to segment-sum (binned "
+                         "plan rejected)")
+    for lvl, d in sorted(levels.items(), key=lambda kv: str(kv[0])):
+        w = d.get("padding_waste")
+        rows = d.get("rows") or 0
+        # tiny coarse levels waste bandwidth by construction and cost
+        # nothing — only flag levels big enough to matter
+        if isinstance(w, (int, float)) and w > WASTE_HINT \
+                and rows >= WASTE_MIN_ROWS:
+            hints.append(
+                f"level {lvl} pack {d.get('pack', '?')} wastes "
+                f"{w:.2f}× bandwidth on padding slots")
+    if halo_local_ratio is not None and halo_local_ratio > HALO_HINT:
+        hints.append(
+            f"halo exchange moves {halo_local_ratio:.2f}× the local "
+            "SpMV bytes — the solve is communication-bound; consider "
+            "fewer, fatter shards or overlapping more work")
+    if plateau:
+        hints.append(
+            f"residual plateaued for {plateau['iterations']} iterations "
+            f"(from iteration {plateau['from_iteration']}, "
+            f"norm ~{plateau['norm']:.3e})"
+            + (" and STALLED outright" if plateau["stalled"] else "")
+            + " — consider a stronger smoother/preconditioner or check "
+              "the operator's conditioning")
+    if divergences:
+        hints.append(f"{int(divergences)} divergence event(s): a "
+                     "residual went non-finite")
+    jit, _ = csum("amgx_jit_compile_total")
+    if jit:
+        hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
+                     "landed inside a timed region, warm up first")
+
+    return {
+        "files": list(paths),
+        "sessions": agg["n_sessions"], "records": agg["n_records"],
+        "dropped_records": agg["dropped_records"],
+        "phases": phases,
+        "spans": {k: dict(v, total_s=round(v["total_s"], 6))
+                  for k, v in agg["spans"].items()},
+        "packs": {k: int(v) for k, v in sorted(packs.items())},
+        "fallbacks": {k: int(v) for k, v in sorted(fallbacks.items())},
+        "levels": levels,
+        "operator_cost": op_cost,
+        "op_costs": op_costs,
+        "distributed": {
+            "halo_exchanges": int(exchanges),
+            "halo_wire_bytes": int(halo_bytes),
+            "halo_entries": int(halo_entries),
+            "halo_bytes_by_label": {k: int(v)
+                                    for k, v in sorted(halo_by.items())},
+            "boundary_fraction": bnd,
+            "halo_local_ratio": halo_local_ratio,
+        },
+        "convergence": dict(conv, trails=len(trails),
+                            plateau=plateau, divergences=int(divergences)),
+        "hints": hints,
+    }
+
+
+def render(d: dict) -> str:
+    """Human-readable report of a :func:`diagnose` result."""
+    L: List[str] = []
+    L.append("amgx solve doctor")
+    L.append("=" * 60)
+    L.append(f"trace: {', '.join(d['files'])}")
+    L.append(f"sessions: {d['sessions']}   records: {d['records']}"
+             + (f"   DROPPED: {d['dropped_records']}"
+                if d["dropped_records"] else ""))
+
+    if d["phases"]:
+        L.append("")
+        L.append("phase breakdown (top-level)")
+        L.append("-" * 40)
+        for k, v in sorted(d["phases"].items(),
+                           key=lambda kv: -kv[1]["total_s"]):
+            L.append(f"  {k:<10} {v['total_s']:>10.4f} s"
+                     f"  ({v['count']}×)")
+    if d["spans"]:
+        L.append("")
+        L.append("span totals (nested; top 12 by time)")
+        L.append("-" * 40)
+        top = sorted(d["spans"].items(),
+                     key=lambda kv: -kv[1]["total_s"])[:12]
+        for k, v in top:
+            L.append(f"  {k:<28} {v['total_s']:>10.4f} s"
+                     f"  ({v['count']}×)")
+
+    if d["packs"]:
+        L.append("")
+        L.append("SpMV pack choices")
+        L.append("-" * 40)
+        for k, v in d["packs"].items():
+            # per-pack cost from the dispatch-time op_cost events —
+            # covers operators no hierarchy level describes (raw spmv,
+            # non-AMG solvers)
+            # dispatch labels refine the pack_kind names ("dia/slices"
+            # vs the descriptor's "dia") — fall back to the base kind
+            pack_name = k.split("pack=", 1)[-1]
+            c = d.get("op_costs", {}).get(pack_name) or \
+                d.get("op_costs", {}).get(pack_name.split("/")[0])
+            extra = ""
+            if c and c.get("bytes_per_apply"):
+                extra = (f"   {_fmt_bytes(c['bytes_per_apply'])}/apply"
+                         f", waste {c.get('padding_waste', '?')}")
+            L.append(f"  {k:<28} {v}{extra}")
+        for k, v in d["fallbacks"].items():
+            L.append(f"  FALLBACK {k:<19} {v}")
+
+    if d["levels"]:
+        L.append("")
+        L.append("hierarchy cost model (per level)")
+        L.append("-" * 40)
+        L.append(f"  {'lvl':<4}{'rows':>10}{'nnz':>12}{'pack':>14}"
+                 f"{'bytes/apply':>14}{'waste':>8}")
+        for lvl, x in sorted(d["levels"].items(),
+                             key=lambda kv: int(kv[0])
+                             if str(kv[0]).isdigit() else 99):
+            L.append(
+                f"  {lvl:<4}"
+                f"{int(x.get('rows', 0)):>10}"
+                f"{int(x.get('nnz', 0)):>12}"
+                f"{str(x.get('pack', '?')):>14}"
+                f"{_fmt_bytes(x.get('bytes_per_apply')):>14}"
+                + (f"{x['padding_waste']:>8.2f}"
+                   if isinstance(x.get("padding_waste"), (int, float))
+                   else f"{'?':>8}"))
+
+    dist = d["distributed"]
+    if dist["halo_exchanges"]:
+        L.append("")
+        L.append("distributed / halo exchange")
+        L.append("-" * 40)
+        L.append(f"  exchanges traced:   {dist['halo_exchanges']}")
+        L.append(f"  wire bytes (padded): "
+                 f"{_fmt_bytes(dist['halo_wire_bytes'])}")
+        L.append(f"  useful halo entries: {dist['halo_entries']}")
+        if dist["halo_local_ratio"] is not None:
+            L.append(f"  halo/local bytes:    "
+                     f"{dist['halo_local_ratio']:.3f}")
+        for dev, f in sorted(dist["boundary_fraction"].items()):
+            L.append(f"  boundary fraction [device {dev}]: {f:.3f}")
+
+    conv = d["convergence"]
+    if conv:
+        L.append("")
+        L.append("convergence")
+        L.append("-" * 40)
+        if "iterations" in conv:
+            L.append(f"  iterations:   {int(conv['iterations'])}")
+        if "final_relres" in conv:
+            L.append(f"  final relres: {conv['final_relres']:.3e}")
+        if "rate" in conv and isinstance(conv.get("rate"), (int, float)):
+            L.append(f"  reduction/iter: {conv['rate']:.3f}")
+        if conv.get("divergences"):
+            L.append(f"  DIVERGENCES:  {conv['divergences']}")
+
+    L.append("")
+    if d["hints"]:
+        L.append("hints")
+        L.append("-" * 40)
+        for h in d["hints"]:
+            L.append(f"  * {h}")
+    else:
+        L.append("hints: none — the trace looks healthy")
+    return "\n".join(L) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    paths = [a for a in argv if a != "--json"]
+    if not paths:
+        print("usage: python -m amgx_tpu.telemetry.doctor "
+              "<trace.jsonl> [more.jsonl ...] [--json]",
+              file=sys.stderr)
+        return 2
+    try:
+        d = diagnose(paths)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"doctor: cannot read trace: {e}", file=sys.stderr)
+        return 1
+    if as_json:
+        # a diverged solve restores "Infinity" gauge tokens to real
+        # floats for the math above — re-sanitize so the output stays
+        # strict JSON (jq-parseable), like every other exporter here
+        from .export import _sanitize
+        print(json.dumps(_sanitize(d), indent=2, default=str,
+                         allow_nan=False))
+    else:
+        print(render(d), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,11 @@ from __future__ import annotations
 
 import json
 import math
+import os
+import socket
 import threading
+import time
+import uuid
 from typing import IO, Iterable, List, Optional, Union
 
 from . import metrics, recorder
@@ -33,11 +37,28 @@ _KINDS = ("meta", "span_begin", "span_end", "event", "counter", "gauge",
 _flush_lock = threading.Lock()
 #: per-path high-water sequence number for incremental flushes
 _flushed_seq = {}
+#: per-path ring-overflow count at the last flush (drop detection)
+_flushed_dropped = {}
+
+#: one id per writing process — the session identity the aggregator and
+#: the Chrome-trace exporter key on (a telemetry_path appended by every
+#: rank of a multi-process mesh holds one meta per session)
+_SESSION_ID = uuid.uuid4().hex[:12]
 
 
 def _meta_record() -> dict:
+    """Session header.  ``pid``/``session`` identify the writing process
+    (what :func:`aggregate_sessions` merges on); ``t_perf``/``t_unix``
+    sample both clocks at write time so sessions from different
+    processes — whose ``perf_counter`` epochs are unrelated — can be
+    aligned onto one wall-clock timeline; ``dropped`` is the cumulative
+    ring-overflow count so a truncated trace is detectable."""
     return {"kind": "meta", "name": "amgx-telemetry",
-            "schema": recorder.SCHEMA_VERSION}
+            "schema": recorder.SCHEMA_VERSION,
+            "pid": os.getpid(), "session": _SESSION_ID,
+            "host": socket.gethostname(),
+            "t_perf": time.perf_counter(), "t_unix": time.time(),
+            "dropped": recorder.dropped_count()}
 
 
 _NONFINITE = {"NaN": math.nan, "Infinity": math.inf,
@@ -103,12 +124,12 @@ def validate_record(rec: dict):
              "metric missing numeric value")
 
 
-def validate_jsonl(lines: Iterable[str]) -> int:
-    """Validate an iterable of JSONL lines; returns the record count.
+def _iter_validated(lines: Iterable[str]):
+    """Parse-and-validate generator over JSONL lines (each line parsed
+    exactly once — ring-sized traces dominate the aggregator's cost).
     The first non-empty line must be the meta header; ``seq`` must be
     strictly increasing within a session (each appending session
     restates the meta header, after which ``seq`` may restart)."""
-    n = 0
     last_seq = 0
     first = True
     for line in lines:
@@ -136,10 +157,14 @@ def validate_jsonl(lines: Iterable[str]) -> int:
                 raise ValueError(
                     f"seq not increasing: {rec['seq']} after {last_seq}")
             last_seq = rec["seq"]
-        n += 1
+        yield rec
     if first:
         raise ValueError("empty trace: no records")
-    return n
+
+
+def validate_jsonl(lines: Iterable[str]) -> int:
+    """Validate an iterable of JSONL lines; returns the record count."""
+    return sum(1 for _ in _iter_validated(lines))
 
 
 def dump_jsonl(path_or_file: Union[str, IO],
@@ -173,6 +198,22 @@ def flush_jsonl(path: str) -> int:
     with _flush_lock:
         first_flush = path not in _flushed_seq
         last = _flushed_seq.get(path, 0)
+        # ring overflow since the last flush to this path: the evicted
+        # records are gone, so say so IN the trace (the doctor reports
+        # it) rather than leaving a silently truncated file
+        dropped = recorder.dropped_count()
+        last_dropped = _flushed_dropped.get(path, 0)
+        if dropped < last_dropped:
+            # recorder.reset_dropped() (telemetry.reset) zeroed the
+            # counter since the last flush — restart the high-water or
+            # every later overflow would hide below the stale mark
+            last_dropped = 0
+        if dropped > last_dropped:
+            recorder.event("ring_overflow",
+                           dropped=dropped - last_dropped,
+                           dropped_total=dropped,
+                           ring_size=recorder._STATE.ring_size)
+        _flushed_dropped[path] = dropped
         recs = [r for r in recorder.records() if r["seq"] > last]
         if first_flush or recs:
             with open(path, "a") as f:
@@ -184,6 +225,106 @@ def flush_jsonl(path: str) -> int:
         return len(recs)
 
 
+# ------------------------------------------------------- session merging
+def _restore_nonfinite(v):
+    """Inverse of :func:`_sanitize` for VALUE fields read back from a
+    trace: the string tokens become floats again so aggregation and the
+    doctor's math see real non-finite numbers."""
+    if isinstance(v, str) and v in _NONFINITE:
+        return _NONFINITE[v]
+    return v
+
+
+def read_sessions(source: Union[str, Iterable[str]]) -> List[dict]:
+    """Parse one JSONL trace into its writing sessions.
+
+    ``source``: a path or an iterable of lines.  Returns one dict per
+    session — ``{"meta": <meta record>, "records": [...]}`` — split at
+    the meta headers (each appending process restates one; PR 2's
+    validator contract).  The lines are validated on the way in, so a
+    drifted trace fails loudly here rather than mis-merging."""
+    if isinstance(source, str):
+        with open(source) as f:
+            lines = f.readlines()
+    else:
+        lines = list(source)
+    sessions: List[dict] = []
+    for rec in _iter_validated(lines):
+        if rec["kind"] == "meta":
+            sessions.append({"meta": rec, "records": []})
+        else:
+            if "value" in rec:
+                rec["value"] = _restore_nonfinite(rec["value"])
+            sessions[-1]["records"].append(rec)
+    return sessions
+
+
+def aggregate_sessions(paths: Union[str, Iterable[str]]) -> dict:
+    """Merge multi-process JSONL traces into one mesh-wide view.
+
+    ``paths``: one path, or an iterable of paths (one per process/rank —
+    or a single file every rank appended to; both layouts hold one meta
+    header per session).  Returns::
+
+        {"sessions":  [{"meta": ..., "records": [...]}, ...],
+         "n_sessions": int, "n_records": int,
+         "dropped_records": int,          # ring-overflow total
+         "counters": {(name, labelitems): sum},   # mesh-wide sums
+         "gauges":   {(name, labelitems): last},  # last write wins
+         "spans":    {name: {"count": n, "total_s": s}},
+         "events":   {name: count}}
+
+    Counter samples are summed across sessions — that is what makes the
+    per-rank halo byte counters a single mesh-wide total; spans keep
+    per-name totals (wall-clock overlap across processes is the Chrome
+    trace's job, :mod:`amgx_tpu.telemetry.tracefile`)."""
+    if isinstance(paths, str):
+        paths = [paths]
+    sessions: List[dict] = []
+    for p in paths:
+        sessions.extend(read_sessions(p))
+    counters: dict = {}
+    gauges: dict = {}
+    spans: dict = {}
+    events: dict = {}
+    # meta.dropped and the ring_overflow events' dropped_total are
+    # CUMULATIVE per-process counters — merge with max within one
+    # process identity (bench appends one session per case from the
+    # same process; summing their metas would overcount), sum across
+    # distinct processes
+    dropped_by_proc: dict = {}
+    for i, s in enumerate(sessions):
+        proc = (s["meta"].get("pid"), s["meta"].get("session")) \
+            if s["meta"].get("session") else ("?", i)
+        s_dropped = int(s["meta"].get("dropped", 0) or 0)
+        for r in s["records"]:
+            kind = r["kind"]
+            if kind == "counter":
+                key = (r["name"], tuple(sorted(r["labels"].items())))
+                counters[key] = counters.get(key, 0) + r["value"]
+            elif kind == "gauge":
+                key = (r["name"], tuple(sorted(r["labels"].items())))
+                gauges[key] = r["value"]
+            elif kind == "span_end":
+                d = spans.setdefault(r["name"],
+                                     {"count": 0, "total_s": 0.0})
+                d["count"] += 1
+                d["total_s"] += r["dur"]
+            elif kind == "event":
+                events[r["name"]] = events.get(r["name"], 0) + 1
+                if r["name"] == "ring_overflow":
+                    s_dropped = max(s_dropped, int(
+                        r["attrs"].get("dropped_total", 0) or 0))
+        dropped_by_proc[proc] = max(dropped_by_proc.get(proc, 0),
+                                    s_dropped)
+    dropped = sum(dropped_by_proc.values())
+    return {"sessions": sessions, "n_sessions": len(sessions),
+            "n_records": sum(len(s["records"]) for s in sessions),
+            "dropped_records": dropped,
+            "counters": counters, "gauges": gauges,
+            "spans": spans, "events": events}
+
+
 def _prom_num(v: float) -> str:
     if math.isnan(v):
         return "NaN"
@@ -192,10 +333,18 @@ def _prom_num(v: float) -> str:
     return repr(float(v))
 
 
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double-quote and newline must be escaped or the series line is
+    unparseable (a pack name or file path label can carry any of them)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _prom_labels(lk) -> str:
     if not lk:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in lk) + "}"
+    return "{" + ",".join(f'{k}="{_prom_escape(v)}"' for k, v in lk) + "}"
 
 
 def prometheus_text() -> str:

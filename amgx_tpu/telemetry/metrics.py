@@ -55,6 +55,32 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("gauge", "wall seconds of the last solver setup"),
     "amgx_last_solve_seconds":
         ("gauge", "wall seconds of the last solve"),
+    # ---- distributed / halo-exchange instrumentation (PR 3) --------
+    "amgx_halo_exchange_total":
+        ("counter", "halo exchanges instrumented (traced) "
+                    "{ring,op,path}"),
+    "amgx_halo_bytes_total":
+        ("counter", "ICI wire bytes per instrumented halo exchange, "
+                    "mesh-wide, padded send buffers {ring,op}"),
+    "amgx_halo_entries_total":
+        ("counter", "useful (unpadded) halo values gathered per "
+                    "instrumented exchange, mesh-wide {ring,op}"),
+    "amgx_dist_boundary_fraction":
+        ("gauge", "boundary rows / local rows of one shard {device}"),
+    "amgx_dist_halo_entries":
+        ("gauge", "ring-1 halo width of one shard {device}"),
+    "amgx_dist_ring_hops":
+        ("gauge", "ppermute hop count of the ring schedule {ring}"),
+    # ---- static cost model (telemetry/costmodel.py) ----------------
+    "amgx_level_spmv_bytes":
+        ("gauge", "modelled HBM bytes of one SpMV on one hierarchy "
+                  "level {level}"),
+    "amgx_level_spmv_flops":
+        ("gauge", "useful flops (2*nnz) of one SpMV on one hierarchy "
+                  "level {level}"),
+    "amgx_level_padding_waste":
+        ("gauge", "stored slots / nnz of one level's device pack "
+                  "{level}"),
     "amgx_setup_seconds":
         ("histogram", "solver setup wall seconds"),
     "amgx_resetup_seconds":

@@ -43,7 +43,7 @@ DEFAULT_RING_SIZE = 65536
 
 
 class _State:
-    __slots__ = ("enabled", "ring", "ring_size", "lock", "seq")
+    __slots__ = ("enabled", "ring", "ring_size", "lock", "seq", "dropped")
 
     def __init__(self):
         self.enabled = False
@@ -51,6 +51,9 @@ class _State:
         self.ring = collections.deque(maxlen=self.ring_size)
         self.lock = threading.Lock()
         self.seq = 0
+        #: records evicted by ring overflow (cumulative) — a truncated
+        #: trace is detectable instead of silently undercounting
+        self.dropped = 0
 
 
 _STATE = _State()
@@ -104,6 +107,20 @@ def clear():
         _STATE.ring.clear()
 
 
+def dropped_count() -> int:
+    """Records evicted by ring overflow since process start (or the last
+    :func:`reset_dropped`).  Exporters surface this so a truncated trace
+    is detectable — the deque otherwise drops the oldest silently."""
+    return _STATE.dropped
+
+
+def reset_dropped():
+    """Zero the overflow counter (test/bench isolation, via
+    ``telemetry.reset``)."""
+    with _STATE.lock:
+        _STATE.dropped = 0
+
+
 def _jsonable(v: Any):
     """Coerce a value into something ``json.dumps`` accepts: numpy
     scalars → python numbers, sequences element-wise, everything
@@ -131,6 +148,8 @@ def _append(rec: dict):
     with _STATE.lock:
         _STATE.seq += 1
         rec["seq"] = _STATE.seq
+        if len(_STATE.ring) == _STATE.ring.maxlen:
+            _STATE.dropped += 1       # the deque evicts its oldest record
         _STATE.ring.append(rec)
 
 
@@ -228,6 +247,8 @@ class Capture:
         #: True when the scope produced more records than the ring
         #: holds — the oldest were evicted and aggregates undercount
         self.truncated = False
+        #: ring-overflow evictions that happened during the scope
+        self.dropped = 0
 
     def kind(self, kind: str) -> List[dict]:
         return [r for r in self.records if r["kind"] == kind]
@@ -314,6 +335,7 @@ def capture(ring_size: Optional[int] = None):
     enable(ring_size)
     with _STATE.lock:
         seq0 = _STATE.seq
+        dropped0 = _STATE.dropped
     cap = Capture()
     try:
         yield cap
@@ -321,6 +343,7 @@ def capture(ring_size: Optional[int] = None):
         with _STATE.lock:
             cap.records = [r for r in _STATE.ring if r["seq"] > seq0]
             produced = _STATE.seq - seq0
+            cap.dropped = _STATE.dropped - dropped0
             if _STATE.ring_size != prev_size:
                 _STATE.ring_size = prev_size
                 _STATE.ring = collections.deque(_STATE.ring,

@@ -1,0 +1,193 @@
+"""Chrome-trace export: view an amgx solve in Perfetto.
+
+Converts the span/event/metric ring (or a JSONL trace file, including
+multi-process ones) into Trace Event Format JSON — the format
+``chrome://tracing`` and https://ui.perfetto.dev load directly:
+
+* every writing session becomes one *process* track (``pid`` from the
+  session's meta header, falling back to a synthetic index), with its
+  recording threads as thread tracks — a multi-process mesh run shows
+  one lane per rank;
+* ``span_begin``/``span_end`` pairs become complete (``"X"``) slices
+  with the begin record's ``attrs`` as slice args;
+* ``event`` records become instants (``"i"``);
+* counter samples become counter (``"C"``) tracks with the RUNNING SUM
+  (the trace format draws absolute values), gauges track their last
+  written value.
+
+Timestamps: record ``t`` is ``perf_counter`` seconds, whose epoch is
+per-process.  Session meta headers carry a paired
+(``t_perf``, ``t_unix``) clock sample, so sessions are aligned onto one
+wall-clock timeline; a headerless record list falls back to t − min(t).
+"""
+from __future__ import annotations
+
+import json
+from typing import IO, List, Optional, Union
+
+from . import recorder
+from .export import _sanitize, read_sessions
+
+#: trace-event phases this exporter emits (telemetry_check validates)
+PHASES = ("X", "i", "C", "M")
+
+
+def _args(d: dict) -> dict:
+    return {str(k): v for k, v in _sanitize(d or {}).items()}
+
+
+def _session_events(records: List[dict], pid: int, offset_s: float,
+                    label: str) -> List[dict]:
+    """Trace events of one session; ``offset_s`` maps the session's
+    perf_counter timeline onto the merged timeline."""
+    out: List[dict] = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": label},
+    }]
+
+    def ts(t):
+        # microseconds, clamped — a tiny negative from clock-sample
+        # skew would make Perfetto drop the whole track
+        return max((t + offset_s) * 1e6, 0.0)
+
+    begins = {}             # sid -> span_begin record
+    counters = {}           # (name, labels) -> running sum
+    for r in records:
+        kind = r["kind"]
+        if kind == "span_begin":
+            begins[r["sid"]] = r
+        elif kind == "span_end":
+            b = begins.pop(r["sid"], None)
+            t1 = r["t"]
+            dur = r.get("dur", 0.0) or 0.0
+            out.append({
+                "ph": "X", "name": r["name"], "pid": pid,
+                "tid": r["tid"], "ts": ts(t1 - dur),
+                "dur": max(dur * 1e6, 0.0),
+                "args": _args(b["attrs"] if b else {}),
+            })
+        elif kind == "event":
+            out.append({
+                "ph": "i", "name": r["name"], "pid": pid,
+                "tid": r["tid"], "ts": ts(r["t"]), "s": "t",
+                "args": _args(r.get("attrs", {})),
+            })
+        elif kind in ("counter", "gauge", "hist"):
+            v = r["value"]
+            if isinstance(v, str):      # "Infinity" tokens: not plottable
+                continue
+            lbl = r["name"]
+            if r["labels"]:
+                lbl += "{" + ",".join(
+                    f"{k}={v2}" for k, v2 in
+                    sorted(r["labels"].items())) + "}"
+            if kind == "counter":
+                counters[lbl] = counters.get(lbl, 0) + v
+                v = counters[lbl]
+            elif kind == "hist":
+                continue                # durations already shown as spans
+            out.append({
+                "ph": "C", "name": lbl, "pid": pid, "tid": 0,
+                "ts": ts(r["t"]), "args": {"value": v},
+            })
+    # unmatched begins (an open span at flush time): emit as instants so
+    # the work is visible rather than silently dropped
+    for b in begins.values():
+        out.append({"ph": "i", "name": b["name"] + " (open)", "pid": pid,
+                    "tid": b["tid"], "ts": ts(b["t"]), "s": "t",
+                    "args": _args(b["attrs"])})
+    return out
+
+
+def chrome_trace(source: Union[None, str, List[str], List[dict]] = None
+                 ) -> dict:
+    """Build the Trace Event Format dict.
+
+    ``source``: None → the current ring contents (one synthetic
+    session); a path or list of paths → JSONL trace file(s), one process
+    track per session; a list of ring records → one synthetic session.
+    """
+    if source is None:
+        sessions = [{"meta": None, "records": recorder.records()}]
+    elif isinstance(source, str):
+        sessions = read_sessions(source)
+    elif source and isinstance(source[0], str):
+        sessions = []
+        for p in source:
+            sessions.extend(read_sessions(p))
+    else:
+        sessions = [{"meta": None, "records": list(source or [])}]
+
+    # wall-clock alignment: offset each session so its records land at
+    # (t_unix of session start) + (t − t_perf); relative to the earliest
+    # session so timestamps stay small
+    t0s = []
+    for s in sessions:
+        m = s["meta"] or {}
+        if "t_perf" in m and "t_unix" in m:
+            t0s.append(m["t_unix"] - m["t_perf"])
+    base = min(t0s) if t0s else None
+    events: List[dict] = []
+    for i, s in enumerate(sessions):
+        m = s["meta"] or {}
+        pid = int(m.get("pid", i + 1))
+        label = f"amgx pid {pid}"
+        if m.get("session"):
+            label += f" [{m['session']}]"
+        if m.get("host"):
+            label += f" @{m['host']}"
+        if base is not None and "t_perf" in m:
+            offset = (m["t_unix"] - m["t_perf"]) - base
+        else:
+            ts_all = [r["t"] for r in s["records"]]
+            offset = -min(ts_all) if ts_all else 0.0
+        events.extend(_session_events(s["records"], pid, offset, label))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path_or_file: Union[str, IO],
+                       source: Union[None, str, List] = None) -> int:
+    """Write the trace-event JSON; returns the event count.  The output
+    loads in Perfetto / ``chrome://tracing`` as-is."""
+    trace = chrome_trace(source)
+
+    def write(f):
+        json.dump(trace, f, allow_nan=False)
+
+    if isinstance(path_or_file, str):
+        with open(path_or_file, "w") as f:
+            write(f)
+    else:
+        write(path_or_file)
+    return len(trace["traceEvents"])
+
+
+def validate_chrome_trace(trace: dict) -> int:
+    """Structural validation against the trace-event schema subset this
+    exporter emits (``scripts/telemetry_check.py`` calls this); returns
+    the event count, raises ``ValueError`` on drift."""
+    def need(cond, msg):
+        if not cond:
+            raise ValueError(f"chrome trace schema: {msg}")
+
+    need(isinstance(trace, dict), "not an object")
+    evs = trace.get("traceEvents")
+    need(isinstance(evs, list), "missing traceEvents list")
+    for e in evs:
+        need(isinstance(e, dict), f"event is not an object: {e!r}")
+        need(e.get("ph") in PHASES, f"unknown phase {e.get('ph')!r}")
+        need(isinstance(e.get("name"), str) and e["name"],
+             f"missing name: {e!r}")
+        need(isinstance(e.get("pid"), int), f"missing pid: {e!r}")
+        need(isinstance(e.get("tid"), int), f"missing tid: {e!r}")
+        if e["ph"] != "M":
+            need(isinstance(e.get("ts"), (int, float)) and e["ts"] >= 0,
+                 f"bad ts: {e!r}")
+        if e["ph"] == "X":
+            need(isinstance(e.get("dur"), (int, float))
+                 and e["dur"] >= 0, f"bad dur: {e!r}")
+        if "args" in e:
+            need(isinstance(e["args"], dict), f"bad args: {e!r}")
+    # the whole thing must be strict JSON (Perfetto rejects bare NaN)
+    json.dumps(trace, allow_nan=False)
+    return len(evs)

@@ -1,0 +1,122 @@
+"""Filter known-benign XLA noise off stderr, keeping a raw sidecar.
+
+The multichip dryrun forces the CPU platform in a fresh process, and
+the persistent compile cache then replays CPU-AOT executables compiled
+on a machine with a different feature set.  XLA's ``cpu_aot_loader``
+logs every mismatch as a multi-kilobyte host-feature dump straight to
+fd 2 — the captured ``tail`` in ``MULTICHIP_r*.json`` drowned in it,
+so a REAL failure (an assert, a traceback) was unreadable.
+
+These warnings come from C++ (absl/tsl logging), so a ``sys.stderr``
+wrapper never sees them: :func:`install_stderr_filter` splices a pipe
+onto fd 2 and a reader thread routes each line — known-benign XLA noise
+goes to a raw sidecar file (nothing is thrown away), everything else
+passes through to the original stderr unchanged.  At exit, one short
+summary line says how many lines were filtered and where they live.
+
+Scope: installed explicitly by entry points that need a readable tail
+(``__graft_entry__.dryrun_multichip``); never at library import.
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+#: a line containing ANY of these is known-benign XLA CPU-AOT noise
+BENIGN_PATTERNS = (
+    b"cpu_aot_loader.cc",
+    b"Machine type used for XLA:CPU compilation",
+    b"could lead to execution errors such as SIGILL",
+    b"is not  supported on the host machine",
+    b"vs host machine features:",
+)
+
+_installed = False
+
+
+def is_benign(line: bytes) -> bool:
+    return any(p in line for p in BENIGN_PATTERNS)
+
+
+def install_stderr_filter(sidecar_path: Optional[str] = None
+                          ) -> Optional[str]:
+    """Splice the fd-level filter onto stderr (idempotent).
+
+    ``sidecar_path``: where filtered lines are kept raw; default
+    ``$AMGX_XLA_NOISE_SIDECAR`` or ``<tmpdir>/amgx_xla_noise_<pid>.log``.
+    Returns the sidecar path (None when already installed).
+    """
+    global _installed
+    if _installed:
+        return None
+    sidecar_path = sidecar_path or os.environ.get(
+        "AMGX_XLA_NOISE_SIDECAR") or os.path.join(
+        tempfile.gettempdir(), f"amgx_xla_noise_{os.getpid()}.log")
+    try:
+        orig_fd = os.dup(2)
+        rd, wr = os.pipe()
+        os.dup2(wr, 2)
+        os.close(wr)
+    except OSError:
+        return None             # exotic fd setup: leave stderr alone
+    _installed = True
+    sys.stderr.flush()
+    state = {"filtered": 0}
+
+    def pump():
+        sidecar = None
+        buf = b""
+        while True:
+            try:
+                chunk = os.read(rd, 65536)
+            except OSError:
+                break
+            if not chunk:
+                break
+            buf += chunk
+            *lines, buf = buf.split(b"\n")
+            for line in lines:
+                if is_benign(line):
+                    state["filtered"] += 1
+                    if sidecar is None:
+                        sidecar = open(sidecar_path, "ab")
+                    sidecar.write(line + b"\n")
+                    sidecar.flush()
+                else:
+                    os.write(orig_fd, line + b"\n")
+        if buf:
+            os.write(orig_fd, buf)
+        if sidecar is not None:
+            sidecar.close()
+
+    pump_thread = threading.Thread(target=pump, daemon=True,
+                                   name="amgx-xla-noise-filter")
+    pump_thread.start()
+
+    def restore_and_summarize():
+        # restore the real stderr FIRST (a crash traceback written
+        # between here and process death must not land in a pipe nobody
+        # reads), then close the pipe's write end so the pump sees EOF
+        # and drains whatever is still buffered — without this, bytes
+        # written just before exit (exactly the failure case this
+        # module must keep readable) die with the daemon thread
+        sys.stderr.flush()
+        try:
+            os.dup2(orig_fd, 2)     # also drops the pipe write end
+        except OSError:
+            pass
+        pump_thread.join(timeout=2.0)
+        if state["filtered"]:
+            # one short, honest line in the real stream: noise was
+            # filtered, not lost — the raw sidecar has every byte
+            os.write(orig_fd,
+                     (f"[xla-noise] {state['filtered']} benign XLA "
+                      f"CPU-AOT warning lines filtered -> "
+                      f"{sidecar_path}\n").encode())
+
+    atexit.register(restore_and_summarize)
+    return sidecar_path

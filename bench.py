@@ -106,6 +106,14 @@ def _run_case(oracle, make_matrix, cfg, dtype, sync_shape=None,
         out = _run_case_inner(oracle, make_matrix, cfg, dtype, sync_shape,
                               keep)
     out["telemetry"] = _tel_case_summary(tel)
+    # AMGX_BENCH_TELEMETRY_PATH: also append each case's raw trace as
+    # one JSONL session — what `python -m amgx_tpu.telemetry.doctor`
+    # and the Perfetto export ingest (multi-case files hold one meta
+    # header per case, the multi-session layout the validator accepts)
+    trace_path = os.environ.get("AMGX_BENCH_TELEMETRY_PATH")
+    if trace_path:
+        with open(trace_path, "a") as f:
+            telemetry.dump_jsonl(f, tel.records)
     return out
 
 
@@ -123,6 +131,27 @@ def _tel_case_summary(tel):
                            "total_s": round(sum(r["value"] for r in rs),
                                             4)}
     iters = tel.gauge_last("amgx_solve_iterations")
+    # cost-model view (telemetry/costmodel.py): the fine operator's
+    # bytes/FLOPs per apply + padding waste, and the halo-exchange wire
+    # totals when the case ran distributed — so BENCH logs carry the
+    # hardware-terms numbers, not just wall seconds
+    opc = tel.events("operator_cost")
+    cost = None
+    if opc:
+        a = opc[-1]["attrs"]
+        cost = {k: a.get(k) for k in
+                ("pack", "bytes_per_apply", "flops_per_apply",
+                 "padding_waste", "halo_bytes_per_apply")
+                if a.get(k) is not None}
+    halo_bytes = tel.counter_total("amgx_halo_bytes_total")
+    halo = None
+    if halo_bytes:
+        halo = {
+            "wire_bytes": int(halo_bytes),
+            "entries": int(tel.counter_total("amgx_halo_entries_total")),
+            "exchanges": int(tel.counter_total(
+                "amgx_halo_exchange_total")),
+        }
     return {
         "packs": {str(k): int(v) for k, v in sorted(
             tel.counter_totals("amgx_spmv_dispatch_total",
@@ -131,6 +160,8 @@ def _tel_case_summary(tel):
         "iterations": int(iters) if iters is not None else None,
         "jit_traces": int(tel.counter_total("amgx_jit_trace_total")),
         "jit_compiles": int(tel.counter_total("amgx_jit_compile_total")),
+        **({"operator_cost": cost} if cost else {}),
+        **({"halo": halo} if halo else {}),
     }
 
 

@@ -122,9 +122,42 @@ def main():
     if "amgx_spmv_dispatch_total" not in text or "# TYPE" not in text:
         fail("prometheus snapshot is missing expected series")
 
+    # 7. the Chrome-trace export is structurally valid trace-event JSON
+    # (one process track, spans as X slices, counters as C tracks) and
+    # survives a strict-JSON round trip — what Perfetto actually loads
+    trace = telemetry.chrome_trace(path)
+    try:
+        n_ev = telemetry.validate_chrome_trace(trace)
+    except ValueError as e:
+        fail(f"chrome trace: {e}")
+    phases = {e["ph"] for e in trace["traceEvents"]}
+    if not {"X", "i", "C", "M"} <= phases:
+        fail(f"chrome trace is missing event phases: {phases}")
+    names = {e["name"] for e in trace["traceEvents"]}
+    if "setup" not in names or "solve" not in names:
+        fail("chrome trace is missing the setup/solve slices")
+    json.loads(json.dumps(trace, allow_nan=False))   # strict round trip
+
+    # 8. the solve doctor ingests the trace and reports the sections the
+    # acceptance criteria name (phase breakdown, cost model, packs)
+    from amgx_tpu.telemetry import doctor
+    diag = doctor.diagnose([path])
+    for key, cond in (("phases", bool(diag["phases"])),
+                      ("packs", bool(diag["packs"])),
+                      ("levels", bool(diag["levels"])),
+                      ("records", diag["records"] == n_rec - 1)):
+        if not cond:
+            fail(f"doctor diagnosis missing/inconsistent: {key}")
+    report = doctor.render(diag)
+    for section in ("phase breakdown", "hierarchy cost model",
+                    "SpMV pack choices", "convergence"):
+        if section not in report:
+            fail(f"doctor report is missing the {section!r} section")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
-          f"{len(names_by_kind.get('span_end', ()))} span names)")
+          f"{len(names_by_kind.get('span_end', ()))} span names, "
+          f"{n_ev} chrome-trace events, doctor OK)")
     if not keep:
         os.unlink(path)
 

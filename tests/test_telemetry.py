@@ -455,6 +455,328 @@ def test_validate_jsonl_multi_session_append():
         telemetry.validate_jsonl([meta, ev(5), ev(1)])
 
 
+# ------------------------------------ PR 3: observability layer tests
+def test_prometheus_label_escaping():
+    """Label values with backslash, double-quote and newline must be
+    escaped per the text exposition format or the series line is
+    unparseable (regression for the exporter's raw f-string)."""
+    telemetry.reset()
+    with telemetry.capture():
+        telemetry.counter_inc("amgx_spmv_dispatch_total",
+                              pack='we\\ird"pack\nname')
+    text = telemetry.prometheus_text()
+    assert 'pack="we\\\\ird\\"pack\\nname"' in text
+    # the rendered text stays line-parseable: no raw newline inside a
+    # label value (every line is either a comment or name{...} value)
+    for line in text.splitlines():
+        assert line.startswith("#") or " " in line
+    telemetry.reset()
+
+
+def test_ring_overflow_dropped_counter(tmp_path):
+    """The recorder counts evicted records; flush surfaces the drop as
+    a ring_overflow event; the doctor reports the truncation."""
+    telemetry.reset()
+    path = str(tmp_path / "overflow.jsonl")
+    with telemetry.capture(ring_size=8) as cap:
+        for i in range(20):
+            telemetry.event("tick", i=i)
+        assert telemetry.dropped_count() == 12
+        telemetry.flush_jsonl(path)
+    assert cap.dropped >= 12 and cap.truncated
+    with open(path) as f:
+        lines = f.readlines()
+    assert telemetry.validate_jsonl(lines) == len(lines)
+    recs = [json.loads(l) for l in lines]
+    (meta,) = [r for r in recs if r["kind"] == "meta"]
+    assert meta["dropped"] >= 12            # surfaced in flush output
+    ov = [r for r in recs if r["kind"] == "event"
+          and r["name"] == "ring_overflow"]
+    assert ov and ov[0]["attrs"]["dropped"] >= 12
+    assert ov[0]["attrs"]["ring_size"] == 8
+    from amgx_tpu.telemetry import doctor
+    d = doctor.diagnose([path])
+    assert d["dropped_records"] >= 12
+    assert any("truncated" in h for h in d["hints"])
+    assert "DROPPED" in doctor.render(d)
+    telemetry.reset()
+
+
+def test_meta_header_identifies_session(tmp_path):
+    """Session meta headers carry the process/session identity and the
+    paired clock sample that make multi-process aggregation and
+    Chrome-trace alignment well-defined."""
+    import os as _os
+    path = str(tmp_path / "meta.jsonl")
+    with telemetry.capture():
+        telemetry.event("ping")
+        telemetry.dump_jsonl(path)
+    meta = json.loads(open(path).readline())
+    assert meta["pid"] == _os.getpid()
+    assert isinstance(meta["session"], str) and meta["session"]
+    assert isinstance(meta["t_perf"], float)
+    assert isinstance(meta["t_unix"], float)
+    assert meta["t_unix"] > 1e9             # a real wall-clock sample
+    assert telemetry.validate_jsonl(open(path).readlines()) == 2
+
+
+def test_chrome_trace_export_structure():
+    """Spans become complete (X) slices with the begin attrs as args,
+    events become instants, counters become running-sum counter
+    tracks — and the whole thing validates structurally."""
+    with telemetry.capture() as cap:
+        with telemetry.span("outer", phase="setup"):
+            with telemetry.span("inner"):
+                telemetry.event("mark", k=1)
+        telemetry.counter_inc("amgx_spmv_dispatch_total", pack="dia")
+        telemetry.counter_inc("amgx_spmv_dispatch_total", pack="dia")
+    trace = telemetry.chrome_trace(cap.records)
+    n = telemetry.validate_chrome_trace(trace)
+    assert n == len(trace["traceEvents"])
+    by_ph = {}
+    for e in trace["traceEvents"]:
+        by_ph.setdefault(e["ph"], []).append(e)
+    xs = {e["name"]: e for e in by_ph["X"]}
+    assert xs["outer"]["args"] == {"phase": "setup"}
+    assert xs["outer"]["dur"] >= xs["inner"]["dur"] >= 0
+    # nesting preserved on the timeline
+    assert xs["outer"]["ts"] <= xs["inner"]["ts"]
+    (mark,) = [e for e in by_ph["i"] if e["name"] == "mark"]
+    assert mark["args"] == {"k": 1}
+    ctr = [e for e in by_ph["C"]
+           if e["name"] == "amgx_spmv_dispatch_total{pack=dia}"]
+    assert [e["args"]["value"] for e in ctr] == [1, 2]   # running sum
+    assert json.loads(json.dumps(trace, allow_nan=False))
+
+
+def test_chrome_trace_from_multi_session_file(tmp_path):
+    """A JSONL file holding two sessions renders one process track per
+    session (pid from the meta header)."""
+    path = str(tmp_path / "two.jsonl")
+    with telemetry.capture() as cap:
+        with telemetry.span("work"):
+            pass
+    telemetry.dump_jsonl(path, cap.records)
+    # second session: same records, another pid (simulating rank 1)
+    lines = open(path).readlines()
+    meta2 = json.loads(lines[0])
+    meta2["pid"] = meta2["pid"] + 1
+    meta2["session"] = "deadbeef0002"
+    with open(path, "a") as f:
+        f.write(json.dumps(meta2) + "\n")
+        for l in lines[1:]:
+            f.write(l)
+    trace = telemetry.chrome_trace(path)
+    telemetry.validate_chrome_trace(trace)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert len(pids) == 2
+    procs = [e for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(procs) == 2
+
+
+def test_aggregate_sessions_roundtrip(tmp_path):
+    """aggregate_sessions merges multi-session JSONL (separate files
+    AND one concatenated file) into one view: counter sums, span
+    totals, record counts all mesh-wide."""
+    p1 = str(tmp_path / "rank0.jsonl")
+    p2 = str(tmp_path / "rank1.jsonl")
+    with telemetry.capture() as c1:
+        with telemetry.span("solve"):
+            telemetry.counter_inc("amgx_halo_bytes_total", 100.0,
+                                  ring=1, op="dist_spmv")
+    telemetry.dump_jsonl(p1, c1.records)
+    with telemetry.capture() as c2:
+        with telemetry.span("solve"):
+            telemetry.counter_inc("amgx_halo_bytes_total", 40.0,
+                                  ring=1, op="dist_spmv")
+        telemetry.event("residual", iteration=0, norm=1.0)
+    telemetry.dump_jsonl(p2, c2.records)
+
+    agg = telemetry.aggregate_sessions([p1, p2])
+    assert agg["n_sessions"] == 2
+    assert agg["n_records"] == len(c1.records) + len(c2.records)
+    key = ("amgx_halo_bytes_total",
+           (("op", "dist_spmv"), ("ring", 1)))
+    assert agg["counters"][key] == 140.0
+    assert agg["spans"]["solve"]["count"] == 2
+    assert agg["events"]["residual"] == 1
+
+    # concatenated single-file layout (what a shared telemetry_path
+    # appended by two processes produces) aggregates identically
+    cat = str(tmp_path / "both.jsonl")
+    with open(cat, "w") as f:
+        f.write(open(p1).read())
+        f.write(open(p2).read())
+    agg2 = telemetry.aggregate_sessions(cat)
+    assert agg2["n_sessions"] == 2
+    assert agg2["counters"][key] == 140.0
+    # sessions keep their identity (meta headers round-trip)
+    assert [s["meta"]["session"] for s in agg2["sessions"]] == \
+        [s["meta"]["session"] for s in agg["sessions"]]
+
+
+def test_costmodel_descriptors():
+    """Static cost descriptors: bytes/FLOPs per apply and padding waste
+    for the dia and ell packs, plus the rollup and roofline helpers."""
+    from amgx_tpu.core.matrix import pack_device, padded_entries
+    from amgx_tpu.telemetry import costmodel
+
+    A = poisson2d(16)                       # 256 rows, 5-pt: 5 diagonals
+    Ad = pack_device(A, 1, np.float64)
+    assert Ad.fmt == "dia"
+    assert padded_entries(Ad) == 5 * 256
+    c = costmodel.spmv_cost(Ad, nnz=A.nnz)
+    assert c["pack"] == "dia" and not c["estimated"]
+    assert c["flops_per_apply"] == 2 * A.nnz
+    assert c["bytes_per_apply"] == (5 + 2) * 256 * 8
+    assert c["padding_waste"] == pytest.approx(5 * 256 / A.nnz,
+                                               abs=1e-4)
+
+    Ae = pack_device(A, 1, np.float64, dia_max_diags=0)   # force ELL
+    assert Ae.fmt == "ell"
+    ce = costmodel.spmv_cost(Ae, nnz=A.nnz)
+    K = Ae.ell_width
+    assert ce["padded_entries"] == 256 * K
+    assert ce["bytes_per_apply"] == \
+        256 * K * 8 + 256 * K * 4 + 2 * 256 * 8
+    # estimated when nnz unknown: waste reads 1.0 against the slots
+    assert costmodel.spmv_cost(Ae)["estimated"]
+
+    roll = costmodel.hierarchy_cost([c, ce])
+    assert roll["total_bytes_per_cycle"] == \
+        c["bytes_per_apply"] + ce["bytes_per_apply"]
+    assert roll["total_flops_per_cycle"] == 2 * 2 * A.nnz
+    gbs = costmodel.achieved_gbs(c["bytes_per_apply"], 1e-6)
+    assert gbs == pytest.approx(c["bytes_per_apply"] / 1e-6 / 1e9)
+    assert costmodel.roofline_fraction(409.5, 819.0) == \
+        pytest.approx(0.5)
+
+
+def test_costmodel_halo_formulas_match_partition():
+    """Halo wire bytes / useful entries from the pack metadata equal
+    the analytic boundary sizes of the partition (no mesh needed —
+    duck-typed pack)."""
+    import types
+
+    import scipy.sparse as _sp
+
+    from amgx_tpu.distributed.partition import build_partition
+    from amgx_tpu.io import poisson5pt
+    from amgx_tpu.telemetry import costmodel
+
+    A = _sp.csr_matrix(poisson5pt(8, 8))
+    part = build_partition(A, 4)
+    fake = types.SimpleNamespace(
+        n_parts=4, block_dim=1, dtype=np.float64,
+        send_idx=part.send_idx, halo_src=part.halo_src,
+        dists=part.dists,
+        send_idx2=part.rings[1].send_idx,
+        halo_src2=part.rings[1].halo_src, dists2=part.rings[1].dists,
+        halo_counts=tuple(int(c) for c in part.halo_count),
+        halo_counts2=tuple(int(c) for c in part.rings[1].halo_count))
+    assert costmodel.halo_entries(fake, ring=1) == \
+        int(sum(part.halo_count))
+    B = part.send_idx.shape[1]
+    hops = len(part.dists)
+    assert costmodel.halo_wire_bytes(fake, ring=1) == \
+        4 * hops * B * 8
+    # ring 2 reads its own maps
+    assert costmodel.halo_entries(fake, ring=2) == \
+        int(sum(part.rings[1].halo_count))
+
+
+def test_op_cost_event_emitted_once_per_operator():
+    from amgx_tpu.ops.spmv import spmv
+    import jax.numpy as jnp
+
+    from amgx_tpu.core.matrix import pack_device
+    A = poisson2d(12)
+    Ad = pack_device(A, 1, np.float64)
+    x = jnp.ones(A.shape[0])
+    with telemetry.capture() as cap:
+        spmv(Ad, x)
+        spmv(Ad, x)          # same operator: no second op_cost event
+    evs = cap.events("op_cost")
+    assert len(evs) == 1
+    a = evs[0]["attrs"]
+    assert a["pack"] == "dia" and a["bytes_per_apply"] > 0
+    assert cap.counter_total("amgx_spmv_dispatch_total",
+                             pack="dia/slices") == 2
+
+
+def test_doctor_detects_residual_plateau(tmp_path):
+    """A synthesized trace whose residual stops decreasing earns the
+    plateau hint; a healthy one does not."""
+    from amgx_tpu.telemetry import doctor
+
+    def trace_with(norms, path):
+        with telemetry.capture() as cap:
+            for i, n in enumerate(norms):
+                telemetry.event("residual", iteration=i, norm=n)
+        telemetry.dump_jsonl(path, cap.records)
+
+    stuck = str(tmp_path / "stuck.jsonl")
+    trace_with([1.0, 0.5, 0.25] + [0.2 * 0.999 ** i for i in range(12)],
+               stuck)
+    d = doctor.diagnose([stuck])
+    assert d["convergence"]["plateau"] is not None
+    assert any("plateau" in h for h in d["hints"])
+
+    healthy = str(tmp_path / "ok.jsonl")
+    trace_with([10.0 ** -i for i in range(10)], healthy)
+    d2 = doctor.diagnose([healthy])
+    assert d2["convergence"]["plateau"] is None
+    assert not any("plateau" in h for h in d2["hints"])
+
+
+def test_doctor_reports_binned_budget_fallback(tmp_path):
+    """The pallas_csr plan rejection event turns into the concrete
+    'over padding budget by N×' doctor hint."""
+    from amgx_tpu.telemetry import doctor
+    with telemetry.capture() as cap:
+        telemetry.event("binned_plan_rejected", rows=5000, nnz=10000,
+                        padded=210000, pad_cap=10.0, over_budget=2.1)
+    path = str(tmp_path / "rej.jsonl")
+    telemetry.dump_jsonl(path, cap.records)
+    d = doctor.diagnose([path])
+    assert any("over padding budget by 2.1×" in h for h in d["hints"])
+
+
+def test_doctor_cli_main(tmp_path, capsys):
+    """`python -m amgx_tpu.telemetry.doctor` entry: report on stdout,
+    usage error without args, --json machine output."""
+    from amgx_tpu.telemetry import doctor
+    path = str(tmp_path / "t.jsonl")
+    with telemetry.capture() as cap:
+        with telemetry.span("solve"):
+            telemetry.event("residual", iteration=0, norm=1.0)
+    telemetry.dump_jsonl(path, cap.records)
+    assert doctor.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "amgx solve doctor" in out
+    assert doctor.main([path, "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["records"] == len(cap.records)
+    assert doctor.main([]) == 2
+
+
+def test_distributed_instruments_are_noop_when_off():
+    """The halo-exchange instruments are one-attribute-check no-ops on
+    a disabled recorder (acceptance criterion)."""
+    import types
+
+    from amgx_tpu.distributed import matrix as dmat
+    assert not telemetry.is_enabled()
+    before = len(telemetry.records())
+    reg_before = telemetry.registry().snapshot()
+    # a pack stub that would CRASH if the gated body ran
+    dmat._tel_exchange(types.SimpleNamespace(), 1, "dist_spmv")
+    dmat._tel_dist_spmv(types.SimpleNamespace())
+    assert len(telemetry.records()) == before
+    assert telemetry.registry().snapshot() == reg_before
+
+
 # ------------------------------------------------------------------- capi
 def test_capi_time_getters():
     from amgx_tpu import capi
