@@ -888,6 +888,97 @@ def AMGX_generate_distributed_poisson_7pt(mtx: MatrixHandle,
     return A, pv
 
 
+# ------------------------------------------------------------------ serving
+class ServiceHandle:
+    """Opaque handle over a :class:`amgx_tpu.serve.SolveService`
+    (TPU-build extension — the reference has no request-level serving
+    layer; its building blocks, ``thread_manager.h`` AsyncTasks and the
+    replace-coefficients resetup path, are what the service composes)."""
+
+    def __init__(self, rsrc: ResourcesHandle, mode, cfg: ConfigHandle):
+        import threading
+        from .serve import SolveService
+        self.rsrc = rsrc
+        self.mode = parse_mode(mode)
+        self.service = SolveService(cfg.cfg)
+        self._tickets = {}
+        self._next_ticket = 1
+        #: concurrent driver threads submit/wait through one handle —
+        #: ticket allocation must not race
+        self._lock = threading.Lock()
+
+
+@_catches(1)
+def AMGX_serve_create(rsrc: ResourcesHandle, mode, cfg: ConfigHandle):
+    """Start a solve service configured by ``cfg`` (``serve_*`` knobs:
+    workers, queue depth, batch window, cache budget, deadlines)."""
+    return ServiceHandle(rsrc, mode, cfg)
+
+
+@_catches(1)
+def AMGX_serve_submit(srv: ServiceHandle, mtx: MatrixHandle,
+                      rhs: VectorHandle):
+    """Queue one solve of ``mtx``'s matrix against ``rhs``; returns an
+    integer ticket for :func:`AMGX_serve_wait`.  Over capacity the call
+    returns ``RC.REJECTED`` and no ticket — the documented backpressure
+    signal (queue bounded by ``serve_queue_depth``)."""
+    pending = srv.service.submit(mtx.matrix, np.asarray(rhs.data))
+    if pending.rc != RC.OK:
+        raise AMGXError(pending.error or "admission rejected", pending.rc)
+    with srv._lock:
+        ticket = srv._next_ticket
+        srv._next_ticket += 1
+        srv._tickets[ticket] = pending
+    return ticket
+
+
+@_catches(2)
+def AMGX_serve_wait(srv: ServiceHandle, ticket: int,
+                    sol: VectorHandle = None, timeout: float = None):
+    """Block for a submitted ticket; fills ``sol`` and returns
+    ``(rc, status, iterations)``.  A timed-out wait KEEPS the ticket —
+    the request is still running and a later wait can still collect
+    it (popping here would make a slow solve unrecoverable)."""
+    with srv._lock:
+        pending = srv._tickets.get(int(ticket))
+    if pending is None:
+        raise BadParametersError(f"unknown serve ticket {ticket}")
+    if not pending.wait_done(timeout):
+        raise AMGXError("serve wait timed out; ticket still pending",
+                        RC.UNKNOWN)
+    with srv._lock:
+        srv._tickets.pop(int(ticket), None)
+    res = pending.result
+    if pending.rc != RC.OK or res is None:
+        raise AMGXError(pending.error or "request failed",
+                        pending.rc if pending.rc != RC.OK else RC.UNKNOWN)
+    if sol is not None:
+        sol.data = np.asarray(res.x)
+    return res.status, res.iterations
+
+
+@_catches(1)
+def AMGX_serve_stats(srv: ServiceHandle):
+    """Operational snapshot: queue depth, completion/rejection counts,
+    latency percentiles, cache hit/miss/eviction and per-session
+    setup-reuse counts."""
+    return srv.service.stats()
+
+
+@_catches()
+def AMGX_serve_drain(srv: ServiceHandle, timeout: float = None):
+    """Stop admission and flush every queued request (new submissions
+    reject with ``RC.REJECTED`` until re-created)."""
+    if not srv.service.drain(timeout):
+        raise AMGXError("serve drain timed out", RC.UNKNOWN)
+
+
+@_catches()
+def AMGX_serve_destroy(srv: ServiceHandle):
+    srv.service.shutdown()
+    srv._tickets.clear()
+
+
 # -------------------------------------------------------------- eigensolver
 @_catches(1)
 def AMGX_eigensolver_create(rsrc: ResourcesHandle, mode,
@@ -938,6 +1029,7 @@ _RC_STRINGS = {
     RC.NOT_IMPLEMENTED: "Not implemented.",
     RC.LICENSE_NOT_FOUND: "License not found.",
     RC.INTERNAL: "Internal error.",
+    RC.REJECTED: "Request rejected by serving admission control.",
 }
 
 

@@ -291,6 +291,21 @@ def register_default_parameters():
       "JSONL trace file; appended incrementally after setup/solve")
     R("telemetry_ring_size", int, 65536,
       "max telemetry records held in the in-memory ring buffer")
+    # serving subsystem (amgx_tpu/serve/): request-level concurrency —
+    # sessions with a pattern-keyed setup cache, micro-batched multi-RHS
+    # solves, bounded-queue admission control
+    R("serve_workers", int, 2,
+      "solve worker threads of the serving pool")
+    R("serve_queue_depth", int, 64,
+      "admission queue capacity; a full queue rejects with RC.REJECTED")
+    R("serve_batch_window_ms", float, 2.0,
+      "micro-batch aggregation window (milliseconds)")
+    R("serve_max_batch", int, 16,
+      "max RHS stacked into one multi-RHS solve executable")
+    R("serve_cache_bytes", int, 1 << 30,
+      "setup-cache byte budget bounding resident hierarchies")
+    R("serve_deadline_ms", float, 0.0,
+      "default per-request deadline in ms; 0 disables deadlines")
 
 
 register_default_parameters()

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import itertools
 from typing import Optional
 
 import jax
@@ -304,6 +305,11 @@ def ell_layout(indptr: np.ndarray, indices: np.ndarray):
     return for_rows, pos, k
 
 
+#: identity tokens for fingerprinting device-only matrices (never
+#: recycled, unlike id())
+_FP_TOKENS = itertools.count(1)
+
+
 def _bsr_from_any(a, block_dim: int) -> sp.bsr_matrix:
     if block_dim == 1:
         return sp.csr_matrix(a)
@@ -407,6 +413,8 @@ class Matrix:
         self._dia = None
         self._dia_checked_max = 0
         self._dinv_dev = None
+        self._pattern_fp = None      # new structure ⇒ new fingerprint
+        self._values_fp = None
         self._drop_generator_state()
         # generators (io/poisson.py) attach their analytic diagonal
         # decomposition — setup then never re-extracts it from CSR.  The
@@ -598,6 +606,7 @@ class Matrix:
         self._dia = None
         self._dia_checked_max = 0
         self._dinv_dev = None
+        self._values_fp = None    # new values; _pattern_fp stays valid
         self._drop_generator_state()
         return self
 
@@ -612,6 +621,86 @@ class Matrix:
                      "_vals_f32_exact"):
             if hasattr(self, attr):
                 delattr(self, attr)
+
+    # -------------------------------------------------------- fingerprints
+    def pattern_fingerprint(self) -> str:
+        """Stable hex digest of the sparsity STRUCTURE — shape, block
+        dim, indptr/indices (never values).  Two matrices with equal
+        fingerprints can share one solver hierarchy through
+        ``Solver.resetup`` (the replace-coefficients contract: same
+        structure, new values) — this is the setup-cache key of the
+        serving layer (serve/session.py).  ``replace_coefficients``
+        preserves the fingerprint; ``set`` resets it.  Matrices with no
+        host-side structure (device-born packs) fingerprint by object
+        identity: never falsely shared, at worst re-set-up."""
+        fp = getattr(self, "_pattern_fp", None)
+        if fp is not None:
+            return fp
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        h.update(repr((tuple(self.shape), self.block_dim)).encode())
+        if self._host is not None:
+            h.update(b"csr")
+            h.update(np.ascontiguousarray(self._host.indptr).tobytes())
+            h.update(np.ascontiguousarray(self._host.indices).tobytes())
+        elif self.blocks is not None:
+            h.update(b"blocks")
+            for blk in self.blocks:
+                h.update(np.ascontiguousarray(blk.indptr).tobytes())
+                h.update(np.ascontiguousarray(blk.indices).tobytes())
+        elif self._dia is not None or \
+                getattr(self, "_dia_thunk", None) is not None or \
+                (self._device is not None and self._device.fmt == "dia"):
+            offs, _ = self.dia_cache()
+            h.update(b"dia")
+            h.update(repr(tuple(int(o) for o in offs)).encode())
+        else:
+            # device-only pack: structure bytes live on device; hashing
+            # them would force a download, so key by a process-unique
+            # token (NOT id(): the allocator recycles addresses after
+            # GC, which could falsely match a dead matrix's session)
+            h.update(b"obj")
+            h.update(str(self._fp_token()).encode())
+        fp = h.hexdigest()
+        self._pattern_fp = fp
+        return fp
+
+    def _fp_token(self) -> int:
+        """Process-unique identity token for fingerprinting matrices
+        with no host-side bytes to hash — never reused, unlike id()."""
+        tok = getattr(self, "_fp_token_v", None)
+        if tok is None:
+            tok = self._fp_token_v = next(_FP_TOKENS)
+        return tok
+
+    def values_fingerprint(self) -> str:
+        """Digest of the stored VALUES (structure excluded).  The serving
+        setup cache pairs this with :meth:`pattern_fingerprint` to
+        decide between reusing a prepared solver outright (equal), a
+        numeric ``resetup`` (pattern equal, values differ), or a full
+        setup (pattern differs).  Cached: hashing O(nnz) data per
+        request would tax the submit path; ``set`` and
+        ``replace_coefficients`` — the value mutators — invalidate."""
+        fp = getattr(self, "_values_fp", None)
+        if fp is not None:
+            return fp
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        if self._host is not None:
+            h.update(np.ascontiguousarray(self._host.data).tobytes())
+        elif self.blocks is not None:
+            for blk in self.blocks:
+                h.update(np.ascontiguousarray(blk.data).tobytes())
+        elif self._dia is not None:
+            h.update(np.ascontiguousarray(self._dia[1]).tobytes())
+        else:
+            # device-only values: identity token — a new Matrix handle
+            # is treated as new values (conservative: an extra resetup,
+            # never a stale hierarchy)
+            h.update(str(self._fp_token()).encode())
+        fp = h.hexdigest()
+        self._values_fp = fp
+        return fp
 
     # ------------------------------------------------------------- properties
     @property

@@ -29,6 +29,10 @@ class RC(enum.IntEnum):
     NOT_IMPLEMENTED = 13
     LICENSE_NOT_FOUND = 14
     INTERNAL = 15
+    #: TPU-build extension (no reference equivalent): the serving
+    #: layer's admission control (amgx_tpu/serve/) sheds load with this
+    #: code — queue full, or a request deadline expired before execution
+    REJECTED = 16
 
 
 class SolveStatus(enum.IntEnum):

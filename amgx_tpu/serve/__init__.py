@@ -1,0 +1,40 @@
+"""Concurrent solve serving.
+
+The request-level layer over the solver stack — the first subsystem
+that exercises it under concurrency.  The reference ships the building
+blocks (``thread_manager.h``'s AsyncTask pool, the
+replace-coefficients resetup path); this package ties their ports
+together with the batching/caching/admission-control playbook an
+inference server uses:
+
+* :mod:`.session` — :class:`SolverSession`: one configured solver +
+  hierarchy per (config hash, sparsity-pattern fingerprint); values
+  fingerprints pick full setup / ``resetup`` / outright reuse;
+* :mod:`.cache` — :class:`SetupCache`: LRU over sessions with a DEVICE
+  byte budget bounding resident hierarchies;
+* :mod:`.batch` — :class:`SolveRequest`/:class:`PendingSolve` and
+  micro-batch assembly: same-operator requests stack into one
+  multi-RHS ``Solver.solve_multi`` executable, per-request convergence
+  split back out;
+* :mod:`.service` — :class:`SolveService`: bounded-queue admission
+  (full ⇒ :data:`~amgx_tpu.errors.RC.REJECTED`), a batching dispatcher,
+  ``ThreadManager`` workers, per-request deadlines, graceful drain.
+
+Metric names live under the versioned ``METRICS`` registry
+(``amgx_serve_*``); ``python -m amgx_tpu.telemetry.doctor`` summarises
+serving behaviour from any trace that carries them.  C-shaped drivers
+reach the service through the ``AMGX_serve_*`` entry points in
+:mod:`amgx_tpu.capi`.
+"""
+from __future__ import annotations
+
+from .batch import PendingSolve, SolveRequest, split_batches
+from .cache import SetupCache
+from .service import SolveService
+from .session import SessionKey, SolverSession, config_hash, session_key
+
+__all__ = [
+    "SolveService", "SetupCache", "SolverSession", "SessionKey",
+    "SolveRequest", "PendingSolve", "split_batches", "config_hash",
+    "session_key",
+]

@@ -1,0 +1,198 @@
+"""Request objects and micro-batch assembly/execution.
+
+The batching unit is (session key, values fingerprint): requests that
+share a *prepared operator* — same config, same sparsity pattern, same
+values — stack their right-hand sides into one multi-RHS solve
+(``Solver.solve_multi``, the vmapped packed executable), exactly the
+shape an inference server's micro-batcher produces.  Same-pattern
+requests with *different* values never share a batch (they are
+different operators); they share the SESSION, riding the resetup path
+sequentially.
+
+Each request's result is split back out with its own convergence
+status, iteration count and residual — a batch where one RHS converges
+and another hits the iteration limit reports both truthfully.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..errors import RC
+from ..solvers.base import SolveResult
+from .session import SessionKey, SolverSession
+
+
+@dataclasses.dataclass
+class SolveRequest:
+    """One queued (matrix, b) solve."""
+
+    matrix: object                 # core.matrix.Matrix
+    b: np.ndarray
+    x0: Optional[np.ndarray]
+    key: SessionKey
+    values_fp: str
+    submitted_t: float
+    #: absolute ``time.monotonic`` deadline, or None
+    deadline_t: Optional[float]
+    _event: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    result: Optional[SolveResult] = None
+    rc: RC = RC.OK
+    error: Optional[str] = None
+
+    def batch_key(self):
+        return (self.key, self.values_fp)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.deadline_t is not None and \
+            (now if now is not None else time.monotonic()) > self.deadline_t
+
+    # ----------------------------------------------------------- completion
+    def complete(self, result: Optional[SolveResult], rc: RC = RC.OK,
+                 error: Optional[str] = None):
+        self.result = result
+        self.rc = RC(rc)
+        self.error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+
+class PendingSolve:
+    """User-facing handle for a submitted request: ``wait()`` blocks for
+    the result; ``rc`` is :data:`RC.OK` on success, :data:`RC.REJECTED`
+    when admission control shed the request (queue full / deadline)."""
+
+    def __init__(self, request: SolveRequest):
+        self._request = request
+
+    @property
+    def rc(self) -> RC:
+        return self._request.rc
+
+    @property
+    def error(self) -> Optional[str]:
+        return self._request.error
+
+    def done(self) -> bool:
+        return self._request.done()
+
+    def wait(self, timeout: Optional[float] = None
+             ) -> Optional[SolveResult]:
+        """Block until the request completes; returns the
+        :class:`SolveResult` (None when rejected or failed — check
+        ``rc``/``error``)."""
+        if not self._request.wait(timeout):
+            return None
+        return self._request.result
+
+    def wait_done(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request completes; True when it did (even
+        rejected/failed — ``wait`` returning None cannot distinguish a
+        rejection from a timeout; this can)."""
+        return self._request.wait(timeout)
+
+    @property
+    def result(self) -> Optional[SolveResult]:
+        return self._request.result
+
+
+def split_batches(requests: List[SolveRequest], max_batch: int
+                  ) -> List[List[SolveRequest]]:
+    """Group requests by (session key, values fp), capping each batch at
+    ``max_batch`` RHS.  Arrival order is preserved within a group."""
+    groups: "dict[tuple, List[SolveRequest]]" = {}
+    order: List[tuple] = []
+    for r in requests:
+        k = r.batch_key()
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(r)
+    batches: List[List[SolveRequest]] = []
+    for k in order:
+        g = groups[k]
+        for i in range(0, len(g), max(1, int(max_batch))):
+            batches.append(g[i:i + max(1, int(max_batch))])
+    return batches
+
+
+def execute_batch(session: SolverSession, requests: List[SolveRequest],
+                  cache=None):
+    """Prepare the session for the batch's operator, run the stacked
+    multi-RHS solve (padded to a power-of-two bucket inside
+    ``solve_multi`` so ragged batch sizes don't recompile), and split
+    per-request results back out.  Failures complete every request in
+    the batch with an error rc instead of raising into the worker
+    pool."""
+    now = time.monotonic()
+    live = []
+    for r in requests:
+        if r.expired(now):
+            telemetry.counter_inc("amgx_serve_rejected_total",
+                                  reason="deadline")
+            telemetry.counter_inc("amgx_serve_requests_total",
+                                  status="REJECTED")
+            r.complete(None, rc=RC.REJECTED,
+                       error="deadline expired before execution")
+        else:
+            live.append(r)
+    # a matrix mutated between submit and execution (e.g.
+    # replace_coefficients on a handle with queued requests) would be
+    # solved against values the request was never submitted with — fail
+    # those requests loudly instead of returning a silently wrong x
+    still = []
+    for r in live:
+        if r.matrix.values_fingerprint() != r.values_fp:
+            telemetry.counter_inc("amgx_serve_requests_total",
+                                  status="ERROR")
+            r.complete(None, rc=RC.BAD_PARAMETERS,
+                       error="matrix values changed after submit; "
+                             "re-submit against the current matrix")
+        else:
+            still.append(r)
+    live = still
+    if not live:
+        return
+    try:
+        B = np.stack([np.asarray(r.b).ravel() for r in live])
+        X0 = None
+        if any(r.x0 is not None for r in live):
+            n = B.shape[1]
+            X0 = np.stack([
+                np.asarray(r.x0).ravel() if r.x0 is not None
+                else np.zeros(n, dtype=B.dtype) for r in live])
+        telemetry.hist_observe("amgx_serve_batch_size", float(len(live)))
+        # prepare + solve are ATOMIC on the session: a racing batch with
+        # different values must not resetup the shared solver between
+        # this batch's prepare and its solve
+        kind, results = session.prepare_and_solve(
+            live[0].matrix, B, X0=X0, pad_to_bucket=True)
+        telemetry.counter_inc("amgx_serve_setup_total", kind=kind)
+        if cache is not None and kind in ("full", "resetup"):
+            cache.account(session)
+    except Exception as e:      # noqa: BLE001 — worker pool must survive
+        msg = f"{type(e).__name__}: {e}"
+        for r in live:
+            telemetry.counter_inc("amgx_serve_requests_total",
+                                  status="ERROR")
+            r.complete(None, rc=RC.UNKNOWN, error=msg)
+        return
+    t_done = time.monotonic()
+    for r, res in zip(live, results):
+        telemetry.counter_inc(
+            "amgx_serve_requests_total",
+            status=("SUCCESS" if int(res.status) == 0 else "FAILED"))
+        telemetry.hist_observe("amgx_serve_request_seconds",
+                               t_done - r.submitted_t)
+        r.complete(res)

@@ -1,0 +1,112 @@
+"""Pattern-keyed LRU setup cache with byte accounting.
+
+AMG setup is the expensive phase (coarsening, packing, jit compiles);
+the cache bounds how many prepared hierarchies stay resident.  Entries
+are :class:`~amgx_tpu.serve.session.SolverSession`s keyed by
+:class:`~amgx_tpu.serve.session.SessionKey`; the budget is DEVICE bytes
+(``utils.memory.device_tree_bytes`` over each session's bindings
+pytree), not entry count — one 256³ hierarchy outweighs a thousand toy
+sessions.  Least-recently-used sessions are dropped until the resident
+total fits; an in-flight session object stays alive through its own
+reference until its batch completes, eviction only forgets it.
+
+Telemetry: ``amgx_serve_cache_{hits,misses,evictions}_total`` counters
+and the ``amgx_serve_cache_bytes`` gauge.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Optional, Tuple
+
+from .. import telemetry
+from ..config import AMGConfig
+from ..core.matrix import Matrix
+from .session import SessionKey, SolverSession, session_key
+
+
+class SetupCache:
+    def __init__(self, max_bytes: int = 1 << 30):
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._sessions: "collections.OrderedDict[SessionKey, SolverSession]" \
+            = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -------------------------------------------------------------- lookup
+    def get_or_create(self, cfg: AMGConfig, matrix: Matrix,
+                      key: Optional[SessionKey] = None
+                      ) -> Tuple[SolverSession, bool]:
+        """The session for (cfg, matrix-pattern); creates one on miss.
+        Returns (session, created).  Pass a precomputed ``key`` to skip
+        re-hashing the config (the service does)."""
+        if key is None:
+            key = session_key(cfg, matrix)
+        with self._lock:
+            s = self._sessions.get(key)
+            if s is not None:
+                self._sessions.move_to_end(key)
+                self.hits += 1
+                telemetry.counter_inc("amgx_serve_cache_hits_total")
+                return s, False
+            self.misses += 1
+            telemetry.counter_inc("amgx_serve_cache_misses_total")
+            s = SolverSession(key, cfg)
+            self._sessions[key] = s
+            return s, True
+
+    def get(self, key: SessionKey) -> Optional[SolverSession]:
+        with self._lock:
+            return self._sessions.get(key)
+
+    # ---------------------------------------------------------- accounting
+    def account(self, session: SolverSession) -> int:
+        """Refresh ``session``'s byte price, then evict LRU sessions
+        until the resident total fits the budget (the session just used
+        is never evicted — it is the MRU by construction).  Returns the
+        resident total after eviction."""
+        size = session.device_bytes()
+        with self._lock:
+            session.bytes = size
+            if session.key in self._sessions:
+                self._sessions.move_to_end(session.key)
+            total = sum(s.bytes for s in self._sessions.values())
+            while total > self.max_bytes and len(self._sessions) > 1:
+                key, victim = next(iter(self._sessions.items()))
+                if victim is session:
+                    break
+                del self._sessions[key]
+                total -= victim.bytes
+                self.evictions += 1
+                telemetry.counter_inc("amgx_serve_cache_evictions_total")
+            telemetry.gauge_set("amgx_serve_cache_bytes", total)
+            return total
+
+    # ------------------------------------------------------------- queries
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(s.bytes for s in self._sessions.values())
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def clear(self):
+        with self._lock:
+            self._sessions.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sessions": len(self._sessions),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "resident_bytes": sum(s.bytes
+                                      for s in self._sessions.values()),
+                "max_bytes": self.max_bytes,
+                "by_session": [s.stats()
+                               for s in self._sessions.values()],
+            }

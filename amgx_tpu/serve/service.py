@@ -1,0 +1,284 @@
+"""The concurrent solve service: admission → batching → execution.
+
+:class:`SolveService` accepts many ``(matrix, b)`` requests and executes
+them efficiently on one device, the same playbook an inference server
+uses:
+
+* **admission**: a bounded queue; a full queue sheds load immediately
+  with :data:`RC.REJECTED` (the documented backpressure contract) —
+  queueing unboundedly would trade a fast "no" for a slow timeout.
+  Optional per-request deadlines reject work whose answer nobody is
+  waiting for anymore.
+* **batching**: a dispatcher thread drains the queue, groups requests
+  by (config, pattern, values) within ``serve_batch_window_ms``, and
+  hands micro-batches to the worker pool
+  (:func:`~amgx_tpu.serve.batch.split_batches`).
+* **execution**: ``utils.thread_manager.ThreadManager`` workers run
+  each batch — session prepare (full setup / resetup / reuse via the
+  pattern-keyed :class:`~amgx_tpu.serve.cache.SetupCache`) then the
+  stacked multi-RHS solve.  Distinct sessions solve concurrently;
+  one session's requests serialise on its lock.
+* **drain/shutdown**: :meth:`drain` stops admission and flushes every
+  queued request; :meth:`shutdown` additionally joins the pool.
+
+All knobs come from the config (``serve_*`` parameters,
+config/registry.py) so C-shaped drivers configure the service exactly
+like a solver.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .. import telemetry
+from ..config import AMGConfig
+from ..core.matrix import Matrix
+from ..errors import RC
+from ..utils.thread_manager import ThreadManager
+from .batch import (PendingSolve, SolveRequest, execute_batch,
+                    split_batches)
+from .cache import SetupCache
+from .session import SessionKey, config_hash
+
+
+class SolveService:
+    def __init__(self, config, start: bool = True):
+        cfg = config if isinstance(config, AMGConfig) \
+            else AMGConfig(config)
+        self.cfg = cfg
+        g = lambda name: cfg.get(name)
+        self.queue_depth = int(g("serve_queue_depth"))
+        self.batch_window_s = float(g("serve_batch_window_ms")) / 1e3
+        self.max_batch = int(g("serve_max_batch"))
+        self.default_deadline_s = float(g("serve_deadline_ms")) / 1e3
+        #: the service's config never changes — hash it once, not per
+        #: submit (the pattern fingerprint side is cached on the Matrix)
+        self._cfg_hash = config_hash(cfg)
+        self.cache = SetupCache(int(g("serve_cache_bytes")))
+        self._tm = ThreadManager(max_workers=int(g("serve_workers")))
+        self._cond = threading.Condition()
+        self._queue: List[SolveRequest] = []
+        #: requests drained from the queue whose batch has not finished
+        #: (drain() must wait these out too — a request between queue
+        #: and worker would otherwise be invisible to it)
+        self._inflight = 0
+        self._accepting = False
+        self._running = False
+        self._dispatcher: Optional[threading.Thread] = None
+        self._latencies: List[float] = []      # completed-request seconds
+        self._lat_lock = threading.Lock()
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Spawn the dispatcher + worker pool and open admission
+        (idempotent)."""
+        with self._cond:
+            self._accepting = True
+            if self._running:
+                return self
+            self._running = True
+        self._tm.spawn_threads()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            name="amgx-serve-dispatch",
+                                            daemon=True)
+        self._dispatcher.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Stop admitting, flush every queued request, finish in-flight
+        batches.  Returns True when everything completed in time."""
+        with self._cond:
+            self._accepting = False
+            self._cond.notify_all()
+        t_end = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._queue or self._inflight:
+                left = None if t_end is None else t_end - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(timeout=min(left or 0.05, 0.05))
+        self._tm.wait_threads()
+        return True
+
+    def shutdown(self, timeout: Optional[float] = None) -> bool:
+        """Graceful stop: drain, stop the dispatcher, join workers."""
+        ok = self.drain(timeout)
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=5.0)
+            self._dispatcher = None
+        self._tm.join_threads()
+        return ok
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # ------------------------------------------------------------ admission
+    def submit(self, matrix: Matrix, b, x0=None,
+               deadline_s: Optional[float] = None) -> PendingSolve:
+        """Queue one solve.  Never blocks: over capacity (or after
+        drain/shutdown) the returned handle is already completed with
+        ``rc == RC.REJECTED`` — the backpressure signal callers must
+        check before waiting."""
+        ddl = deadline_s if deadline_s is not None \
+            else (self.default_deadline_s or None)
+        now = time.monotonic()
+        req = SolveRequest(
+            matrix=matrix, b=b, x0=x0,
+            key=SessionKey(config=self._cfg_hash,
+                           pattern=matrix.pattern_fingerprint()),
+            values_fp=matrix.values_fingerprint(),
+            submitted_t=now,
+            deadline_t=(now + ddl) if ddl else None)
+        with self._cond:
+            # admission counts OUTSTANDING work — queued AND drained-but-
+            # unfinished — against the capacity: the dispatcher empties
+            # the queue every window, so len(queue) alone would let a
+            # sustained overload pile unbounded work into the pool
+            outstanding = len(self._queue) + self._inflight
+            accepting = self._accepting
+            reject = not accepting or outstanding >= self.queue_depth
+            if not reject:
+                self._queue.append(req)
+                telemetry.gauge_set("amgx_serve_queue_depth",
+                                    len(self._queue))
+                self._cond.notify_all()
+        # counters live under ONE lock (_lat_lock, shared with the
+        # worker-side completion/deadline accounting) so concurrent
+        # admission and deadline sheds never lose an increment
+        if reject:
+            reason = "queue_full" if accepting else "draining"
+            with self._lat_lock:
+                self.rejected += 1
+            telemetry.counter_inc("amgx_serve_rejected_total",
+                                  reason=reason)
+            telemetry.counter_inc("amgx_serve_requests_total",
+                                  status="REJECTED")
+            req.complete(None, rc=RC.REJECTED,
+                         error=f"admission rejected: {reason}")
+            return PendingSolve(req)
+        with self._lat_lock:
+            self.submitted += 1
+        return PendingSolve(req)
+
+    def solve(self, matrix: Matrix, b, x0=None,
+              timeout: Optional[float] = None):
+        """Convenience: submit + wait.  Raises on rejection."""
+        from ..errors import AMGXError
+        p = self.submit(matrix, b, x0=x0)
+        if p.rc != RC.OK:
+            raise AMGXError(p.error or "request rejected", p.rc)
+        res = p.wait(timeout)
+        if p.rc != RC.OK or res is None:
+            raise AMGXError(p.error or "request failed",
+                            p.rc if p.rc != RC.OK else RC.UNKNOWN)
+        return res
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                while self._running and not self._queue:
+                    self._cond.wait(timeout=0.05)
+                if not self._running and not self._queue:
+                    return
+                if not self._queue:
+                    continue
+                # batching window: once work exists, wait a beat for
+                # same-operator companions to arrive (skipped when the
+                # queue already holds a full batch)
+                if self.batch_window_s > 0 and \
+                        len(self._queue) < self.max_batch:
+                    self._cond.wait(timeout=self.batch_window_s)
+                drained, self._queue = self._queue, []
+                self._inflight += len(drained)
+                telemetry.gauge_set("amgx_serve_queue_depth", 0)
+            for batch in split_batches(drained, self.max_batch):
+                self._tm.push_work(self._batch_task(batch))
+
+    def _batch_task(self, batch: List[SolveRequest]):
+        def run():
+            try:
+                session, _created = self.cache.get_or_create(
+                    self.cfg, batch[0].matrix, key=batch[0].key)
+                execute_batch(session, batch, cache=self.cache)
+                done_t = time.monotonic()
+                with self._lat_lock:
+                    self.completed += sum(1 for r in batch
+                                          if r.rc == RC.OK)
+                    # deadline sheds happen here, past admission — they
+                    # must show in stats() like any other rejection
+                    self.rejected += sum(1 for r in batch
+                                         if r.rc == RC.REJECTED)
+                    for r in batch:
+                        if r.rc == RC.OK:
+                            self._latencies.append(done_t - r.submitted_t)
+                    del self._latencies[:-4096]
+            except Exception as e:    # noqa: BLE001 — swallowed ON PURPOSE:
+                # the failure is delivered through the request handles
+                # below; letting it reach the future would make a later
+                # drain()'s wait_threads() re-raise it mid-shutdown
+                msg = f"{type(e).__name__}: {e}"
+                for r in batch:
+                    if not r.done():
+                        r.complete(None, rc=RC.UNKNOWN, error=msg)
+            finally:
+                for r in batch:
+                    if not r.done():     # belt-and-braces: no waiter hangs
+                        r.complete(None, rc=RC.UNKNOWN,
+                                   error="batch task failed")
+                with self._cond:
+                    self._inflight -= len(batch)
+                    self._cond.notify_all()
+        return run
+
+    # ---------------------------------------------------------------- stats
+    def latency_percentiles(self) -> dict:
+        """p50/p95/p99 of completed-request latency (seconds), computed
+        over the most recent completions."""
+        with self._lat_lock:
+            lat = sorted(self._latencies)
+        if not lat:
+            return {"p50": None, "p95": None, "p99": None}
+
+        def pct(p):
+            i = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
+            return lat[i]
+
+        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+
+    def reset_latency_stats(self):
+        """Drop collected request latencies (benchmark warm-up: separate
+        the compile-heavy first requests from steady-state numbers)."""
+        with self._lat_lock:
+            self._latencies.clear()
+
+    def stats(self) -> dict:
+        with self._cond:
+            depth = len(self._queue)
+        with self._lat_lock:
+            submitted, completed, rejected = \
+                self.submitted, self.completed, self.rejected
+        return {
+            "submitted": submitted,
+            "completed": completed,
+            "rejected": rejected,
+            "queue_depth": depth,
+            "queue_capacity": self.queue_depth,
+            "workers": self._tm._max_workers,
+            "worker_task_failures": self._tm.failed_tasks,
+            "latency_s": self.latency_percentiles(),
+            "cache": self.cache.stats(),
+        }

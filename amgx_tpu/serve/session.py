@@ -1,0 +1,141 @@
+"""Solver sessions: the serving layer's unit of setup reuse.
+
+A :class:`SolverSession` owns one configured solver (and, for AMG
+configs, its hierarchy) keyed by :class:`SessionKey` — the pair of the
+config hash and the matrix's sparsity-pattern fingerprint
+(``core.matrix.Matrix.pattern_fingerprint``).  Every request carrying
+the same key reuses the session; within a session the VALUES
+fingerprint decides how much work reuse buys:
+
+* equal values → the prepared solver is reused outright (``reuse``);
+* same pattern, new values → ``Solver.resetup`` — the
+  replace-coefficients path that keeps compiled executables, hierarchy
+  structure and nested solver instances (reference contract:
+  ``AMGX_solver_resetup``, same structure / new values);
+* a fresh session pays the one full ``Solver.setup``.
+
+Sessions are thread-safe: the lock serialises prepare/solve on one
+session while distinct sessions run concurrently on the service's
+worker pool.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import List, Optional
+
+from ..config import AMGConfig
+from ..core.matrix import Matrix
+
+
+def config_hash(cfg: AMGConfig) -> str:
+    """Stable digest of every (scope, name) → value entry — two configs
+    that resolve identically share sessions regardless of the source
+    text's entry order."""
+    items = sorted((scope, name, str(v), str(ns))
+                   for (scope, name), (v, ns) in cfg._params.items())
+    return hashlib.blake2b(repr(items).encode(),
+                           digest_size=12).hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionKey:
+    """(config hash, sparsity-pattern fingerprint) — equal keys may
+    share one solver hierarchy via resetup."""
+
+    config: str
+    pattern: str
+
+
+def session_key(cfg: AMGConfig, matrix: Matrix) -> SessionKey:
+    return SessionKey(config=config_hash(cfg),
+                      pattern=matrix.pattern_fingerprint())
+
+
+class SolverSession:
+    """One configured solver + its setup state, reusable across
+    same-pattern requests."""
+
+    def __init__(self, key: SessionKey, cfg: AMGConfig):
+        from ..solvers import SolverFactory
+        self.key = key
+        self.lock = threading.RLock()
+        self.solver = SolverFactory.allocate(cfg, "default", "solver")
+        self.solver._toplevel = True
+        #: values fingerprint the solver is currently prepared for
+        self.values_fp: Optional[str] = None
+        self.full_setups = 0
+        self.resetups = 0
+        self.value_hits = 0
+        self.last_used = time.monotonic()
+        #: device bytes of the prepared hierarchy (cache accounting;
+        #: refreshed by the cache after each prepare)
+        self.bytes = 0
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, matrix: Matrix) -> str:
+        """Make the solver ready for ``matrix``'s values; returns the
+        work actually done: ``"full"`` | ``"resetup"`` | ``"reuse"``."""
+        vfp = matrix.values_fingerprint()
+        with self.lock:
+            self.last_used = time.monotonic()
+            if self.solver.Ad is None:
+                self.solver.setup(matrix)
+                self.full_setups += 1
+                self.values_fp = vfp
+                return "full"
+            if vfp == self.values_fp:
+                self.value_hits += 1
+                return "reuse"
+            self.solver.resetup(matrix)
+            self.resetups += 1
+            self.values_fp = vfp
+            return "resetup"
+
+    # --------------------------------------------------------------- solve
+    def solve_batch(self, B, X0=None, pad_to_bucket: bool = False
+                    ) -> List:
+        """Multi-RHS solve under the session lock (one session's solver
+        state is not reentrant; distinct sessions overlap freely)."""
+        with self.lock:
+            self.last_used = time.monotonic()
+            return self.solver.solve_multi(B, X0=X0,
+                                           pad_to_bucket=pad_to_bucket)
+
+    def prepare_and_solve(self, matrix: Matrix, B, X0=None,
+                          pad_to_bucket: bool = False):
+        """Atomic prepare + batched solve: (kind, results).  The lock is
+        held across BOTH steps — two same-pattern batches with different
+        values racing on one session must not interleave a resetup
+        between the other's prepare and solve (the solve would run
+        against the wrong coefficients)."""
+        with self.lock:
+            kind = self.prepare(matrix)
+            return kind, self.solver.solve_multi(
+                B, X0=X0, pad_to_bucket=pad_to_bucket)
+
+    # ---------------------------------------------------------- accounting
+    def device_bytes(self) -> int:
+        """Resident device bytes of the prepared solver (hierarchy,
+        smoother arrays, matrix pack) — what evicting this session would
+        free."""
+        from ..utils.memory import device_tree_bytes
+        with self.lock:
+            if self.solver.Ad is None:
+                return 0
+            if self.solver._bindings is not None:
+                return device_tree_bytes(self.solver._bindings.collect())
+            from ..solvers._bind import DeviceBindings
+            return device_tree_bytes(DeviceBindings(self.solver).collect())
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "pattern": self.key.pattern,
+                "full_setups": self.full_setups,
+                "resetups": self.resetups,
+                "value_hits": self.value_hits,
+                "bytes": self.bytes,
+            }

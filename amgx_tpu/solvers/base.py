@@ -185,6 +185,7 @@ class Solver:
         self.scaler = None
         self._solve_fn = None
         self._refined_fn = None
+        self._solve_multi = None
         self._bindings = None
         self.setup_time = 0.0
 
@@ -262,7 +263,8 @@ class Solver:
         with cpu_profiler(f"setup:{self.config_name}"):
             self.solver_setup()
         if getattr(self, "_numeric_resetup", False) \
-                and self._solve_fn is not None \
+                and (self._solve_fn is not None
+                     or self._solve_multi is not None) \
                 and self._bindings is not None:
             # numeric re-setup (resetup() only — a plain setup() keeps
             # its full-rebuild contract): keep the jitted executables and
@@ -282,6 +284,11 @@ class Solver:
         else:
             self._solve_fn = None
             self._refined_fn = None
+            self._solve_multi = None
+            # a full rebuild replaces hierarchy/level objects: bindings
+            # slots referencing the OLD objects would keep serving stale
+            # device data to a later solve_multi
+            self._bindings = None
             # new matrix values ⇒ stale rounding residue; next refined
             # solve rebuilds it (and the bindings that carry it)
             if hasattr(self, "_refine_lo"):
@@ -520,20 +527,13 @@ class Solver:
             if refine:
                 self._ensure_refine_data()
             self._bindings = DeviceBindings(self)
+            # the batched executable closes over the bindings object —
+            # a rebuilt bindings set means it must re-bind too
+            self._solve_multi = None
             if dist:
                 self._bindings.normalize_placement(self.Ad.mesh)
-            body = self._build_solve_fn()
-
-            def packed(b, x0, tol, it_limit):
-                x, it, nrm, nrm_ini, history = body(b, x0, tol, it_limit)
-                stats = jnp.concatenate([
-                    it[None].astype(jnp.float64),
-                    jnp.ravel(nrm).astype(jnp.float64),
-                    jnp.ravel(nrm_ini).astype(jnp.float64)])
-                return x, stats, history
-
             self._solve_fn = jax.jit(
-                bind_for_trace(self._bindings, packed))
+                bind_for_trace(self._bindings, self._packed_solve_fn()))
             self._refined_fn = None
 
         t0 = time.perf_counter()
@@ -611,6 +611,203 @@ class Solver:
         return SolveResult(x=x, iterations=iters, status=status,
                            residual_norm=nrm, residual_history=history_np,
                            setup_time=self.setup_time, solve_time=solve_time)
+
+    def _packed_solve_fn(self) -> Callable:
+        """The solve body with (iters, nrm, nrm_ini) packed into one f64
+        stats vector — ONE small host fetch per solve.  Shared by the
+        single-RHS driver and the vmapped multi-RHS driver so both stay
+        on the same wire layout (decoded as ``(len - 1) // 2``)."""
+        body = self._build_solve_fn()
+
+        def packed(b, x0, tol, it_limit):
+            x, it, nrm, nrm_ini, history = body(b, x0, tol, it_limit)
+            stats = jnp.concatenate([
+                it[None].astype(jnp.float64),
+                jnp.ravel(nrm).astype(jnp.float64),
+                jnp.ravel(nrm_ini).astype(jnp.float64)])
+            return x, stats, history
+
+        return packed
+
+    # ------------------------------------------------------ multi-RHS solve
+    def solve_multi(self, B, X0=None, zero_initial_guess: bool = False,
+                    pad_to_bucket: bool = False) -> "list[SolveResult]":
+        """Batched solve of k right-hand sides against ONE operator in a
+        single executable — the serving layer's micro-batch path
+        (serve/batch.py).
+
+        ``B`` is (k, n) (or a sequence of k vectors); returns one
+        :class:`SolveResult` per RHS.  The batched loop is the
+        single-RHS solve body vmapped over the RHS axis: per-request
+        convergence monitoring is preserved (the batched ``while_loop``
+        runs until every lane is done, a converged lane's state frozen
+        by the standard select-masking), so one RHS can converge in 3
+        iterations while its batchmate runs to the iteration limit, each
+        reporting its own count, status and true final residual.
+        Configurations whose executable shape is not RHS-batchable —
+        distributed operators, mixed-precision refinement below the
+        dtype floor, device-pinned host-mode packs — fall back to
+        sequential :meth:`solve` calls with identical per-request
+        results.
+
+        ``pad_to_bucket`` (the serving micro-batcher's mode): pad the
+        batch axis to the next power of two with zero RHS so a stream
+        of ragged batch sizes compiles at most log2(max) executables —
+        pad lanes converge at iteration 0, are excluded from telemetry,
+        and only the k live results are returned."""
+        if self.Ad is None:
+            raise BadConfigurationError("solve_multi() before setup()")
+        B = [B[i] for i in range(B.shape[0])] \
+            if isinstance(B, (np.ndarray, jax.Array)) and np.ndim(B) == 2 \
+            else list(B)
+        k = len(B)
+        if k == 0:
+            return []
+        dtype = self.Ad.dtype
+        dist = self.Ad.fmt == "sharded-ell"
+        floor = self._tolerance_floor(dtype)
+        refine = (self.monitor_residual and self.tolerance < floor
+                  and not dist and self.scaler is None
+                  and self.A is not None
+                  and jnp.dtype(dtype) == jnp.float32
+                  and np.dtype(self.A.dtype).itemsize >
+                  np.dtype(dtype).itemsize)
+        pin = None
+        if not dist:
+            try:
+                devs = list(self.Ad.diag.devices())
+                if len(devs) == 1 and devs[0] != jax.devices()[0]:
+                    pin = devs[0]
+            except Exception:
+                pin = None
+        if k == 1 or dist or refine or pin is not None:
+            out = []
+            for j, bj in enumerate(B):
+                xj = None if X0 is None else X0[j]
+                out.append(self.solve(bj, x0=xj,
+                                      zero_initial_guess=
+                                      zero_initial_guess))
+            return out
+
+        Bm = np.stack([np.asarray(bj).ravel() for bj in B])
+        if self.scaler is not None:
+            Bm = np.stack([self.scaler.scale_rhs(r.astype(dtype))
+                           for r in Bm])
+        X0m = None
+        if X0 is not None and not zero_initial_guess:
+            X0m = np.stack([np.asarray(x).ravel() for x in X0])
+            if self.scaler is not None:
+                X0m = np.stack([self.scaler.scale_initial_guess(
+                    r.astype(dtype)) for r in X0m])
+        if self._reorder is not None:
+            perm, _ = self._reorder
+            Bm = Bm[:, perm]
+            if X0m is not None:
+                X0m = X0m[:, perm]
+        if pad_to_bucket:
+            bucket = 1
+            while bucket < k:
+                bucket <<= 1
+            if bucket > k:
+                Bm = np.concatenate(
+                    [Bm, np.zeros((bucket - k, Bm.shape[1]), Bm.dtype)])
+                if X0m is not None:
+                    X0m = np.concatenate(
+                        [X0m, np.zeros((bucket - k, X0m.shape[1]),
+                                       X0m.dtype)])
+        Bd = jnp.asarray(Bm, dtype)
+        X0d = jnp.zeros_like(Bd) if X0m is None \
+            else jnp.asarray(X0m, dtype)
+
+        if self._solve_multi is None:
+            from ._bind import DeviceBindings, bind_for_trace
+            if self._bindings is None:
+                self._bindings = DeviceBindings(self)
+            bindings = self._bindings
+            vm = jax.vmap(self._packed_solve_fn(),
+                          in_axes=(0, 0, None, None))
+            self._solve_multi = (bindings,
+                                 jax.jit(bind_for_trace(bindings, vm)))
+        bindings, fn = self._solve_multi
+
+        t0 = time.perf_counter()
+        with telemetry.span("solve_multi", solver=self.config_name,
+                            scope=self.scope, batch=k), \
+                cpu_profiler(f"solve_multi:{self.config_name}"):
+            rdt = np.zeros((), dtype).real.dtype
+            X, stats, history = fn(
+                bindings.collect(), Bd, X0d,
+                jnp.asarray(self.tolerance, rdt),
+                jnp.asarray(self.max_iters, jnp.int32))
+            stats = np.asarray(stats)      # ONE host fetch: (k, 1+2m)
+        solve_time = time.perf_counter() - t0
+        Xh = None
+        if self._reorder is not None or self.scaler is not None:
+            Xh = np.asarray(X)
+        hist_all = None
+        if self.store_res_history or self.print_solve_stats \
+                or self.convergence in ("RELATIVE_MAX",
+                                        "RELATIVE_MAX_CORE"):
+            # RELATIVE_MAX needs the monitored trajectory for the true
+            # running max even when the caller didn't ask to keep it —
+            # same as solve()'s nrm_max recovery
+            hist_all = np.asarray(history)
+
+        results = []
+        m = (stats.shape[1] - 1) // 2
+        for j in range(k):
+            iters = int(stats[j, 0])
+            nrm = np.atleast_1d(stats[j, 1:1 + m])
+            nrm_ini = np.atleast_1d(stats[j, 1 + m:])
+            if Xh is not None:
+                xj = Xh[j]
+                if self._reorder is not None:
+                    xj = xj[self._reorder[1]]
+                if self.scaler is not None:
+                    xj = self.scaler.unscale_solution(np.asarray(xj))
+            else:
+                xj = X[j]
+            history_np = None
+            if hist_all is not None:
+                history_np = np.atleast_2d(hist_all[j])[:iters + 1]
+            if self.monitor_residual:
+                nrm_max = nrm_ini
+                if self.convergence in ("RELATIVE_MAX",
+                                        "RELATIVE_MAX_CORE") \
+                        and history_np is not None:
+                    h = history_np[np.isfinite(history_np).all(axis=1)] \
+                        if history_np.size else history_np
+                    if h.size:
+                        nrm_max = np.maximum(nrm_ini, h.max(axis=0))
+                conv = bool(np.all(self._host_converged(nrm, nrm_ini,
+                                                        nrm_max)))
+                diverged = bool(np.any(~np.isfinite(nrm)))
+                status = (SolveStatus.SUCCESS if conv else
+                          (SolveStatus.DIVERGED if diverged
+                           else SolveStatus.NOT_CONVERGED))
+            else:
+                status = SolveStatus.SUCCESS
+            if telemetry.is_enabled():
+                label = ("SUCCESS" if status == SolveStatus.SUCCESS
+                         else ("DIVERGED"
+                               if bool(np.any(~np.isfinite(nrm)))
+                               else "NOT_CONVERGED"))
+                telemetry.counter_inc("amgx_solves_total", status=label)
+            results.append(SolveResult(
+                x=xj, iterations=iters, status=status,
+                residual_norm=nrm,
+                # history is RETURNED only on request (solve() parity);
+                # a RELATIVE_MAX fetch above serves the status math only
+                residual_history=(history_np
+                                  if self.store_res_history
+                                  or self.print_solve_stats else None),
+                setup_time=self.setup_time, solve_time=solve_time))
+        if telemetry.is_enabled():
+            telemetry.hist_observe("amgx_solve_seconds", solve_time)
+            telemetry.gauge_set("amgx_last_solve_seconds", solve_time)
+            if self.telemetry_path:
+                telemetry.flush_jsonl(self.telemetry_path)
+        return results
 
     def _emit_solve_telemetry(self, iters, nrm, nrm_ini, status,
                               history, solve_time):

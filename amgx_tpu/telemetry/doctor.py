@@ -138,6 +138,11 @@ def diagnose(paths: List[str]) -> dict:
             if r["kind"] != "hist" or not r["name"].startswith("amgx_") \
                     or not r["name"].endswith("_seconds"):
                 continue
+            if r["name"].startswith("amgx_serve_"):
+                # request latency is not a wall phase (overlapping
+                # requests sum past wall time); the serving section
+                # reports it as percentiles instead
+                continue
             key = r["name"][len("amgx_"):-len("_seconds")]
             d = phases.setdefault(key, {"count": 0, "total_s": 0.0})
             d["count"] += 1
@@ -198,6 +203,51 @@ def diagnose(paths: List[str]) -> dict:
     halo_local_ratio = None
     if halo_per_apply and local_bytes:
         halo_local_ratio = round(halo_per_apply / local_bytes, 4)
+
+    # ---- serving (amgx_tpu/serve/) ----------------------------------
+    req_total, req_by = csum("amgx_serve_requests_total")
+    rej_total, rej_by = csum("amgx_serve_rejected_total")
+    setups_total, setups_by = csum("amgx_serve_setup_total")
+    cache_hits, _ = csum("amgx_serve_cache_hits_total")
+    cache_misses, _ = csum("amgx_serve_cache_misses_total")
+    cache_evict, _ = csum("amgx_serve_cache_evictions_total")
+    batch_sizes, req_lat = [], []
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] != "hist":
+                continue
+            if r["name"] == "amgx_serve_batch_size":
+                batch_sizes.append(float(r["value"]))
+            elif r["name"] == "amgx_serve_request_seconds":
+                req_lat.append(float(r["value"]))
+    serving = None
+    if req_total or batch_sizes or cache_hits or cache_misses:
+        req_lat.sort()
+
+        def _pct(p):
+            if not req_lat:
+                return None
+            return req_lat[min(len(req_lat) - 1,
+                               int(round(p * (len(req_lat) - 1))))]
+
+        serving = {
+            "requests": {k: int(v) for k, v in sorted(req_by.items())},
+            "rejections": {k: int(v) for k, v in sorted(rej_by.items())},
+            "setup_kinds": {k: int(v)
+                            for k, v in sorted(setups_by.items())},
+            "cache": {"hits": int(cache_hits),
+                      "misses": int(cache_misses),
+                      "evictions": int(cache_evict)},
+            "batches": {
+                "count": len(batch_sizes),
+                "mean_size": (round(sum(batch_sizes) / len(batch_sizes),
+                                    2) if batch_sizes else None),
+                "max_size": (int(max(batch_sizes))
+                             if batch_sizes else None),
+            },
+            "latency_s": {"p50": _pct(0.50), "p95": _pct(0.95),
+                          "p99": _pct(0.99)},
+        }
 
     # ---- convergence ------------------------------------------------
     conv = {}
@@ -265,6 +315,24 @@ def diagnose(paths: List[str]) -> dict:
     if jit:
         hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
                      "landed inside a timed region, warm up first")
+    if serving:
+        if rej_total:
+            hints.append(
+                f"serving shed {int(rej_total)} request(s) "
+                f"({', '.join(f'{k}: {int(v)}' for k, v in sorted(rej_by.items()))})"
+                " — raise serve_queue_depth, add serve_workers, or relax "
+                "deadlines")
+        full = sum(v for k, v in setups_by.items() if "kind=full" in k)
+        completed = sum(v for k, v in req_by.items()
+                        if "status=SUCCESS" in k or "status=FAILED" in k)
+        if completed and full >= completed:
+            hints.append(
+                "no setup reuse: every served request paid a full setup "
+                "— requests never shared a (config, pattern) session")
+        fails, _ = csum("amgx_worker_task_failures_total")
+        if fails:
+            hints.append(f"{int(fails)} worker task(s) raised — the pool "
+                         "survived, but check the service error log")
 
     return {
         "files": list(paths),
@@ -287,6 +355,7 @@ def diagnose(paths: List[str]) -> dict:
             "boundary_fraction": bnd,
             "halo_local_ratio": halo_local_ratio,
         },
+        "serving": serving,
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
         "hints": hints,
@@ -375,6 +444,29 @@ def render(d: dict) -> str:
                      f"{dist['halo_local_ratio']:.3f}")
         for dev, f in sorted(dist["boundary_fraction"].items()):
             L.append(f"  boundary fraction [device {dev}]: {f:.3f}")
+
+    srv = d.get("serving")
+    if srv:
+        L.append("")
+        L.append("serving")
+        L.append("-" * 40)
+        for k, v in srv["requests"].items():
+            L.append(f"  requests {k:<20} {v}")
+        for k, v in srv["rejections"].items():
+            L.append(f"  REJECTED {k:<20} {v}")
+        for k, v in srv["setup_kinds"].items():
+            L.append(f"  setup {k:<23} {v}")
+        c = srv["cache"]
+        L.append(f"  cache hits/misses/evictions: {c['hits']}/"
+                 f"{c['misses']}/{c['evictions']}")
+        b = srv["batches"]
+        if b["count"]:
+            L.append(f"  batches: {b['count']} (mean {b['mean_size']}, "
+                     f"max {b['max_size']} RHS)")
+        lat = srv["latency_s"]
+        if lat["p50"] is not None:
+            L.append(f"  latency p50/p95/p99: {lat['p50']*1e3:.1f}/"
+                     f"{lat['p95']*1e3:.1f}/{lat['p99']*1e3:.1f} ms")
 
     conv = d["convergence"]
     if conv:

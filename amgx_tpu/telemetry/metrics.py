@@ -89,11 +89,39 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("histogram", "solve wall seconds"),
     "amgx_jit_compile_seconds":
         ("histogram", "XLA backend compile wall seconds"),
+    # ---- serving subsystem (amgx_tpu/serve/, PR 4) ------------------
+    "amgx_serve_requests_total":
+        ("counter", "serving requests completed by outcome {status}"),
+    "amgx_serve_rejected_total":
+        ("counter", "serving admission rejections {reason}"),
+    "amgx_serve_queue_depth":
+        ("gauge", "requests waiting in the serving admission queue"),
+    "amgx_serve_batch_size":
+        ("histogram", "RHS count of one executed micro-batch"),
+    "amgx_serve_request_seconds":
+        ("histogram", "request latency, submit to completed result"),
+    "amgx_serve_cache_hits_total":
+        ("counter", "setup-cache lookups that found a session"),
+    "amgx_serve_cache_misses_total":
+        ("counter", "setup-cache lookups that created a session"),
+    "amgx_serve_cache_evictions_total":
+        ("counter", "sessions evicted by the cache byte budget"),
+    "amgx_serve_cache_bytes":
+        ("gauge", "resident device bytes of cached sessions"),
+    "amgx_serve_setup_total":
+        ("counter", "session preparations by kind "
+                    "{kind=full|resetup|reuse}"),
+    "amgx_worker_task_failures_total":
+        ("counter", "worker-pool tasks that raised (pool survives)"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
                    60.0)
+#: count-valued histogram buckets (micro-batch sizes)
+COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+#: histograms whose unit is a count, not seconds
+_COUNT_HISTS = frozenset({"amgx_serve_batch_size"})
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -152,7 +180,9 @@ class MetricsRegistry:
         with self._lock:
             h = self._hists.get(key)
             if h is None:
-                h = self._hists[key] = _Hist()
+                bounds = COUNT_BUCKETS if name in _COUNT_HISTS \
+                    else DEFAULT_BUCKETS
+                h = self._hists[key] = _Hist(bounds)
             h.observe(value)
 
     # -------------------------------------------------------------- query

@@ -50,3 +50,22 @@ _info = MemoryInfo()
 
 def memory_info() -> MemoryInfo:
     return _info
+
+
+def device_tree_bytes(tree) -> int:
+    """Total device bytes of a pytree's array leaves — the per-session
+    accounting unit of the serving setup cache (serve/cache.py): one
+    prepared solver's bindings pytree is exactly its resident hierarchy
+    + smoother data, so summing leaf ``nbytes`` prices a cache entry
+    without touching backend allocator stats."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            try:
+                total += int(nb)
+            except Exception:
+                pass
+    return total

@@ -9,7 +9,12 @@ execution.
 Here the pool overlaps the HOST side of per-level setup (coloring,
 slab packing, diagonal inversion in numpy/scipy, which release the GIL)
 and the async device uploads those setups dispatch.  Tasks must be
-independent — the hierarchy's per-level smoother setups are.
+independent — the hierarchy's per-level smoother setups are.  The
+serving layer (amgx_tpu/serve/) runs its batch solves on the same pool
+shape, so task failures are survivable: a raising task is counted
+(``amgx_worker_task_failures_total``) and recorded, the worker and pool
+stay alive, and :meth:`wait_threads` re-raises the first failure to the
+caller that asked for the results.
 """
 from __future__ import annotations
 
@@ -27,27 +32,83 @@ class ThreadManager:
         self._max_workers = max_workers
         self._futures: List[concurrent.futures.Future] = []
         self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+        self._fail_lock = threading.Lock()
+        self._spawn_lock = threading.Lock()
+        #: first exception harvested from a PRUNED completed future —
+        #: wait_threads re-raises it so pruning never swallows a failure
+        self._pending_exc: Optional[BaseException] = None
+        #: tasks that raised since construction (cumulative; the pool
+        #: survives every one of them)
+        self.failed_tasks = 0
 
     # ------------------------------------------------ reference API names
     def spawn_threads(self) -> None:
-        if not self.serialize and self._pool is None:
-            self._pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=self._max_workers,
-                thread_name_prefix="amgx-setup")
+        # locked: concurrent first pushes auto-spawn (push_work below) —
+        # an unlocked check-then-create would leak a second executor
+        with self._spawn_lock:
+            if not self.serialize and self._pool is None:
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="amgx-worker")
+
+    def _guard(self, task: Callable[[], None]):
+        """Exception-safe task wrapper: count + record the failure (the
+        telemetry counter makes silent worker deaths observable) and
+        re-raise into the future so :meth:`wait_threads` keeps its
+        fail-the-caller contract.  The executor worker itself survives
+        and keeps draining the queue."""
+        try:
+            return task()
+        except BaseException:
+            with self._fail_lock:
+                self.failed_tasks += 1
+            try:
+                from ..telemetry import metrics as _m
+                _m.counter_inc("amgx_worker_task_failures_total")
+            except Exception:
+                pass    # telemetry must never mask the task's failure
+            raise
 
     def push_work(self, task: Callable[[], None]) -> None:
-        """Queue one AsyncTask; runs inline under ``serialize_threads``."""
-        if self.serialize or self._pool is None:
-            task()
+        """Queue one AsyncTask; runs inline under ``serialize_threads``.
+
+        ``push_work`` before :meth:`spawn_threads` auto-spawns the pool
+        (the old behaviour ran the task inline, silently serialising a
+        caller that forgot to spawn)."""
+        if self.serialize:
+            self._guard(task)
             return
-        self._futures.append(self._pool.submit(task))
+        if self._pool is None:
+            self.spawn_threads()
+        self._futures.append(self._pool.submit(self._guard, task))
+        if len(self._futures) >= 512:
+            # long-running users (the serving dispatcher) push work for
+            # the process lifetime and only wait at drain — prune
+            # completed futures so the list stays bounded, harvesting
+            # any failure for the next wait_threads
+            keep = []
+            for f in self._futures:
+                if f.done():
+                    exc = f.exception()
+                    if exc is not None and self._pending_exc is None:
+                        self._pending_exc = exc
+                else:
+                    keep.append(f)
+            self._futures = keep
 
     def wait_threads(self) -> None:
         """Block until every queued task finished; re-raise the first
         failure (a failed smoother setup must fail the hierarchy setup)."""
         futures, self._futures = self._futures, []
+        first_exc, self._pending_exc = self._pending_exc, None
         for f in futures:
-            f.result()
+            try:
+                f.result()
+            except BaseException as e:
+                if first_exc is None:
+                    first_exc = e
+        if first_exc is not None:
+            raise first_exc
 
     def join_threads(self) -> None:
         self.wait_threads()

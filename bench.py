@@ -234,6 +234,74 @@ def _run_case_inner(oracle, make_matrix, cfg, dtype, sync_shape=None,
             "pack": pack_kind(Ad)}
 
 
+def _bench_serving(n_side: int = 12, n_requests: int = 32):
+    """Serving-mode benchmark: drive the request-level layer
+    (amgx_tpu/serve/) with concurrent same-pattern traffic and report
+    latency percentiles + cache/batch behaviour — the SLO-shaped
+    numbers (p50/p95/p99, throughput) the solve-time headline cannot
+    show.  Small operator on purpose: this measures the serving
+    machinery (admission, batching, session reuse), not SpMV."""
+    import numpy as np
+
+    import amgx_tpu as amgx
+    from amgx_tpu.io import poisson7pt
+    from amgx_tpu.serve import SolveService
+
+    A = poisson7pt(n_side, n_side, n_side)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=200, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        "serve_batch_window_ms=2, serve_workers=2, serve_max_batch=8")
+    m = amgx.Matrix(A)
+    rng = np.random.default_rng(5)
+    n = A.shape[0]
+    svc = SolveService(cfg)
+    try:
+        # warm: first request pays setup + the k=1 compile; batch sizes
+        # are bucketed to powers of two (serve/batch.py), so compiling
+        # each bucket width ONCE leaves the timed wave compile-free —
+        # the steady state a long-running service sits in
+        svc.solve(m, rng.standard_normal(n), timeout=300)
+        sess, _ = svc.cache.get_or_create(svc.cfg, m)
+        for w in (2, 4, 8):
+            sess.solve_batch(rng.standard_normal((w, n)))
+        svc.reset_latency_stats()
+        t0 = time.perf_counter()
+        pend = [svc.submit(m, rng.standard_normal(n))
+                for _ in range(n_requests)]
+        ok = sum(1 for p in pend
+                 if p.wait(300) is not None and p.rc == 0)
+        wall = time.perf_counter() - t0
+        lat = svc.latency_percentiles()
+        st = svc.stats()
+        return {
+            "n": int(n),
+            "requests": int(n_requests),
+            "completed": int(ok),
+            "wall_s": round(wall, 4),
+            "throughput_rps": round(n_requests / wall, 1),
+            "p50_ms": (round(lat["p50"] * 1e3, 2)
+                       if lat["p50"] is not None else None),
+            "p95_ms": (round(lat["p95"] * 1e3, 2)
+                       if lat["p95"] is not None else None),
+            "p99_ms": (round(lat["p99"] * 1e3, 2)
+                       if lat["p99"] is not None else None),
+            "cache": {k: st["cache"][k] for k in
+                      ("sessions", "hits", "misses", "evictions")},
+            "setups": {k: st["cache"]["by_session"][0][k]
+                       for k in ("full_setups", "resetups", "value_hits")}
+            if st["cache"]["by_session"] else {},
+            "rejected": int(st["rejected"]),
+        }
+    finally:
+        svc.shutdown()
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -670,6 +738,17 @@ def main():
         extra_cases["classical_device_resetup48"] = guarded(
             "classical_device_resetup48", case_resetup)
 
+    # serving mode (amgx_tpu/serve/): request-level latency percentiles
+    # + cache/batch stats, mirroring the PR 3 telemetry embedding — a
+    # transient failure must not take down the headline JSON line
+    try:
+        serving = _bench_serving()
+    except Exception as e:
+        import traceback
+        print(f"[bench] serving benchmark failed: {e}", file=sys.stderr)
+        traceback.print_exc()
+        serving = {"error": str(e)[:200]}
+
     metric_name = f"poisson{n_side}_fgmres_agg_amg_solve_s"
     # vs_baseline against the newest recorded round with the same metric
     # (BENCH_r*.json written by the driver): >1 = faster than baseline
@@ -723,6 +802,7 @@ def main():
             "matrix_fmt": Ad.fmt,
             "headline_pack": case.get("pack"),
             "telemetry": case.get("telemetry"),
+            "serving": serving,
             "device_dtype": str(dtype),
             **({"poisson256": big} if big else {}),
             **extra_cases,

@@ -87,6 +87,92 @@ def test_replace_coefficients_and_resetup():
     assert resid < 1e-7
 
 
+def test_replace_coefficients_reuse_hits_resetup_path():
+    """AMGX_matrix_replace_coefficients → AMGX_solver_resetup must take
+    the numeric-resetup REUSE path — compiled executables and bindings
+    survive — and the re-solve must match a from-scratch setup."""
+    cfg, rsrc, A, b, x = _setup_handles()
+    M = sp.csr_matrix(poisson5pt(9, 9))
+    n = M.shape[0]
+    amgx.AMGX_matrix_upload_all(A, n, M.nnz, 1, 1, M.indptr, M.indices,
+                                M.data)
+    rc, solver = amgx.AMGX_solver_create(rsrc, "dDDI", cfg)
+    amgx.AMGX_solver_setup(solver, A)
+    amgx.AMGX_vector_upload(b, n, 1, np.ones(n))
+    amgx.AMGX_vector_set_zero(x, n, 1)
+    amgx.AMGX_solver_solve(solver, b, x)       # builds the jitted solve
+    fn_before = solver.solver._solve_fn
+    fp_before = A.matrix.pattern_fingerprint()
+    assert fn_before is not None
+
+    new_data = M.data * 1.7
+    assert amgx.AMGX_matrix_replace_coefficients(
+        A, n, M.nnz, new_data) == RC.OK
+    # structure untouched ⇒ the serving-cache pattern key is stable too
+    assert A.matrix.pattern_fingerprint() == fp_before
+    assert amgx.AMGX_solver_resetup(solver, A) == RC.OK
+    # the resetup path kept the compiled executable (full setup rebuilds)
+    assert solver.solver._solve_fn is fn_before
+    amgx.AMGX_vector_set_zero(x, n, 1)
+    assert amgx.AMGX_solver_solve(solver, b, x) == RC.OK
+    rc, xs = amgx.AMGX_vector_download(x)
+
+    # oracle: a FRESH solver set up on the new coefficients from scratch
+    cfg2, rsrc2, A2, b2, x2 = _setup_handles()
+    M2 = sp.csr_matrix((new_data, M.indices.copy(), M.indptr.copy()),
+                       shape=M.shape)
+    amgx.AMGX_matrix_upload_all(A2, n, M2.nnz, 1, 1, M2.indptr,
+                                M2.indices, M2.data)
+    rc, solver2 = amgx.AMGX_solver_create(rsrc2, "dDDI", cfg2)
+    amgx.AMGX_solver_setup(solver2, A2)
+    amgx.AMGX_vector_upload(b2, n, 1, np.ones(n))
+    amgx.AMGX_vector_set_zero(x2, n, 1)
+    amgx.AMGX_solver_solve(solver2, b2, x2)
+    rc, xs2 = amgx.AMGX_vector_download(x2)
+    np.testing.assert_allclose(xs, xs2, rtol=1e-8, atol=1e-10)
+    resid = np.linalg.norm(np.ones(n) - M2 @ xs)
+    assert resid < 1e-7
+
+
+@pytest.mark.serve
+def test_serve_capi_flow():
+    """AMGX_serve_*: create → submit → wait → stats → drain → destroy,
+    including the backpressure RC on an over-capacity submit."""
+    rc, cfg = amgx.AMGX_config_create(
+        CONFIG + ", serve_workers=1, serve_queue_depth=2, "
+                 "serve_batch_window_ms=1")
+    assert rc == RC.OK
+    rc, rsrc = amgx.AMGX_resources_create_simple(cfg)
+    rc, A = amgx.AMGX_matrix_create(rsrc, "dDDI")
+    M = sp.csr_matrix(poisson5pt(8, 8))
+    n = M.shape[0]
+    amgx.AMGX_matrix_upload_all(A, n, M.nnz, 1, 1, M.indptr, M.indices,
+                                M.data)
+    rc, b = amgx.AMGX_vector_create(rsrc, "dDDI")
+    rc, x = amgx.AMGX_vector_create(rsrc, "dDDI")
+    amgx.AMGX_vector_upload(b, n, 1, np.ones(n))
+    amgx.AMGX_vector_set_zero(x, n, 1)
+    rc, srv = amgx.AMGX_serve_create(rsrc, "dDDI", cfg)
+    assert rc == RC.OK
+    rc, ticket = amgx.AMGX_serve_submit(srv, A, b)
+    assert rc == RC.OK and ticket is not None
+    rc, status, iters = amgx.AMGX_serve_wait(srv, ticket, x)
+    assert rc == RC.OK
+    assert status == SolveStatus.SUCCESS and iters > 0
+    resid = np.linalg.norm(np.ones(n) - M @ x.data)
+    assert resid < 1e-7
+    rc, stats = amgx.AMGX_serve_stats(srv)
+    assert rc == RC.OK and stats["completed"] == 1
+    assert stats["cache"]["misses"] == 1
+    assert amgx.AMGX_serve_drain(srv) == RC.OK
+    # drained service sheds new work with the documented RC
+    rc, t2 = amgx.AMGX_serve_submit(srv, A, b)
+    assert rc == RC.REJECTED and t2 is None
+    rc, msg = amgx.AMGX_get_error_string(int(RC.REJECTED))
+    assert "admission" in msg.lower() or "rejected" in msg.lower()
+    assert amgx.AMGX_serve_destroy(srv) == RC.OK
+
+
 def test_read_write_system(tmp_path, rng):
     path = str(tmp_path / "sys.mtx")
     M = sp.csr_matrix(poisson5pt(5, 5))

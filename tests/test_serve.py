@@ -1,0 +1,521 @@
+"""Serving-subsystem tests (amgx_tpu/serve/): multi-RHS solve parity,
+pattern-keyed setup caching under concurrency, micro-batching, and
+bounded-queue backpressure.
+
+The acceptance contract: N concurrent same-pattern solves trigger
+exactly ONE full setup (the rest reuse the session via the
+replace-coefficients/resetup path), batched results match sequential
+solves within tolerance, and an over-capacity request is rejected with
+the documented ``RC.REJECTED``.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.errors import RC, SolveStatus
+from amgx_tpu.io import poisson5pt, poisson7pt
+from amgx_tpu.serve import (PendingSolve, SetupCache, SolveService,
+                            session_key, split_batches)
+
+pytestmark = pytest.mark.serve
+
+
+AMG_PCG_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-10, "
+    "out:convergence=RELATIVE_INI, out:store_res_history=1, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+JACOBI_CFG = (
+    "config_version=2, solver(s)=BLOCK_JACOBI, s:max_iters={iters}, "
+    "s:monitor_residual=1, s:tolerance={tol}, "
+    "s:convergence=RELATIVE_INI, s:store_res_history=1")
+
+
+# ---------------------------------------------------------------------------
+# multi-RHS solve correctness (solvers/base.solve_multi)
+# ---------------------------------------------------------------------------
+def test_multi_rhs_matches_sequential_pcg_amg(rng):
+    A = poisson7pt(8, 8, 8)
+    slv = amgx.create_solver(amgx.AMGConfig(AMG_PCG_CFG))
+    slv.setup(amgx.Matrix(A))
+    B = rng.standard_normal((5, A.shape[0]))
+    batched = slv.solve_multi(B)
+    assert len(batched) == 5
+    for j, res in enumerate(batched):
+        seq = slv.solve(B[j])
+        assert res.status == SolveStatus.SUCCESS
+        assert res.iterations == seq.iterations
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(seq.x),
+                                   rtol=1e-10, atol=1e-12)
+        relres = np.linalg.norm(B[j] - A @ np.asarray(res.x)) / \
+            np.linalg.norm(B[j])
+        assert relres < 1e-9
+
+
+def test_multi_rhs_matches_sequential_jacobi(rng):
+    A = sp.csr_matrix(poisson5pt(9, 9))
+    cfg = amgx.AMGConfig(JACOBI_CFG.format(iters=80, tol="1e-6"))
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    B = rng.standard_normal((4, A.shape[0]))
+    batched = slv.solve_multi(B)
+    for j, res in enumerate(batched):
+        seq = slv.solve(B[j])
+        assert res.iterations == seq.iterations
+        assert res.status == seq.status
+        np.testing.assert_allclose(np.asarray(res.x), np.asarray(seq.x),
+                                   rtol=1e-12, atol=1e-13)
+
+
+def test_multi_rhs_mixed_convergence(rng):
+    """One RHS converges (exact initial guess), its batchmate hits the
+    iteration limit — each lane reports its own status and count."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    n = A.shape[0]
+    cfg = amgx.AMGConfig(JACOBI_CFG.format(iters=3, tol="1e-8"))
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    x_exact = rng.standard_normal(n)
+    b0 = np.asarray(A @ x_exact).ravel()
+    b1 = rng.standard_normal(n)
+    res = slv.solve_multi(np.stack([b0, b1]),
+                          X0=np.stack([x_exact, np.zeros(n)]))
+    assert res[0].status == SolveStatus.SUCCESS
+    assert res[0].iterations == 0          # converged at the initial guess
+    assert res[1].status == SolveStatus.NOT_CONVERGED
+    assert res[1].iterations == 3          # ran to the limit
+    # the converged lane's answer was not perturbed by its batchmate's
+    # extra iterations
+    np.testing.assert_allclose(np.asarray(res[0].x), x_exact,
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_multi_rhs_history_per_lane(rng):
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    cfg = amgx.AMGConfig(JACOBI_CFG.format(iters=10, tol="1e-12"))
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    B = rng.standard_normal((3, A.shape[0]))
+    for j, res in enumerate(slv.solve_multi(B)):
+        seq = slv.solve(B[j])
+        np.testing.assert_allclose(res.residual_history,
+                                   seq.residual_history,
+                                   rtol=1e-10)
+
+
+def test_multi_rhs_after_resetup_uses_new_coefficients(rng):
+    """A solver that only ever ran solve_multi (the serving shape) must
+    serve the NEW operator after resetup — the batched executable and
+    its bindings refresh in place (no full recompile, no stale pack)."""
+    A = sp.csr_matrix(poisson5pt(9, 9))
+    n = A.shape[0]
+    slv = amgx.create_solver(
+        amgx.AMGConfig(JACOBI_CFG.format(iters=60, tol="1e-8")))
+    slv.setup(amgx.Matrix(A))
+    B = rng.standard_normal((2, n))
+    slv.solve_multi(B)                      # builds the batched fn only
+    assert slv._solve_fn is None and slv._solve_multi is not None
+    fn_before = slv._solve_multi[1]
+    slv.resetup(amgx.Matrix(sp.csr_matrix(A * 2.0)))
+    assert slv._solve_multi is not None \
+        and slv._solve_multi[1] is fn_before   # executable survived
+    res = slv.solve_multi(B)
+    # oracle: a FRESH solver fully set up on the new coefficients — the
+    # refreshed executable must match it exactly, not the old operator
+    # (a stale pack would leave relres ≈ 1, not matching the oracle)
+    ref = amgx.create_solver(
+        amgx.AMGConfig(JACOBI_CFG.format(iters=60, tol="1e-8")))
+    ref.setup(amgx.Matrix(sp.csr_matrix(A * 2.0)))
+    for j, r in enumerate(res):
+        seq = ref.solve(B[j])
+        assert r.iterations == seq.iterations
+        np.testing.assert_allclose(np.asarray(r.x), np.asarray(seq.x),
+                                   rtol=1e-12, atol=1e-13)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints (core/matrix.py)
+# ---------------------------------------------------------------------------
+def test_pattern_fingerprint_contract():
+    A = sp.csr_matrix(poisson5pt(7, 7))
+    m1, m2 = amgx.Matrix(A), amgx.Matrix(A * 2.0)
+    m3 = amgx.Matrix(sp.csr_matrix(poisson5pt(7, 8)))
+    assert m1.pattern_fingerprint() == m2.pattern_fingerprint()
+    assert m1.pattern_fingerprint() != m3.pattern_fingerprint()
+    assert m1.values_fingerprint() != m2.values_fingerprint()
+    # replace_coefficients keeps the structure ⇒ keeps the fingerprint
+    fp = m1.pattern_fingerprint()
+    vfp = m1.values_fingerprint()
+    m1.replace_coefficients(np.asarray(m1.host.data) * 3.0)
+    assert m1.pattern_fingerprint() == fp
+    assert m1.values_fingerprint() != vfp
+    # set() with a new structure resets it
+    m1.set(sp.csr_matrix(poisson5pt(6, 6)))
+    assert m1.pattern_fingerprint() != fp
+    # same values ⇒ same values fingerprint across handles
+    assert amgx.Matrix(A).values_fingerprint() == \
+        amgx.Matrix(A.copy()).values_fingerprint()
+
+
+def test_session_key_config_order_invariant():
+    c1 = amgx.AMGConfig("config_version=2, solver(s)=PCG, s:max_iters=7, "
+                        "s:tolerance=1e-9")
+    c2 = amgx.AMGConfig("config_version=2, solver(s)=PCG, "
+                        "s:tolerance=1e-9, s:max_iters=7")
+    c3 = amgx.AMGConfig("config_version=2, solver(s)=PCG, s:max_iters=8")
+    m = amgx.Matrix(sp.csr_matrix(poisson5pt(5, 5)))
+    assert session_key(c1, m) == session_key(c2, m)
+    assert session_key(c1, m) != session_key(c3, m)
+
+
+# ---------------------------------------------------------------------------
+# micro-batch assembly (serve/batch.py)
+# ---------------------------------------------------------------------------
+def test_split_batches_groups_and_caps():
+    from amgx_tpu.serve.batch import SolveRequest
+    from amgx_tpu.serve.session import SessionKey
+
+    def req(pat, vals):
+        return SolveRequest(matrix=None, b=None, x0=None,
+                            key=SessionKey("cfg", pat), values_fp=vals,
+                            submitted_t=0.0, deadline_t=None)
+
+    rs = [req("p1", "v1"), req("p1", "v1"), req("p2", "v1"),
+          req("p1", "v2"), req("p1", "v1")]
+    batches = split_batches(rs, max_batch=2)
+    sizes = [len(b) for b in batches]
+    # p1/v1 → [2, 1] (capped), p2/v1 → [1], p1/v2 → [1]
+    assert sorted(sizes) == [1, 1, 1, 2]
+    for b in batches:
+        assert len({r.batch_key() for r in b}) == 1
+
+
+# ---------------------------------------------------------------------------
+# the service: concurrency / caching proof (acceptance criteria)
+# ---------------------------------------------------------------------------
+def _service_cfg(extra=""):
+    return amgx.AMGConfig(AMG_PCG_CFG + ", serve_batch_window_ms=10, "
+                          "serve_workers=2, serve_max_batch=8" + extra)
+
+
+def test_concurrent_same_pattern_single_full_setup(rng):
+    """The headline proof: N concurrent same-pattern solves → exactly
+    one full setup; results match sequential solves."""
+    A = poisson7pt(7, 7, 7)
+    n = A.shape[0]
+    m = amgx.Matrix(A)
+    N = 12
+    rhs = [rng.standard_normal(n) for _ in range(N)]
+    with SolveService(_service_cfg()) as svc:
+        pend = []
+        threads = []
+
+        def fire(b):
+            pend.append((b, svc.submit(m, b)))
+
+        for b in rhs:     # concurrent submitters, like N client threads
+            t = threading.Thread(target=fire, args=(b,))
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        results = [(b, p.wait(120)) for b, p in pend]
+        assert svc.drain(60)
+        st = svc.stats()
+    assert st["completed"] == N and st["rejected"] == 0
+    sess = st["cache"]["by_session"]
+    assert len(sess) == 1                      # one pattern ⇒ one session
+    assert sess[0]["full_setups"] == 1         # EXACTLY one full setup
+    assert sess[0]["resetups"] == 0            # same values: pure reuse
+    assert st["cache"]["misses"] == 1
+    # every answer matches a fresh sequential solve
+    ref = amgx.create_solver(amgx.AMGConfig(AMG_PCG_CFG))
+    ref.setup(amgx.Matrix(A))
+    for b, res in results:
+        assert res is not None and res.status == SolveStatus.SUCCESS
+        np.testing.assert_allclose(np.asarray(res.x),
+                                   np.asarray(ref.solve(b).x),
+                                   rtol=1e-8, atol=1e-10)
+
+
+def test_same_pattern_new_values_resetup_not_full_setup(rng):
+    """Same sparsity pattern with new coefficients rides Solver.resetup —
+    still only ONE full setup for the whole sequence."""
+    A = poisson7pt(6, 6, 6)
+    n = A.shape[0]
+    with SolveService(_service_cfg()) as svc:
+        solves = []
+        for scale in (1.0, 2.0, 0.5):
+            m = amgx.Matrix(sp.csr_matrix(A * scale))
+            b = rng.standard_normal(n)
+            res = svc.solve(m, b, timeout=120)
+            solves.append((scale, b, res))
+        st = svc.stats()
+    sess = st["cache"]["by_session"]
+    assert len(sess) == 1
+    assert sess[0]["full_setups"] == 1
+    assert sess[0]["resetups"] == 2            # two value refreshes
+    for scale, b, res in solves:
+        assert res.status == SolveStatus.SUCCESS
+        relres = np.linalg.norm(b - (A * scale) @ np.asarray(res.x)) / \
+            np.linalg.norm(b)
+        assert relres < 1e-8
+
+
+def test_distinct_patterns_get_distinct_sessions(rng):
+    A1 = poisson7pt(6, 6, 6)
+    A2 = sp.csr_matrix(poisson5pt(16, 16))
+    with SolveService(_service_cfg()) as svc:
+        r1 = svc.solve(amgx.Matrix(A1), np.ones(A1.shape[0]), timeout=120)
+        r2 = svc.solve(amgx.Matrix(A2), np.ones(A2.shape[0]), timeout=120)
+        st = svc.stats()
+    assert r1.status == SolveStatus.SUCCESS
+    assert r2.status == SolveStatus.SUCCESS
+    assert st["cache"]["sessions"] == 2
+    assert st["cache"]["misses"] == 2
+    assert sum(s["full_setups"] for s in st["cache"]["by_session"]) == 2
+
+
+def test_requests_are_micro_batched(rng):
+    """Same-operator requests queued together execute as stacked
+    multi-RHS batches, visible in the batch-size histogram."""
+    A = poisson7pt(6, 6, 6)
+    n = A.shape[0]
+    m = amgx.Matrix(A)
+    with telemetry.capture() as tel:
+        svc = SolveService(_service_cfg(), start=False)
+        # warm the session first so the batch isn't serialized behind
+        # the one-time setup
+        svc.start()
+        svc.solve(m, np.ones(n), timeout=120)
+        svc.drain(60)
+        # queue a burst while the dispatcher is busy waiting: they land
+        # in one window
+        svc._accepting = True
+        pend = [svc.submit(m, rng.standard_normal(n)) for _ in range(6)]
+        for p in pend:
+            assert p.wait(120) is not None, p.error
+        svc.shutdown()
+    sizes = [r["value"] for r in
+             tel.metric_records("amgx_serve_batch_size", kind="hist")]
+    assert sizes and max(sizes) >= 2           # at least one true batch
+    assert sum(sizes) == 7                     # every request was served
+
+
+def test_backpressure_rejects_with_documented_rc(rng):
+    """Over-capacity submissions reject immediately with RC.REJECTED."""
+    A = sp.csr_matrix(poisson5pt(10, 10))
+    m = amgx.Matrix(A)
+    cfg = _service_cfg(", serve_queue_depth=2")
+    svc = SolveService(cfg, start=False)     # no dispatcher: queue fills
+    try:
+        with telemetry.capture() as tel:
+            svc._accepting = True
+            p1 = svc.submit(m, np.ones(A.shape[0]))
+            p2 = svc.submit(m, np.ones(A.shape[0]))
+            p3 = svc.submit(m, np.ones(A.shape[0]))
+        assert p1.rc == RC.OK and p2.rc == RC.OK
+        assert p3.rc == RC.REJECTED
+        assert p3.done() and p3.result is None
+        assert int(RC.REJECTED) == 16          # the documented code
+        assert tel.counter_total("amgx_serve_rejected_total",
+                                 reason="queue_full") == 1
+        # the queued two still complete once the service starts
+        svc.start()
+        assert p1.wait(120) is not None
+        assert p2.wait(120) is not None
+    finally:
+        svc.shutdown()
+
+
+def test_backpressure_counts_inflight_work(rng):
+    """Admission capacity covers drained-but-unfinished work, not just
+    the queue — the dispatcher empties the queue every window, so
+    counting the queue alone would never shed sustained overload."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_service_cfg(", serve_queue_depth=2"))
+    try:
+        with svc._cond:
+            svc._inflight = 2          # two batches still executing
+        p = svc.submit(m, np.ones(A.shape[0]))
+        assert p.rc == RC.REJECTED
+        with svc._cond:
+            svc._inflight = 0
+        res = svc.solve(m, np.ones(A.shape[0]), timeout=120)
+        assert res.status == SolveStatus.SUCCESS
+    finally:
+        svc.shutdown()
+
+
+def test_deadline_expired_request_is_shed(rng):
+    A = sp.csr_matrix(poisson5pt(10, 10))
+    m = amgx.Matrix(A)
+    svc = SolveService(_service_cfg(), start=False)
+    try:
+        svc._accepting = True
+        p = svc.submit(m, np.ones(A.shape[0]), deadline_s=0.001)
+        time.sleep(0.05)                      # deadline passes in-queue
+        svc.start()
+        p.wait(60)
+        assert p.rc == RC.REJECTED
+        assert "deadline" in (p.error or "")
+    finally:
+        svc.shutdown()
+
+
+def test_matrix_mutated_after_submit_fails_loudly(rng):
+    """replace_coefficients on a handle with queued requests must not
+    silently solve those requests against the NEW values — they fail
+    with a clear error instead."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_service_cfg(), start=False)
+    try:
+        svc._accepting = True
+        p = svc.submit(m, np.ones(A.shape[0]))
+        m.replace_coefficients(np.asarray(m.host.data) * 2.0)
+        svc.start()
+        assert p.wait_done(60)
+        assert p.rc == RC.BAD_PARAMETERS
+        assert "changed after submit" in (p.error or "")
+    finally:
+        svc.shutdown()
+
+
+def test_submit_after_drain_rejected(rng):
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_service_cfg())
+    try:
+        assert svc.drain(60)
+        p = svc.submit(m, np.ones(A.shape[0]))
+        assert p.rc == RC.REJECTED
+    finally:
+        svc.shutdown()
+
+
+def test_cache_eviction_by_byte_budget(rng):
+    """A tiny byte budget keeps only the MRU session resident."""
+    A1 = poisson7pt(6, 6, 6)
+    A2 = sp.csr_matrix(poisson5pt(14, 14))
+    cfg = _service_cfg(", serve_cache_bytes=1")  # 1 byte: evict everything
+    with SolveService(cfg) as svc:
+        svc.solve(amgx.Matrix(A1), np.ones(A1.shape[0]), timeout=120)
+        svc.solve(amgx.Matrix(A2), np.ones(A2.shape[0]), timeout=120)
+        st = svc.stats()
+    assert st["cache"]["evictions"] >= 1
+    assert st["cache"]["sessions"] == 1        # only the MRU survived
+
+
+def test_service_error_reported_not_fatal(rng):
+    """A failing solve (setup raises) completes its request with an
+    error rc; the pool and the service survive for the next request."""
+    bad = amgx.Matrix(sp.csr_matrix((3, 4)))   # non-square: setup raises
+    good = sp.csr_matrix(poisson5pt(8, 8))
+    with SolveService(_service_cfg()) as svc:
+        p = svc.submit(bad, np.ones(3))
+        p.wait(60)
+        assert p.rc != RC.OK and p.result is None
+        res = svc.solve(amgx.Matrix(good), np.ones(good.shape[0]),
+                        timeout=120)
+        assert res.status == SolveStatus.SUCCESS
+        st = svc.stats()
+    assert st["worker_task_failures"] == 0     # failure was contained
+
+
+# ---------------------------------------------------------------------------
+# thread manager satellites (utils/thread_manager.py)
+# ---------------------------------------------------------------------------
+def test_thread_manager_survives_raising_task():
+    from amgx_tpu.utils.thread_manager import ThreadManager
+    done = []
+    tm = ThreadManager(max_workers=2)
+    tm.spawn_threads()
+    with telemetry.capture() as tel:
+        tm.push_work(lambda: (_ for _ in ()).throw(ValueError("boom")))
+        tm.push_work(lambda: done.append(1))
+        with pytest.raises(ValueError, match="boom"):
+            tm.wait_threads()
+        # the pool is still alive and keeps executing work
+        tm.push_work(lambda: done.append(2))
+        tm.join_threads()
+    assert done == [1, 2]
+    assert tm.failed_tasks == 1
+    assert tel.counter_total("amgx_worker_task_failures_total") == 1
+
+
+def test_thread_manager_push_before_spawn_autospawns():
+    from amgx_tpu.utils.thread_manager import ThreadManager
+    tm = ThreadManager(max_workers=1)
+    hits = []
+    tm.push_work(lambda: hits.append(threading.get_ident()))
+    tm.join_threads()
+    assert len(hits) == 1
+    # ran on a pool worker, not inline on the caller thread
+    assert hits[0] != threading.get_ident()
+
+
+def test_thread_manager_serialize_counts_failures():
+    from amgx_tpu.utils.thread_manager import ThreadManager
+    tm = ThreadManager(serialize=True)
+    with pytest.raises(RuntimeError):
+        tm.push_work(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    assert tm.failed_tasks == 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry integration
+# ---------------------------------------------------------------------------
+def test_serve_metric_names_registered():
+    from amgx_tpu.telemetry.metrics import METRICS
+    for name, kind in (
+            ("amgx_serve_requests_total", "counter"),
+            ("amgx_serve_rejected_total", "counter"),
+            ("amgx_serve_queue_depth", "gauge"),
+            ("amgx_serve_batch_size", "histogram"),
+            ("amgx_serve_request_seconds", "histogram"),
+            ("amgx_serve_cache_hits_total", "counter"),
+            ("amgx_serve_cache_misses_total", "counter"),
+            ("amgx_serve_cache_evictions_total", "counter"),
+            ("amgx_serve_cache_bytes", "gauge"),
+            ("amgx_serve_setup_total", "counter"),
+            ("amgx_worker_task_failures_total", "counter")):
+        assert name in METRICS and METRICS[name][0] == kind
+
+
+def test_doctor_serving_section(tmp_path, rng):
+    """A trace carrying serve metrics produces the doctor's serving
+    section (and valid JSONL throughout)."""
+    from amgx_tpu.telemetry.doctor import diagnose, render
+    A = sp.csr_matrix(poisson5pt(10, 10))
+    m = amgx.Matrix(A)
+    path = str(tmp_path / "serve_trace.jsonl")
+    with telemetry.capture() as tel:
+        with SolveService(_service_cfg(", serve_queue_depth=1")) as svc:
+            svc.solve(m, np.ones(A.shape[0]), timeout=120)
+            # force one rejection for the hints
+            svc._accepting = False
+            p = svc.submit(m, np.ones(A.shape[0]))
+            assert p.rc == RC.REJECTED
+            svc._accepting = True
+    with open(path, "w") as f:
+        telemetry.dump_jsonl(f, tel.records)
+    with open(path) as f:
+        assert telemetry.validate_jsonl(f) > 0
+    d = diagnose([path])
+    assert d["serving"] is not None
+    assert d["serving"]["cache"]["misses"] == 1
+    assert sum(d["serving"]["rejections"].values()) == 1
+    text = render(d)
+    assert "serving" in text
+    assert any("shed" in h for h in d["hints"])
