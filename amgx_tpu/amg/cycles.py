@@ -19,10 +19,26 @@ from ..ops.spmv import spmv
 
 
 def build_cycle(hierarchy, cycle_type: str = None):
-    """Return cycle_fn(b, x) -> x for the hierarchy (traced)."""
+    """Return cycle_fn(b, x) -> x for the hierarchy (traced).
+
+    Convergence forensics (``forensics`` config knob, telemetry/
+    forensics.py): when the hierarchy carries ``forensics=1`` the traced
+    cycle additionally computes the residual norm at the four cut
+    points of every level of every cycle — entry, after pre-smooth,
+    after the coarse-grid correction, after post-smooth — and hands
+    them to the host recorder through ``jax.debug.callback`` as one
+    ``cycle_level`` event per level per cycle (``cycle_coarse`` for the
+    coarsest solve).  Off (the default) the built cycle is
+    BIT-IDENTICAL to the uninstrumented one: no extra SpMVs, no
+    callbacks, no jaxpr change — so jit caches are untouched."""
     ct = cycle_type or hierarchy.cycle_type
     levels = hierarchy.levels
     h = hierarchy
+    fore = bool(getattr(h, "forensics", 0))
+    if fore:
+        from functools import partial
+
+        from ..telemetry import forensics as _forensics
 
     # hybrid host/device hierarchy (amg_host_levels_rows, amg.h:169-173):
     # the first level at or below the row threshold — and everything
@@ -53,11 +69,35 @@ def build_cycle(hierarchy, cycle_type: str = None):
             return x
         return lvl.smoother.apply(b, x0=x, n_iters=sweeps)
 
+    def _fore_at(i):
+        # the debug callback cannot live inside a host-compute region —
+        # levels offloaded by amg_host_levels_rows stay uninstrumented
+        return fore and i < host_from
+
+    def _rnorm(v):
+        # scalar L2, complex-safe — the forensics norm is deliberately
+        # norm-type-independent (reduction FACTORS are what matter)
+        return jnp.sqrt(jnp.real(jnp.vdot(v, v)))
+
     def coarse_solve(b, x):
         cs = h.coarse_solver
         if h.coarse_solver_is_smoother:
             return cs.apply(b, x0=x, n_iters=h.coarsest_sweeps)
         return cs.apply(b, x0=x)
+
+    def coarse_solve_inst(b, x):
+        """Coarsest-grid solve with entry/exit residual norms recorded
+        (two cut points — there are no smoothing components here)."""
+        Adc = getattr(h.coarse_solver, "Ad", None)
+        if not _fore_at(len(levels)) or Adc is None:
+            return coarse_solve(b, x)
+        n_in = _rnorm(b - spmv(Adc, x))
+        x = coarse_solve(b, x)
+        jax.debug.callback(partial(_forensics.emit_cycle_coarse,
+                                   len(levels)),
+                           n_in, _rnorm(b - spmv(Adc, x)),
+                           ordered=False)
+        return x
 
     def presweeps_at(i):
         if i == 0 and h.finest_sweeps >= 0:
@@ -85,11 +125,16 @@ def build_cycle(hierarchy, cycle_type: str = None):
         executable; named scopes can)."""
         if i == len(levels):
             with jax.named_scope("amg_coarse_solve"):
-                return coarse_solve(b, x)
+                return coarse_solve_inst(b, x)
         lvl = levels[i]
+        inst = _fore_at(i)
+        if inst:
+            n_entry = _rnorm(b - spmv(lvl.Ad, x))
         with jax.named_scope(f"amg_level_{i}"):
             x = smooth(lvl, b, x, presweeps_at(i))
             r = b - spmv(lvl.Ad, x)
+            if inst:
+                n_pre = _rnorm(r)
             bc = lvl.restrict_residual(r)
         xc = jnp.zeros_like(bc)
         if flavor == "V":
@@ -137,7 +182,14 @@ def build_cycle(hierarchy, cycle_type: str = None):
                 x = x + lam.astype(x.dtype) * e
             else:
                 x = lvl.prolongate_and_correct(x, xc)
+            if inst:
+                n_coarse = _rnorm(b - spmv(lvl.Ad, x))
             x = smooth(lvl, b, x, postsweeps_at(i))
+            if inst:
+                jax.debug.callback(
+                    partial(_forensics.emit_cycle_level, i, flavor),
+                    n_entry, n_pre, n_coarse,
+                    _rnorm(b - spmv(lvl.Ad, x)), ordered=False)
         return x
 
     def _kcycle(i, b, x, flavor):
@@ -150,7 +202,7 @@ def build_cycle(hierarchy, cycle_type: str = None):
     def _kcycle_body(i, b, x, flavor):
         if i == len(levels):
             with jax.named_scope("amg_coarse_solve"):
-                return coarse_solve(b, x)
+                return coarse_solve_inst(b, x)
         inner_flavor = "V" if flavor == "CGF" else flavor
         Ad = levels[i].Ad
 

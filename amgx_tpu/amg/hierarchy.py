@@ -196,6 +196,9 @@ class AMGHierarchy:
         #: 2-norm, 3 = minimise error A-norm, 0 = off
         self.error_scaling = int(g("error_scaling"))
         self.scaling_smoother_steps = int(g("scaling_smoother_steps"))
+        #: convergence forensics (telemetry/forensics.py): cycle-anatomy
+        #: instrumentation in build_cycle + setup-time quality probes
+        self.forensics = int(g("forensics"))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.coarse_solver_is_smoother = False
@@ -229,6 +232,17 @@ class AMGHierarchy:
         self.setup_time = time.perf_counter() - t0
         if telemetry.is_enabled():
             self._emit_telemetry()
+            if self.forensics:
+                # hierarchy quality probes (telemetry/forensics.py):
+                # near-nullspace preservation, sampled Galerkin
+                # consistency, CF/coarsening ratios, strength sample —
+                # best-effort, a probe gap must never break setup
+                from ..telemetry import forensics
+                try:
+                    with cpu_profiler("forensics_probes"):
+                        forensics.probe_hierarchy(self)
+                except Exception:
+                    pass
         if self.print_grid_stats:
             # informational table: verbosity level 2 (the reference
             # prints it through the same gated output stream)

@@ -75,6 +75,14 @@ class AMGLevel:
         return (getattr(self.A, "logical_rows", None) or self.Ad.n_rows,
                 self.A.nnz)
 
+    def probe_handles(self) -> dict:
+        """Host-side handles for the forensics hierarchy-quality probes
+        (``telemetry/forensics.py``): the operator handle plus whatever
+        this level kind can offer — explicit P/R for classical levels,
+        the C/F split when recorded.  Every entry is optional; probes
+        skip what a level cannot provide."""
+        return {"A": self.A}
+
 
 class AggregationLevel(AMGLevel):
     """Implicit piecewise-constant transfer over ``aggregates``."""
@@ -246,6 +254,13 @@ class ClassicalLevel(AMGLevel):
     def transfer_matrices(self):
         """The host Matrix handles of P/R (for the batched upload)."""
         return [m for m in (self._Pm, self._Rm) if m is not None]
+
+    def probe_handles(self) -> dict:
+        """Explicit transfers enable the sampled Galerkin consistency
+        spot-check; device-pipeline levels (host P/R absent) degrade to
+        the operator-only probes."""
+        return {"A": self.A, "P": self._Pm, "R": self._Rm,
+                "cf_map": getattr(self.A, "cf_map", None)}
 
     @property
     def P(self) -> DeviceMatrix:

@@ -291,6 +291,15 @@ def register_default_parameters():
       "JSONL trace file; appended incrementally after setup/solve")
     R("telemetry_ring_size", int, 65536,
       "max telemetry records held in the in-memory ring buffer")
+    # convergence forensics (telemetry/forensics.py): per-level cycle
+    # anatomy (residual norms at the four cut points of every cycle),
+    # hierarchy quality probes at setup, and the asymptotic
+    # convergence-factor gauge.  Off by default: the traced cycle is
+    # bit-identical to the uninstrumented one when 0 (no extra jit
+    # traces); 1 adds three residual-norm SpMVs per level per cycle
+    R("forensics", int, 0,
+      "enable convergence forensics (cycle anatomy + hierarchy probes)",
+      _BOOL)
     # serving subsystem (amgx_tpu/serve/): request-level concurrency —
     # sessions with a pattern-keyed setup cache, micro-batched multi-RHS
     # solves, bounded-queue admission control

@@ -32,6 +32,39 @@ class AMGSolver(Solver):
     def solve_iteration(self, b, x, state, iter_idx):
         return self._cycle(b, x), state
 
+    def set_forensics(self, on: bool = True):
+        """Flip cycle-anatomy instrumentation (telemetry/forensics.py)
+        on the EXISTING hierarchy without a re-setup: rebuilds the
+        traced cycle and drops this solver's compiled executables so
+        the next solve traces the (un)instrumented graph.  A caller
+        whose OUTER solver inlined this cycle as a preconditioner must
+        invalidate that executable itself (and owns its own history
+        flag — the asymptotic-rate estimate reads the OUTER solve's
+        residual history)."""
+        self.forensics = bool(on)
+        if on:
+            # same coupling as the config knob in Solver.__init__: the
+            # asymptotic-rate gauge needs the residual history kept
+            # (disabling leaves it on — harmless, maybe user-configured)
+            self.store_res_history = True
+        self.hierarchy.forensics = 1 if on else 0
+        if on:
+            # the setup-time quality probes were skipped when the knob
+            # was off — run them now so the doctor's probe section (and
+            # the hints pointing at it) exist for this enable path too;
+            # they emit only if telemetry is currently recording
+            from .. import telemetry
+            if telemetry.is_enabled():
+                try:
+                    telemetry.forensics.probe_hierarchy(self.hierarchy)
+                except Exception:
+                    pass
+        self._cycle = build_cycle(self.hierarchy)
+        self._solve_fn = None
+        self._refined_fn = None
+        self._solve_multi = None
+        self._bindings = None
+
     def grid_stats(self):
         return self.hierarchy.grid_stats()
 

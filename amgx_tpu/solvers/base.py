@@ -174,6 +174,14 @@ class Solver:
         if int(g("telemetry")):
             telemetry.enable(int(g("telemetry_ring_size")))
             self.store_res_history = True
+        # convergence forensics (telemetry/forensics.py): cycle-anatomy
+        # instrumentation rides the hierarchy (amg/cycles.py reads the
+        # same knob); at this layer the knob keeps the residual history
+        # so the asymptotic convergence-factor estimate can be computed
+        # per solve
+        self.forensics = bool(int(g("forensics")))
+        if self.forensics:
+            self.store_res_history = True
         # an EXPLICIT verbosity_level drives the level-gated output
         # stream; the registry default must not clobber a verbosity the
         # host application set programmatically
@@ -803,6 +811,13 @@ class Solver:
                                   or self.print_solve_stats else None),
                 setup_time=self.setup_time, solve_time=solve_time))
         if telemetry.is_enabled():
+            if self.forensics:
+                # drain in-flight forensics callbacks (see
+                # _emit_solve_telemetry) before the flush below
+                try:
+                    jax.effects_barrier()
+                except Exception:
+                    pass
             telemetry.hist_observe("amgx_solve_seconds", solve_time)
             telemetry.gauge_set("amgx_last_solve_seconds", solve_time)
             if self.telemetry_path:
@@ -816,6 +831,16 @@ class Solver:
         and the per-iteration residual trajectory (iteration 0 = the
         initial residual, matching ``AMGX_solver_get_iteration_residual``
         indexing)."""
+        if self.forensics:
+            # cycle-anatomy events arrive through unordered
+            # jax.debug.callback: on an async backend they may still be
+            # in flight when the solve returns — drain them before the
+            # trace is scanned/flushed (else the doctor undercounts
+            # cycles and a capture scope closing would drop them)
+            try:
+                jax.effects_barrier()
+            except Exception:
+                pass
         telemetry.hist_observe("amgx_solve_seconds", solve_time)
         telemetry.gauge_set("amgx_last_solve_seconds", solve_time)
         telemetry.gauge_set("amgx_solve_iterations", iters)
@@ -833,6 +858,22 @@ class Solver:
             if iters > 0 and np.isfinite(relres) and relres > 0:
                 telemetry.gauge_set("amgx_solve_convergence_rate",
                                     relres ** (1.0 / iters))
+            if self.forensics and history is not None:
+                # asymptotic convergence factor: trailing-half estimate
+                # (telemetry/forensics.py) — the number that predicts
+                # iteration growth, vs the whole-solve geometric mean
+                # above which the fast early iterations flatter
+                from ..telemetry import forensics
+                rate = forensics.asymptotic_rate(
+                    [float(np.max(row))
+                     for row in np.atleast_2d(history)])
+                if rate is not None:
+                    telemetry.gauge_set(
+                        "amgx_forensics_asymptotic_rate", rate)
+                    telemetry.event("solve_forensics",
+                                    solver=self.config_name,
+                                    iterations=iters,
+                                    asymptotic_rate=rate)
             if diverged:
                 telemetry.counter_inc("amgx_solve_diverged_total")
                 telemetry.event("divergence", solver=self.config_name,

@@ -20,7 +20,12 @@ the grid-stats table:
 * **analysis** (PR 3): :mod:`.costmodel` (static bytes/FLOPs/padding
   descriptors per SpMV pack, roofline fractions), :mod:`.tracefile`
   (Chrome-trace export — view a solve in Perfetto), :mod:`.doctor`
-  (``python -m amgx_tpu.telemetry.doctor trace.jsonl`` diagnosis).
+  (``python -m amgx_tpu.telemetry.doctor trace.jsonl`` diagnosis,
+  ``--diff`` for two-trace A/B comparison);
+* **convergence forensics** (:mod:`.forensics`): per-level cycle
+  anatomy (residual norms at the four cut points of every cycle),
+  hierarchy quality probes at setup, asymptotic convergence-factor
+  estimates — gated by the ``forensics`` config knob.
 
 Everything is **off by default** and compiled down to one attribute
 check per instrument; enable globally with :func:`enable`, per config
@@ -29,7 +34,7 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import costmodel, export, metrics, recorder, tracefile
+from . import costmodel, export, forensics, metrics, recorder, tracefile
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -50,7 +55,7 @@ __all__ = [
     "validate_record", "validate_jsonl",
     "read_sessions", "aggregate_sessions",
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
-    "costmodel",
+    "costmodel", "forensics",
     "reset",
 ]
 

@@ -12,11 +12,21 @@ and prints what a performance engineer would ask the trace first:
   fractions, ring hops,
 * the convergence trajectory: iterations, final residual, and
   plateau/stall detection over the per-iteration residual events,
+* convergence forensics, when the trace carries ``forensics=1`` events
+  (:mod:`amgx_tpu.telemetry.forensics`): the per-level per-component
+  reduction-factor table (pre-smooth / coarse correction /
+  post-smooth), hierarchy quality probes, and the weakest
+  level/component named explicitly,
 * concrete hints ("level 3 fell back to segment-sum: over padding
-  budget by 2.1×", "trace truncated: raise telemetry_ring_size", ...).
+  budget by 2.1×", "level 2 post-smooth reduction 0.97 → raise
+  postsweeps or switch smoother", ...).
 
-``--json`` prints the machine-readable diagnosis instead.  Everything
-is host-side file parsing — no device work, no compiles.
+``--diff other.jsonl`` compares two traces level by level — the
+pipeline-on/off or 64³-vs-128³ A/B view: iteration counts, asymptotic
+rates, per-level component factors side by side with the drifts
+called out.  ``--json`` prints the machine-readable diagnosis
+instead.  Everything is host-side file parsing — no device work, no
+compiles.
 """
 from __future__ import annotations
 
@@ -25,6 +35,7 @@ import sys
 from typing import List, Optional, Tuple
 
 from .export import aggregate_sessions
+from .forensics import COMPONENTS
 
 #: trailing per-iteration reduction factor above which the residual is
 #: considered plateaued (a healthy AMG-preconditioned solve reduces
@@ -38,6 +49,24 @@ WASTE_HINT = 2.0
 WASTE_MIN_ROWS = 4096
 #: halo-vs-local byte ratio past which the solve reads comms-bound
 HALO_HINT = 0.5
+#: per-component geometric-mean reduction factor past which a cycle
+#: component earns a "weakest link" hint (a healthy V-cycle smoothing
+#: component reduces the residual well below this; 0.85+ means the
+#: component barely helps and ~1.0 means it does nothing)
+WEAK_COMPONENT = 0.85
+#: coarse-correction factor past which the correction is flagged as
+#: amplifying.  NOT 1.0: a healthy coarse correction routinely grows
+#: the RESIDUAL norm transiently (the prolongated correction injects
+#: high-frequency residual the post-smoother then removes) — only
+#: sustained growth past this is pathological
+AMPLIFY_HINT = 1.5
+#: sampled Galerkin relative error past which the stored coarse
+#: operator no longer matches R·A·P (value drift, stale resetup)
+GALERKIN_ERR_HINT = 1e-6
+#: near-nullspace metric past which a level lost the constant vector
+NULLSPACE_HINT = 0.9
+#: absolute component-factor drift that earns a diff-mode callout
+DIFF_DRIFT = 0.1
 
 
 def _label_get(labels: Tuple, key: str):
@@ -260,6 +289,14 @@ def diagnose(paths: List[str]) -> dict:
     trails = _residual_trails(agg)
     plateau = _plateau(trails[-1]) if trails else None
     divergences = agg["events"].get("divergence", 0)
+    g = glast("amgx_forensics_asymptotic_rate")
+    if g:
+        conv["asymptotic_rate"] = list(g.values())[-1]
+
+    # ---- convergence forensics (telemetry/forensics.py) -------------
+    from . import forensics as _forensics
+    fr = _forensics.analyze(r for s in agg["sessions"]
+                            for r in s["records"])
 
     # ---- hints ------------------------------------------------------
     hints: List[str] = []
@@ -311,6 +348,7 @@ def diagnose(paths: List[str]) -> dict:
     if divergences:
         hints.append(f"{int(divergences)} divergence event(s): a "
                      "residual went non-finite")
+    hints.extend(_forensics_hints(fr))
     jit, _ = csum("amgx_jit_compile_total")
     if jit:
         hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
@@ -358,8 +396,96 @@ def diagnose(paths: List[str]) -> dict:
         "serving": serving,
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
+        "forensics": fr,
         "hints": hints,
     }
+
+
+#: component → actionable knob, the concrete advice a weak component
+#: earns ("which config line do I change")
+_COMPONENT_ADVICE = {
+    "pre_smooth": "raise presweeps or switch smoother",
+    "post_smooth": "raise postsweeps or switch smoother",
+    "coarse_corr": "inspect interpolation/strength (check the "
+                   "amgx_forensics_galerkin_err and nullspace probes)",
+    "coarse_solve": "raise coarsest_sweeps or use a direct coarse "
+                    "solver (DENSE_LU_SOLVER)",
+}
+
+_COMPONENT_LABEL = {
+    "pre_smooth": "pre-smooth", "post_smooth": "post-smooth",
+    "coarse_corr": "coarse correction", "coarse_solve": "coarse solve",
+}
+
+#: the cycle components, in cut-point order — one authority
+#: (forensics.COMPONENTS) so a new component shows up everywhere
+COMP_ORDER = COMPONENTS
+
+
+def _forensics_hints(fr: Optional[dict]) -> List[str]:
+    """Actionable convergence hints from the forensics analysis: name
+    dead smoothing components, stagnating levels, amplifying coarse
+    corrections, weak coarse solves and failed quality probes.  Tuned
+    to stay silent on a healthy trace: smoothing factors ~0.6 and a
+    mildly-over-1 coarse-correction residual factor are normal."""
+    if not fr:
+        return []
+    hints: List[str] = []
+    levels = fr.get("levels", {})
+    for lvl, d in sorted(levels.items()):
+        for comp in ("pre_smooth", "post_smooth"):
+            f = d.get(comp)
+            if f is not None and f >= WEAK_COMPONENT:
+                knob = "presweeps" if comp == "pre_smooth" \
+                    else "postsweeps"
+                verb = "does nothing" if f >= 0.98 else "barely reduces"
+                hints.append(
+                    f"level {lvl} {_COMPONENT_LABEL[comp]} {verb} "
+                    f"(reduction {f:.2f}) → raise {knob} or switch "
+                    "smoother")
+        f = d.get("coarse_corr")
+        if f is not None and f >= AMPLIFY_HINT:
+            hints.append(
+                f"coarse correction amplifying at level {lvl} "
+                f"(factor {f:.2f}) → inspect interpolation")
+        t = d.get("total")
+        if t is not None and t >= WEAK_COMPONENT:
+            # dominant component by baseline-NORMALISED severity
+            # (forensics.component_score): a raw max would let a
+            # healthy transiently-amplifying coarse correction
+            # out-rank a dead smoother and misdirect the advice
+            from .forensics import component_score
+            worst = max(
+                ((component_score(c, d[c]), d[c], c)
+                 for c in COMP_ORDER if d.get(c) is not None),
+                default=(None, None, None))
+            if worst[0] is not None:
+                hints.append(
+                    f"level {lvl} cycle barely reduces the residual "
+                    f"(total {t:.2f}); dominant component: "
+                    f"{_COMPONENT_LABEL[worst[2]]} ({worst[1]:.2f}) → "
+                    f"{_COMPONENT_ADVICE[worst[2]]}")
+    c = fr.get("coarse")
+    if c and c.get("factor") is not None and \
+            c["factor"] >= WEAK_COMPONENT:
+        hints.append(
+            f"coarsest-grid solve at level {c['level']} barely reduces "
+            f"(factor {c['factor']:.2f}) → "
+            f"{_COMPONENT_ADVICE['coarse_solve']}")
+    for lvl, p in sorted(fr.get("probes", {}).items()):
+        ge = p.get("galerkin_err")
+        if isinstance(ge, (int, float)) and ge > GALERKIN_ERR_HINT:
+            hints.append(
+                f"level {lvl}: stored coarse operator drifts from "
+                f"R·A·P by {ge:.1e} (sampled) — a stale value refresh "
+                "or a broken transfer")
+        ns = p.get("nullspace")
+        if isinstance(ns, (int, float)) and ns > NULLSPACE_HINT:
+            hints.append(
+                f"level {lvl}: operator no longer annihilates the "
+                f"constant vector (|A·1|/|A| = {ns:.2f}) — the "
+                "near-nullspace was lost in coarsening")
+    return hints
 
 
 def render(d: dict) -> str:
@@ -479,8 +605,14 @@ def render(d: dict) -> str:
             L.append(f"  final relres: {conv['final_relres']:.3e}")
         if "rate" in conv and isinstance(conv.get("rate"), (int, float)):
             L.append(f"  reduction/iter: {conv['rate']:.3f}")
+        if isinstance(conv.get("asymptotic_rate"), (int, float)):
+            L.append(f"  asymptotic rate: {conv['asymptotic_rate']:.3f}")
         if conv.get("divergences"):
             L.append(f"  DIVERGENCES:  {conv['divergences']}")
+
+    fr = d.get("forensics")
+    if fr:
+        L.extend(_render_forensics(fr))
 
     L.append("")
     if d["hints"]:
@@ -493,25 +625,222 @@ def render(d: dict) -> str:
     return "\n".join(L) + "\n"
 
 
+def _fmt_factor(f) -> str:
+    return f"{f:7.3f}" if isinstance(f, (int, float)) else f"{'-':>7}"
+
+
+def _render_forensics(fr: dict) -> List[str]:
+    """The convergence-forensics report block: per-level per-component
+    reduction factors, the coarse-solve factor, the weakest link, and
+    the hierarchy quality probes."""
+    L: List[str] = []
+    if fr.get("levels"):
+        L.append("")
+        L.append("convergence forensics (per-level cycle anatomy)")
+        L.append("-" * 40)
+        L.append(f"  {'lvl':<4}{'cycles':>7}{'pre':>8}{'coarse':>8}"
+                 f"{'post':>8}{'total':>8}")
+        for lvl, x in sorted(fr["levels"].items(),
+                             key=lambda kv: int(kv[0])):
+            L.append(f"  {lvl:<4}{int(x.get('cycles', 0)):>7}"
+                     + _fmt_factor(x.get("pre_smooth")).rjust(8)
+                     + _fmt_factor(x.get("coarse_corr")).rjust(8)
+                     + _fmt_factor(x.get("post_smooth")).rjust(8)
+                     + _fmt_factor(x.get("total")).rjust(8))
+        c = fr.get("coarse")
+        if c and isinstance(c.get("factor"), (int, float)):
+            L.append(f"  coarse solve @{c['level']}: factor "
+                     f"{c['factor']:.3f} ({c['cycles']}×)")
+        w = fr.get("weakest")
+        if w:
+            L.append(f"  weakest component: level {w['level']} "
+                     f"{_COMPONENT_LABEL[w['component']]} "
+                     f"(factor {w['factor']:.3f})")
+    if fr.get("probes"):
+        L.append("")
+        L.append("hierarchy quality probes")
+        L.append("-" * 40)
+        L.append(f"  {'lvl':<4}{'rows':>10}{'cf':>7}{'nullsp':>8}"
+                 f"{'galerkin':>10}{'strong':>8}")
+        for lvl, p in sorted(fr["probes"].items(),
+                             key=lambda kv: int(kv[0])):
+            ge = p.get("galerkin_err")
+            L.append(f"  {lvl:<4}{int(p.get('rows', 0)):>10}"
+                     + _fmt_factor(p.get("cf_ratio")).rjust(7)
+                     + _fmt_factor(p.get("nullspace")).rjust(8)
+                     + (f"{ge:>10.1e}" if isinstance(ge, (int, float))
+                        else f"{'-':>10}")
+                     + _fmt_factor(p.get("strong_frac")).rjust(8))
+    return L
+
+
+# ---------------------------------------------------------------- diff
+def diff(da: dict, db: dict) -> dict:
+    """Two-trace A/B comparison (pipeline-on/off, 64³-vs-128³): the
+    level-by-level convergence picture of ``da`` vs ``db`` with drifts
+    past :data:`DIFF_DRIFT` called out."""
+    conv_a, conv_b = da["convergence"], db["convergence"]
+
+    def pair(key):
+        return {"a": conv_a.get(key), "b": conv_b.get(key)}
+
+    fra = da.get("forensics") or {}
+    frb = db.get("forensics") or {}
+    la, lb = fra.get("levels", {}), frb.get("levels", {})
+    levels = {}
+    for lvl in sorted(set(la) | set(lb), key=int):
+        row = {}
+        for comp in COMPONENTS + ("total",):
+            row[comp] = {
+                "a": (la.get(lvl) or {}).get(comp),
+                "b": (lb.get(lvl) or {}).get(comp)}
+        levels[lvl] = row
+    rows = {}
+    for lvl in sorted(set(da["levels"]) | set(db["levels"]),
+                      key=lambda v: int(v) if str(v).isdigit() else 99):
+        rows[lvl] = {"a": (da["levels"].get(lvl) or {}).get("rows"),
+                     "b": (db["levels"].get(lvl) or {}).get("rows")}
+    phases = {}
+    for k in sorted(set(da["phases"]) | set(db["phases"])):
+        phases[k] = {
+            "a": (da["phases"].get(k) or {}).get("total_s"),
+            "b": (db["phases"].get(k) or {}).get("total_s")}
+    drifts: List[str] = []
+    for lvl, row in levels.items():
+        for comp, v in row.items():
+            if comp == "total":
+                continue
+            a, b = v["a"], v["b"]
+            if isinstance(a, (int, float)) and \
+                    isinstance(b, (int, float)) and \
+                    abs(b - a) >= DIFF_DRIFT:
+                word = "worsened" if b > a else "improved"
+                drifts.append(
+                    f"level {lvl} {_COMPONENT_LABEL[comp]} {word} "
+                    f"{a:.2f} → {b:.2f}")
+    wa, wb = fra.get("weakest"), frb.get("weakest")
+    if wa and wb and (wa["level"], wa["component"]) != \
+            (wb["level"], wb["component"]):
+        drifts.append(
+            f"weakest component moved: level {wa['level']} "
+            f"{_COMPONENT_LABEL[wa['component']]} → level "
+            f"{wb['level']} {_COMPONENT_LABEL[wb['component']]}")
+    return {"a": da["files"], "b": db["files"],
+            "convergence": {k: pair(k) for k in
+                            ("iterations", "final_relres", "rate",
+                             "asymptotic_rate")},
+            "rows": rows, "phases": phases, "levels": levels,
+            "drifts": drifts}
+
+
+def _fmt_num(v, spec=".3f") -> str:
+    if isinstance(v, (int, float)):
+        return format(v, spec)
+    return "-"
+
+
+def render_diff(dd: dict) -> str:
+    """Human-readable report of a :func:`diff` result."""
+    L: List[str] = []
+    L.append("amgx convergence diff")
+    L.append("=" * 60)
+    L.append(f"A: {', '.join(dd['a'])}")
+    L.append(f"B: {', '.join(dd['b'])}")
+    L.append("")
+    L.append("convergence (A vs B)")
+    L.append("-" * 40)
+    c = dd["convergence"]
+    it = c["iterations"]
+    if it["a"] is not None or it["b"] is not None:
+        L.append(f"  iterations:      "
+                 f"{_fmt_num(it['a'], '.0f')} vs "
+                 f"{_fmt_num(it['b'], '.0f')}")
+    rr = c["final_relres"]
+    if rr["a"] is not None or rr["b"] is not None:
+        L.append(f"  final relres:    "
+                 f"{_fmt_num(rr['a'], '.3e')} vs "
+                 f"{_fmt_num(rr['b'], '.3e')}")
+    for key, label in (("rate", "reduction/iter: "),
+                       ("asymptotic_rate", "asymptotic rate:")):
+        v = c[key]
+        if v["a"] is not None or v["b"] is not None:
+            L.append(f"  {label} {_fmt_num(v['a'])} vs "
+                     f"{_fmt_num(v['b'])}")
+    if dd["rows"]:
+        L.append("")
+        L.append("hierarchy (rows, A vs B)")
+        L.append("-" * 40)
+        for lvl, v in dd["rows"].items():
+            L.append(f"  level {lvl:<4} {_fmt_num(v['a'], '.0f'):>10}"
+                     f" vs {_fmt_num(v['b'], '.0f'):>10}")
+    if dd["levels"]:
+        L.append("")
+        L.append("cycle anatomy (A | B per component)")
+        L.append("-" * 40)
+        L.append(f"  {'lvl':<4}{'pre A|B':>16}{'coarse A|B':>18}"
+                 f"{'post A|B':>16}")
+        for lvl, row in dd["levels"].items():
+            def ab(comp, row=row):
+                v = row[comp]
+                return (f"{_fmt_num(v['a'])}|{_fmt_num(v['b'])}")
+            L.append(f"  {lvl:<4}{ab('pre_smooth'):>16}"
+                     f"{ab('coarse_corr'):>18}{ab('post_smooth'):>16}")
+    if dd["phases"]:
+        L.append("")
+        L.append("phase totals (A vs B, seconds)")
+        L.append("-" * 40)
+        for k, v in dd["phases"].items():
+            L.append(f"  {k:<10} {_fmt_num(v['a'], '.4f'):>10} vs "
+                     f"{_fmt_num(v['b'], '.4f'):>10}")
+    L.append("")
+    if dd["drifts"]:
+        L.append("drifts")
+        L.append("-" * 40)
+        for h in dd["drifts"]:
+            L.append(f"  * {h}")
+    else:
+        L.append("drifts: none past the threshold")
+    return "\n".join(L) + "\n"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     as_json = "--json" in argv
-    paths = [a for a in argv if a != "--json"]
+    argv = [a for a in argv if a != "--json"]
+    diff_paths: List[str] = []
+    if "--diff" in argv:
+        i = argv.index("--diff")
+        diff_paths = argv[i + 1:]
+        argv = argv[:i]
+        if not diff_paths:
+            print("doctor: --diff requires a second trace",
+                  file=sys.stderr)
+            return 2
+    paths = argv
     if not paths:
         print("usage: python -m amgx_tpu.telemetry.doctor "
-              "<trace.jsonl> [more.jsonl ...] [--json]",
+              "<trace.jsonl> [more.jsonl ...] "
+              "[--diff other.jsonl ...] [--json]",
               file=sys.stderr)
         return 2
+    # a diverged solve restores "Infinity" gauge tokens to real floats
+    # for the math above — re-sanitize so --json output stays strict
+    # JSON (jq-parseable), like every other exporter here
+    from .export import _sanitize
     try:
         d = diagnose(paths)
+        dd = diff(d, diagnose(diff_paths)) if diff_paths else None
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"doctor: cannot read trace: {e}", file=sys.stderr)
         return 1
+    if dd is not None:
+        if as_json:
+            print(json.dumps(_sanitize(dd), indent=2, default=str,
+                             allow_nan=False))
+        else:
+            print(render_diff(dd), end="")
+        return 0
     if as_json:
-        # a diverged solve restores "Infinity" gauge tokens to real
-        # floats for the math above — re-sanitize so the output stays
-        # strict JSON (jq-parseable), like every other exporter here
-        from .export import _sanitize
         print(json.dumps(_sanitize(d), indent=2, default=str,
                          allow_nan=False))
     else:

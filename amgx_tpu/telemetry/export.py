@@ -116,6 +116,13 @@ def validate_record(rec: dict):
         need(isinstance(rec.get("attrs"), dict), "event missing attrs")
         need(rec.get("sid") is None or isinstance(rec["sid"], int),
              "bad event sid")
+        if rec["name"] in ("cycle_level", "cycle_coarse",
+                           "forensics_probe"):
+            # forensics events are an analysis input contract
+            # (telemetry/forensics.py keys its anatomy on the level):
+            # a level that stopped being an int mis-buckets silently
+            need(isinstance(rec["attrs"].get("level"), int),
+                 "forensics event missing integer level")
     else:   # counter / gauge / hist
         need(isinstance(rec.get("labels"), dict), "metric missing labels")
         v = rec.get("value")

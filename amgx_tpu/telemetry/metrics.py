@@ -71,6 +71,22 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("gauge", "ring-1 halo width of one shard {device}"),
     "amgx_dist_ring_hops":
         ("gauge", "ppermute hop count of the ring schedule {ring}"),
+    # ---- convergence forensics (telemetry/forensics.py) ------------
+    "amgx_forensics_nullspace":
+        ("gauge", "near-nullspace preservation |A*1|inf/|A|inf of one "
+                  "hierarchy level {level}"),
+    "amgx_forensics_galerkin_err":
+        ("gauge", "sampled relative error of R*A*P vs the stored "
+                  "coarse operator below one level {level}"),
+    "amgx_forensics_cf_ratio":
+        ("gauge", "coarse rows / fine rows across one coarsening "
+                  "{level}"),
+    "amgx_forensics_strong_frac":
+        ("gauge", "fraction of sampled off-diagonal couplings that are "
+                  "strong (AHAT theta=0.25) on one level {level}"),
+    "amgx_forensics_asymptotic_rate":
+        ("gauge", "asymptotic per-iteration residual reduction of the "
+                  "last solve (trailing-half estimate)"),
     # ---- static cost model (telemetry/costmodel.py) ----------------
     "amgx_level_spmv_bytes":
         ("gauge", "modelled HBM bytes of one SpMV on one hierarchy "

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Per-case bench trajectory across rounds.
+
+Parses every ``BENCH_r*.json`` record the driver wrote into one
+round-by-round table of the numbers worth trending — the headline
+solve time, each extra case, SpMV GFLOPS, serving p50 — so the bench
+trajectory is never silently empty again: a round whose bench run
+failed (rc != 0, unparseable output) shows up as a visible
+"round N unusable" row with its error kind instead of vanishing.
+
+Usage: python scripts/bench_trend.py [repo_dir] [--json]
+       (default repo_dir: the directory containing this script's
+       parent — i.e. the repo root)
+"""
+import glob
+import json
+import os
+import sys
+
+
+#: (column label, extractor) — each extractor takes the parsed bench
+#: JSON and returns a number or None
+def _x(path):
+    def get(d):
+        cur = d
+        for k in path:
+            if not isinstance(cur, dict):
+                return None
+            cur = cur.get(k)
+        return cur if isinstance(cur, (int, float)) else None
+    return get
+
+
+CASES = (
+    ("headline_s", _x(("value",))),
+    ("iters", _x(("extras", "iterations"))),
+    ("setup_s", _x(("extras", "setup_s"))),
+    ("spmv_gflops", _x(("extras", "spmv_gflops"))),
+    ("p256_s", _x(("extras", "poisson256", "solve_s"))),
+    ("cla64_s", _x(("extras", "pcg_classical64", "solve_s"))),
+    ("cla128_s", _x(("extras", "pcg_classical128", "solve_s"))),
+    ("dilu4x4_s", _x(("extras", "bicgstab_dilu_4x4", "solve_s"))),
+    ("lobpcg_s", _x(("extras", "eigen", "lobpcg_32cubed_s"))),
+    ("resetup_s", _x(("extras", "classical_device_resetup48",
+                      "resetup_warm_s"))),
+    ("serve_p50_ms", _x(("extras", "serving", "p50_ms"))),
+)
+
+
+def _extract_parsed(rec: dict):
+    """The bench JSON of one driver record: the ``parsed`` field when
+    the driver managed to parse it, else the last JSON-looking line of
+    the recorded tail (the driver wraps raw output there)."""
+    pv = rec.get("parsed")
+    if isinstance(pv, dict) and ("metric" in pv or "error_kind" in pv):
+        return pv
+    for line in reversed(str(rec.get("tail", "")).splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and ("metric" in cand
+                                           or "error_kind" in cand):
+                return cand
+    return None
+
+
+def _error_kind(rec: dict, parsed) -> str:
+    if isinstance(parsed, dict) and parsed.get("error_kind"):
+        return str(parsed["error_kind"])
+    tail = str(rec.get("tail", ""))
+    if "UNAVAILABLE" in tail or "Unable to initialize backend" in tail:
+        return "device_unavailable"
+    return "no_parseable_output"
+
+
+def _round_key(path: str):
+    """Numeric round order — a lexical sort puts r100 before r11."""
+    import re
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else 1 << 30, path)
+
+
+def load_rounds(repo_dir: str):
+    """[{round, usable, reason?, values: {case: num}}] sorted by round."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo_dir,
+                                              "BENCH_r*.json")),
+                       key=_round_key):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            out.append({"round": os.path.basename(path), "usable": False,
+                        "reason": f"unreadable record: {e}"})
+            continue
+        rnd = rec.get("n", os.path.basename(path))
+        parsed = _extract_parsed(rec)
+        rc = rec.get("rc")
+        if rc not in (0, None) or parsed is None \
+                or parsed.get("metric") is None:
+            out.append({
+                "round": rnd, "usable": False,
+                "reason": f"rc={rc}, {_error_kind(rec, parsed)}"})
+            continue
+        out.append({"round": rnd, "usable": True,
+                    "metric": parsed.get("metric"),
+                    "values": {label: fn(parsed)
+                               for label, fn in CASES}})
+    return out
+
+
+def render(rounds) -> str:
+    labels = [label for label, _ in CASES]
+    widths = {label: max(len(label), 9) for label in labels}
+    L = ["bench trajectory (per case, per round)"]
+    L.append("-" * (8 + sum(w + 2 for w in widths.values())))
+    L.append("round   " + "  ".join(label.rjust(widths[label])
+                                    for label in labels))
+    for r in rounds:
+        if not r["usable"]:
+            L.append(f"r{r['round']:<6} UNUSABLE — {r['reason']}")
+            continue
+        cells = []
+        for label in labels:
+            v = r["values"].get(label)
+            cells.append((f"{v:.4g}" if isinstance(v, (int, float))
+                          else "-").rjust(widths[label]))
+        L.append(f"r{r['round']:<6} " + "  ".join(cells))
+    usable = [r for r in rounds if r["usable"]]
+    L.append("")
+    L.append(f"{len(usable)}/{len(rounds)} rounds usable")
+    if usable:
+        metrics = {r["metric"] for r in usable}
+        if len(metrics) > 1:
+            L.append(f"NOTE: headline metric changed across rounds: "
+                     f"{sorted(metrics)}")
+    return "\n".join(L) + "\n"
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    repo = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    rounds = load_rounds(repo)
+    if not rounds:
+        print(f"bench_trend: no BENCH_r*.json records under {repo}",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(rounds, indent=2, default=str))
+    else:
+        print(render(rounds), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

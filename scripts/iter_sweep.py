@@ -1,10 +1,24 @@
 #!/usr/bin/env python
 """Iteration-growth study for the classical bench config (CPU host
-path; hierarchies identical to TPU)."""
+path; hierarchies identical to TPU).
+
+``--trace DIR`` (or ``AMGX_SWEEP_TRACE_DIR``) additionally runs every
+case with convergence forensics on and writes one JSONL trace per
+(variant, size) under DIR — per-level cycle anatomy, hierarchy quality
+probes, asymptotic rate — so an iteration-growth regression (the
+39-vs-21 classical 128³ problem) is *explainable*, not just
+observable:
+
+    python scripts/iter_sweep.py --trace /tmp/sweep base
+    python -m amgx_tpu.telemetry.doctor /tmp/sweep/base_24.jsonl \
+        --diff /tmp/sweep/base_40.jsonl
+"""
 import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 os.environ["AMGX_NO_DEVICE_PIPELINE"] = "1"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -13,6 +27,7 @@ import numpy as np
 import scipy.sparse as sp
 
 import amgx_tpu as amgx
+from amgx_tpu import telemetry
 from amgx_tpu.io import poisson7pt
 
 BASE = (
@@ -35,7 +50,19 @@ variants = {
     "relax0.8": ", sm:relaxation_factor=0.8",
 }
 sizes = [24, 32, 40]
-sel = sys.argv[1:] if len(sys.argv) > 1 else list(variants)
+
+args = sys.argv[1:]
+trace_dir = os.environ.get("AMGX_SWEEP_TRACE_DIR", "")
+if "--trace" in args:
+    i = args.index("--trace")
+    if i + 1 >= len(args):
+        print("iter_sweep: --trace requires a directory", file=sys.stderr)
+        sys.exit(2)
+    trace_dir = args[i + 1]
+    args = args[:i] + args[i + 2:]
+if trace_dir:
+    os.makedirs(trace_dir, exist_ok=True)
+sel = args if args else list(variants)
 
 for name in sel:
     extra = variants[name]
@@ -43,10 +70,28 @@ for name in sel:
     for nx in sizes:
         A = poisson7pt(nx, nx, nx)
         m = amgx.Matrix(A)
-        slv = amgx.create_solver(amgx.AMGConfig(BASE + extra))
-        t0 = time.perf_counter()
-        slv.setup(m)
-        res = slv.solve(np.ones(A.shape[0]))
+        cfg_str = BASE + extra + (", forensics=1" if trace_dir else "")
+        slv = amgx.create_solver(amgx.AMGConfig(cfg_str))
+        if trace_dir:
+            # scoped capture per case: each case's trace is its own
+            # session file (no cross-case ring pollution), written
+            # with the meta header the doctor/validator expect
+            with telemetry.capture() as cap:
+                slv.setup(m)
+                res = slv.solve(np.ones(A.shape[0]))
+            path = os.path.join(trace_dir, f"{name}_{nx}.jsonl")
+            telemetry.dump_jsonl(path, cap.records)
+            fr = telemetry.forensics.analyze(cap.records)
+            if fr and fr.get("weakest"):
+                w = fr["weakest"]
+                print(f"  [{name} {nx}³] weakest: level {w['level']} "
+                      f"{w['component']} ({w['factor']:.3f})  "
+                      f"asymptotic {fr['asymptotic_rate'] or 0:.3f}  "
+                      f"→ {path}", flush=True)
+        else:
+            t0 = time.perf_counter()
+            slv.setup(m)
+            res = slv.solve(np.ones(A.shape[0]))
         hier = slv.preconditioner.hierarchy
         opc = sum(l.A.nnz for l in hier.levels) + hier.coarsest.nnz
         row.append((nx, int(res.iterations), int(res.status),

@@ -154,12 +154,75 @@ def main():
         if section not in report:
             fail(f"doctor report is missing the {section!r} section")
 
+    # 9. convergence forensics (forensics=1): the instrumented cycle
+    # emits schema-valid cycle-anatomy events, the probes fire, and the
+    # doctor's convergence + diff sections render from them
+    telemetry.reset()
+    telemetry.disable()
+    path_f = path + ".forensics"
+    if os.path.exists(path_f):
+        os.unlink(path_f)
+    cfg_f = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        f"forensics=1, out:telemetry=1, out:telemetry_path={path_f}")
+    slv_f = amgx.create_solver(cfg_f)
+    slv_f.setup(amgx.Matrix(A))
+    res_f = slv_f.solve(np.ones(A.shape[0]))
+    if int(res_f.status) != 0:
+        fail(f"forensics smoke solve did not converge ({res_f.status})")
+    with open(path_f) as f:
+        lines_f = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_f)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"forensics trace: {e}")
+    recs_f = [json.loads(l) for l in lines_f if l.strip()]
+    ev_names = {r["name"] for r in recs_f if r["kind"] == "event"}
+    for name in ("cycle_level", "cycle_coarse", "forensics_probe",
+                 "solve_forensics"):
+        if name not in ev_names:
+            fail(f"forensics trace is missing {name!r} events")
+    for r in recs_f:
+        if r["kind"] == "event" and r["name"] == "cycle_level":
+            a = r["attrs"]
+            if not all(isinstance(a.get(k), (int, float))
+                       for k in ("entry", "pre", "coarse", "post")):
+                fail(f"cycle_level event missing cut-point norms: {a}")
+    diag_f = doctor.diagnose([path_f])
+    fr = diag_f.get("forensics")
+    if not fr or not fr.get("levels") or fr.get("weakest") is None:
+        fail("doctor forensics section is empty for a forensics trace")
+    report_f = doctor.render(diag_f)
+    for section in ("convergence forensics", "hierarchy quality probes",
+                    "weakest component"):
+        if section not in report_f:
+            fail(f"doctor report is missing the {section!r} "
+                 "forensics section")
+    dd = doctor.diff(diag_f, diag_f)
+    report_d = doctor.render_diff(dd)
+    for section in ("convergence (A vs B)", "cycle anatomy"):
+        if section not in report_d:
+            fail(f"doctor diff report is missing {section!r}")
+    import contextlib
+    import io
+    with contextlib.redirect_stdout(io.StringIO()) as diff_out:
+        rc_diff = doctor.main([path_f, "--diff", path_f])
+    if rc_diff != 0 or "convergence diff" not in diff_out.getvalue():
+        fail("doctor --diff CLI failed")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
-          f"{n_ev} chrome-trace events, doctor OK)")
+          f"{n_ev} chrome-trace events, doctor OK, forensics OK)")
     if not keep:
         os.unlink(path)
+        os.unlink(path_f)
 
 
 if __name__ == "__main__":

@@ -41,6 +41,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serve: serving-subsystem fast tests "
                    "(tier-1; pytest -m serve selects just these)")
+    config.addinivalue_line(
+        "markers", "forensics: convergence-forensics fast tests "
+                   "(tier-1; pytest -m forensics selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

@@ -1,0 +1,406 @@
+"""Convergence forensics (telemetry/forensics.py + the instrumented
+cycle in amg/cycles.py + the doctor's convergence sections): cycle
+anatomy matches directly-measured V-cycle reduction, the doctor names a
+deliberately weakened level, forensics-off adds no events and no
+retraces, quality probes, trend/diff tooling."""
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.telemetry import doctor, forensics
+
+pytestmark = [pytest.mark.forensics, pytest.mark.telemetry]
+
+
+def poisson1d(n):
+    return sp.csr_matrix(
+        sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n)))
+
+
+def poisson2d(n):
+    I = sp.identity(n)
+    T = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n))
+    return sp.csr_matrix(sp.kron(I, T) + sp.kron(T, I))
+
+
+#: AMG as the MAIN solver: one V-cycle per monitored iteration, so the
+#: level-0 cut-point norms must reproduce the residual history exactly
+AMG_MAIN = (
+    "config_version=2, solver(amg)=AMG, amg:max_iters=25, "
+    "amg:monitor_residual=1, amg:tolerance=1e-10, "
+    "amg:convergence=RELATIVE_INI, amg:algorithm=CLASSICAL, "
+    "amg:selector=PMIS, amg:interpolator=D1, amg:max_levels=4, "
+    "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+    "amg:min_coarse_rows=8, amg:coarse_solver=DENSE_LU_SOLVER, "
+    "forensics=1")
+
+PCG_AMG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=60, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+    "amg:selector=PMIS, amg:max_iters=1, amg:max_levels=10, "
+    "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+# -------------------------------------------------------- cycle anatomy
+@pytest.mark.parametrize("A", [poisson1d(96), poisson2d(16)],
+                         ids=["poisson1d", "poisson2d"])
+def test_cycle_anatomy_matches_measured_reduction(A):
+    """The recorded per-level cut-point norms are REAL residual norms:
+    with AMG as the main solver (one cycle per iteration, L2 monitor),
+    the level-0 entry/post norms must equal the monitored residual
+    history, and the per-cycle component product must compose to the
+    directly measured per-iteration reduction."""
+    slv = amgx.create_solver(amgx.AMGConfig(AMG_MAIN))
+    slv.setup(amgx.Matrix(A))
+    with telemetry.capture() as cap:
+        res = slv.solve(np.ones(A.shape[0]))
+    hist = np.asarray(res.residual_history).ravel()
+    ev = [r["attrs"] for r in cap.events("cycle_level")
+          if r["attrs"]["level"] == 0]
+    assert len(ev) >= res.iterations >= 2
+    for k in range(min(len(ev), res.iterations)):
+        a = ev[k]
+        # entry/post norms ARE the monitored residuals around cycle k
+        assert a["entry"] == pytest.approx(hist[k], rel=1e-5)
+        assert a["post"] == pytest.approx(hist[k + 1], rel=1e-5)
+        # the component factors compose to the measured reduction
+        prod = (a["pre"] / a["entry"]) * (a["coarse"] / a["pre"]) \
+            * (a["post"] / a["coarse"])
+        assert prod == pytest.approx(hist[k + 1] / hist[k], rel=1e-5)
+    # every instrumented level emitted once per cycle
+    n_l0 = len(ev)
+    levels = {r["attrs"]["level"] for r in cap.events("cycle_level")}
+    for lvl in levels:
+        assert len([r for r in cap.events("cycle_level")
+                    if r["attrs"]["level"] == lvl]) == n_l0
+    # the coarsest solve recorded entry/exit too
+    assert cap.events("cycle_coarse")
+
+
+def test_forensics_analyze_and_asymptotic_gauge():
+    A = poisson2d(20)
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG + ", forensics=1"))
+    slv.setup(amgx.Matrix(A))
+    with telemetry.capture() as cap:
+        res = slv.solve(np.ones(A.shape[0]))
+    fr = forensics.analyze(cap.records)
+    assert fr is not None and fr["levels"]
+    for lvl, d in fr["levels"].items():
+        assert d["cycles"] >= res.iterations
+        # healthy smoothing components reduce the residual
+        assert 0 < d["pre_smooth"] < 1.0
+        assert 0 < d["post_smooth"] < 1.0
+        assert 0 < d["total"] < 1.0
+    assert fr["coarse"] is not None and fr["coarse"]["factor"] < 0.1
+    assert fr["weakest"] is not None
+    # per-solve asymptotic convergence-factor gauge + event
+    rate = cap.gauge_last("amgx_forensics_asymptotic_rate")
+    assert rate is not None and 0 < rate < 1.0
+    sf = cap.events("solve_forensics")
+    assert sf and sf[-1]["attrs"]["asymptotic_rate"] == \
+        pytest.approx(rate)
+
+
+def test_asymptotic_rate_estimator():
+    # exact geometric decay → the rate itself
+    norms = [1.0 * 0.5 ** k for k in range(12)]
+    assert forensics.asymptotic_rate(norms) == pytest.approx(0.5)
+    # fast start, slow tail → the TAIL rate (what predicts growth)
+    norms = [10.0 ** -k for k in range(5)] + \
+        [1e-4 * 0.9 ** k for k in range(1, 9)]
+    assert forensics.asymptotic_rate(norms) == pytest.approx(0.9,
+                                                            rel=0.05)
+    assert forensics.asymptotic_rate([1.0, 0.5]) is None
+    assert forensics.asymptotic_rate([]) is None
+    # non-finite and zero entries are ignored, not propagated
+    assert forensics.asymptotic_rate(
+        [1.0, float("nan"), 0.5, 0.25, 0.125, 0.0625]) is not None
+
+
+def test_cycle_anatomy_from_synthetic_records():
+    def ev(name, **attrs):
+        return {"kind": "event", "name": name, "attrs": attrs}
+
+    recs = [
+        ev("cycle_level", level=0, flavor="V", entry=1.0, pre=0.5,
+           coarse=0.4, post=0.2),
+        ev("cycle_level", level=0, flavor="V", entry=0.2, pre=0.1,
+           coarse=0.08, post=0.04),
+        ev("cycle_level", level=1, flavor="V", entry=1.0, pre=0.97,
+           coarse=0.4, post=0.2),
+        ev("cycle_coarse", level=2, entry=1.0, exit=0.01),
+    ]
+    a = forensics.cycle_anatomy(recs)
+    l0 = a["levels"][0]
+    assert l0["cycles"] == 2
+    assert l0["pre_smooth"] == pytest.approx(0.5)
+    assert l0["coarse_corr"] == pytest.approx(0.8)
+    assert l0["post_smooth"] == pytest.approx(0.5)
+    assert l0["total"] == pytest.approx(0.2)
+    l1 = a["levels"][1]
+    assert l1["pre_smooth"] == pytest.approx(0.97)
+    assert l1["coarse_corr"] == pytest.approx(0.4 / 0.97)
+    assert a["coarse"]["factor"] == pytest.approx(0.01)
+    w = forensics.weakest_component(a)
+    assert (w["level"], w["component"]) == (1, "pre_smooth")
+    # non-finite cut points are skipped, not poisoning the mean
+    recs.append(ev("cycle_level", level=0, flavor="V",
+                   entry=float("inf"), pre=1.0, coarse=1.0, post=1.0))
+    a2 = forensics.cycle_anatomy(recs)
+    assert a2["levels"][0]["pre_smooth"] is not None
+
+
+# ------------------------------------------------------ weakened level
+def test_doctor_names_weakened_level(tmp_path):
+    """Acceptance criterion: a hierarchy with one deliberately disabled
+    level-1 smoother makes the doctor report level 1 as the dominant
+    convergence bottleneck, with the per-component table rendered."""
+    A = poisson2d(24)
+    path = str(tmp_path / "weak.jsonl")
+    # leftover ring records from earlier tests would flush into the
+    # fresh trace path and dilute the level-1 factors
+    telemetry.reset()
+    cfg = amgx.AMGConfig(PCG_AMG + ", forensics=1, out:telemetry=1, "
+                         f"out:telemetry_path={path}")
+    slv = amgx.create_solver(cfg)
+    try:
+        slv.setup(amgx.Matrix(A))
+        hier = slv.preconditioner.hierarchy
+        assert len(hier.levels) >= 2
+        # kill level 1's smoother: its pre/post components do nothing
+        hier.levels[1].smoother.apply = \
+            lambda b, x0=None, n_iters=None: x0
+        res = slv.solve(np.ones(A.shape[0]))
+        assert res.iterations > 0
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+    d = doctor.diagnose([path])
+    fr = d["forensics"]
+    assert fr is not None
+    # level 1's smoothing components are exactly dead
+    assert fr["levels"][1]["pre_smooth"] == pytest.approx(1.0)
+    assert fr["levels"][1]["post_smooth"] == pytest.approx(1.0)
+    # the hints name level 1's smoothing as the problem
+    hints = [h for h in d["hints"] if "level 1" in h]
+    assert any("smoother" in h and ("postsweeps" in h
+                                    or "presweeps" in h)
+               for h in hints), d["hints"]
+    report = doctor.render(d)
+    assert "convergence forensics (per-level cycle anatomy)" in report
+    assert "hierarchy quality probes" in report
+    assert "weakest component" in report
+
+
+def test_doctor_healthy_trace_has_no_forensics_hints(tmp_path):
+    """The tuned thresholds stay silent on a healthy converging solve
+    (a transiently-amplifying coarse-correction RESIDUAL is normal)."""
+    A = poisson2d(20)
+    path = str(tmp_path / "healthy.jsonl")
+    telemetry.reset()
+    cfg = amgx.AMGConfig(PCG_AMG + ", forensics=1, out:telemetry=1, "
+                         f"out:telemetry_path={path}")
+    slv = amgx.create_solver(cfg)
+    try:
+        slv.setup(amgx.Matrix(A))
+        res = slv.solve(np.ones(A.shape[0]))
+        assert int(res.status) == 0
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+    d = doctor.diagnose([path])
+    fore_hints = [h for h in d["hints"]
+                  if "smoother" in h or "interpolation" in h
+                  or "coarsest" in h or "nullspace" in h.lower()]
+    assert fore_hints == []
+
+
+# ------------------------------------------------------------ off mode
+def test_forensics_off_no_events_and_no_retraces():
+    """forensics=0 (default): the solve emits no forensics events and —
+    warm — no additional jit retraces (the instrumentation must not
+    change the traced graph when off)."""
+    A = poisson2d(16)
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG))
+    slv.setup(amgx.Matrix(A))
+    slv.solve(np.ones(A.shape[0]))          # warm: trace + compile
+    with telemetry.capture() as cap:
+        slv.solve(np.ones(A.shape[0]))
+    assert cap.events("cycle_level") == []
+    assert cap.events("cycle_coarse") == []
+    assert cap.events("forensics_probe") == []
+    assert cap.events("solve_forensics") == []
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+
+
+def test_set_forensics_flips_instrumentation():
+    """AMGSolver.set_forensics instruments an already-built hierarchy
+    (and un-instruments it again) without a re-setup."""
+    A = poisson2d(16)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(amg)=AMG, amg:max_iters=6, "
+        "amg:monitor_residual=1, amg:tolerance=1e-10, "
+        "amg:convergence=RELATIVE_INI, amg:algorithm=CLASSICAL, "
+        "amg:selector=PMIS, amg:max_levels=4, "
+        "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+        "amg:min_coarse_rows=8, amg:coarse_solver=DENSE_LU_SOLVER")
+    slv = amgx.create_solver(cfg)
+    slv.setup(amgx.Matrix(A))
+    with telemetry.capture() as cap0:
+        slv.solve(np.ones(A.shape[0]))
+    assert cap0.events("cycle_level") == []
+    with telemetry.capture() as cap1:
+        slv.set_forensics(True)
+        slv.solve(np.ones(A.shape[0]))
+    assert cap1.events("cycle_level")
+    # the runtime flip also turns on history keeping (the per-solve
+    # asymptotic estimate needs it) and re-runs the quality probes
+    assert cap1.events("solve_forensics")
+    assert cap1.gauge_last("amgx_forensics_asymptotic_rate") is not None
+    assert cap1.events("forensics_probe")
+    slv.set_forensics(False)
+    with telemetry.capture() as cap2:
+        slv.solve(np.ones(A.shape[0]))
+    assert cap2.events("cycle_level") == []
+
+
+# -------------------------------------------------------------- probes
+def test_hierarchy_quality_probes():
+    A = poisson2d(20)
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG + ", forensics=1"))
+    with telemetry.capture() as cap:
+        slv.setup(amgx.Matrix(A))
+    probes = {r["attrs"]["level"]: r["attrs"]
+              for r in cap.events("forensics_probe")}
+    assert probes
+    for lvl, p in probes.items():
+        # a freshly built classical hierarchy satisfies Galerkin
+        # consistency to rounding
+        if p.get("galerkin_err") is not None:
+            assert p["galerkin_err"] < 1e-10
+        # Poisson keeps the near-nullspace on every Galerkin level
+        if p.get("nullspace") is not None:
+            assert p["nullspace"] < 0.6
+        assert 0 < p["cf_ratio"] < 1.0
+    assert cap.gauge_last("amgx_forensics_galerkin_err",
+                          level=0) is not None
+    assert cap.gauge_last("amgx_forensics_cf_ratio",
+                          level=0) is not None
+
+
+def test_probe_gauges_cleared_on_rebuild():
+    """A shallower re-setup must not leave stale deep-level forensics
+    gauges in the registry snapshot (same hygiene as the level
+    gauges)."""
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG + ", forensics=1"))
+    with telemetry.capture():
+        slv.setup(amgx.Matrix(poisson2d(20)))
+        deep = {lk for (n, lk) in
+                telemetry.registry()._gauges
+                if n == "amgx_forensics_cf_ratio"}
+        assert deep
+        slv2 = amgx.create_solver(
+            amgx.AMGConfig(PCG_AMG + ", forensics=1, amg:max_levels=2"))
+        slv2.setup(amgx.Matrix(poisson2d(20)))
+        after = {lk for (n, lk) in
+                 telemetry.registry()._gauges
+                 if n == "amgx_forensics_cf_ratio"}
+        assert len(after) <= 1      # only level 0 of the 2-level build
+
+
+# ----------------------------------------------------- doctor diff CLI
+def _write_synthetic_trace(path, iters, level1_post):
+    """A minimal but schema-valid forensics trace: residual trail +
+    cycle anatomy with a chosen level-1 post-smooth factor."""
+    with telemetry.capture() as cap:
+        norm = 1.0
+        for k in range(iters + 1):
+            telemetry.event("residual", iteration=k, norm=norm)
+            telemetry.event("cycle_level", level=0, flavor="V",
+                            entry=norm, pre=norm * 0.5,
+                            coarse=norm * 0.45, post=norm * 0.3)
+            telemetry.event("cycle_level", level=1, flavor="V",
+                            entry=norm, pre=norm * 0.6,
+                            coarse=norm * 0.5,
+                            post=norm * 0.5 * level1_post)
+            norm *= 0.3
+    telemetry.dump_jsonl(str(path), cap.records)
+
+
+def test_doctor_diff_cli(tmp_path, capsys):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    _write_synthetic_trace(a, 8, level1_post=0.5)
+    _write_synthetic_trace(b, 20, level1_post=0.99)
+    assert doctor.main([str(a), "--diff", str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "amgx convergence diff" in out
+    assert "cycle anatomy (A | B per component)" in out
+    assert "level 1 post-smooth worsened" in out
+    # --json variant stays strict JSON
+    assert doctor.main([str(a), "--diff", str(b), "--json"]) == 0
+    dd = json.loads(capsys.readouterr().out)
+    assert dd["levels"]
+    # missing --diff operand is a usage error
+    assert doctor.main([str(a), "--diff"]) == 2
+
+
+def test_validate_record_checks_forensics_events():
+    good = {"kind": "event", "name": "cycle_level", "seq": 1, "t": 0.0,
+            "tid": 1, "sid": None,
+            "attrs": {"level": 0, "entry": 1.0}}
+    telemetry.validate_record(good)
+    bad = dict(good, attrs={"level": "zero"})
+    with pytest.raises(ValueError, match="integer level"):
+        telemetry.validate_record(bad)
+
+
+# ------------------------------------------------- bench-trend tooling
+def _load_script(name):
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", name)
+    spec = importlib.util.spec_from_file_location(
+        name.replace(".py", ""), path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_trend_marks_unusable_rounds(tmp_path):
+    bt = _load_script("bench_trend.py")
+    good = {"n": 1, "rc": 0, "tail": "", "parsed": {
+        "metric": "m", "value": 0.5, "unit": "s",
+        "extras": {"iterations": 7, "setup_s": 1.0,
+                   "spmv_gflops": 100.0}}}
+    bad = {"n": 2, "rc": 1, "tail": "JaxRuntimeError: UNAVAILABLE: "
+           "TPU backend setup/compile error", "parsed": None}
+    tail_only = {"n": 3, "rc": 0, "tail":
+                 'x\n{"metric": "m", "value": 0.25, "extras": {}}\n',
+                 "parsed": None}
+    for i, rec in enumerate((good, bad, tail_only), 1):
+        (tmp_path / f"BENCH_r0{i}.json").write_text(json.dumps(rec))
+    rounds = bt.load_rounds(str(tmp_path))
+    assert [r["usable"] for r in rounds] == [True, False, True]
+    assert rounds[1]["reason"] == "rc=1, device_unavailable"
+    assert rounds[2]["values"]["headline_s"] == 0.25
+    text = bt.render(rounds)
+    assert "UNUSABLE" in text and "2/3 rounds usable" in text
+
+
+def test_bench_device_error_classifier():
+    bench = _load_script("../bench.py")
+    assert bench._is_device_init_error(
+        RuntimeError("Unable to initialize backend 'tpu'"))
+    assert bench._is_device_init_error(
+        RuntimeError("UNAVAILABLE: TPU backend setup/compile error"))
+    assert not bench._is_device_init_error(ValueError("bad config"))
