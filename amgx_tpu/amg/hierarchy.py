@@ -24,6 +24,7 @@ import numpy as np
 import scipy.sparse as sp
 
 from .. import telemetry
+from ..telemetry import setup_profile
 from ..config import AMGConfig
 from ..core.matrix import Matrix
 from ..errors import BadConfigurationError
@@ -239,7 +240,8 @@ class AMGHierarchy:
                 # best-effort, a probe gap must never break setup
                 from ..telemetry import forensics
                 try:
-                    with cpu_profiler("forensics_probes"):
+                    with cpu_profiler("forensics_probes"), \
+                            setup_profile.phase("probes"):
                         forensics.probe_hierarchy(self)
                 except Exception:
                     pass
@@ -256,7 +258,8 @@ class AMGHierarchy:
         cur = self._build_levels(A)
         self._setup_smoothers_and_coarse(cur)
         if self.structure_reuse_levels != 0:
-            with cpu_profiler("classical_resetup_plans"):
+            with cpu_profiler("classical_resetup_plans"), \
+                    setup_profile.phase("resetup_plan"):
                 self._build_classical_plans(A, cur)
 
     def _build_classical_plans(self, A: Matrix, coarsest: Matrix):
@@ -351,7 +354,9 @@ class AMGHierarchy:
                 break
             if n <= self.min_coarse_rows:
                 break
-            with cpu_profiler(f"coarsen_level_{len(self.levels)}"):
+            with cpu_profiler(f"coarsen_level_{len(self.levels)}"), \
+                    setup_profile.phase("coarsen",
+                                        level=len(self.levels)):
                 level, Ac, struct = self._coarsen_once(cur,
                                                        len(self.levels))
             if level is None:
@@ -367,9 +372,18 @@ class AMGHierarchy:
                     "classical":
                 from ..core.matrix import batch_upload
                 mats, lean_except = self._level_pack_mats(level)
-                stream.push_work(
-                    lambda ms=mats, le=lean_except:
-                    batch_upload(ms, lean_except=le))
+
+                def _stream_upload(ms=mats, le=lean_except,
+                                   li=len(self.levels) - 1):
+                    # runs on the streaming worker thread: its upload
+                    # phase OVERLAPS the main-thread coarsening (the
+                    # setup-profile analyzer reports it separately and
+                    # excludes it from wall-clock coverage)
+                    with setup_profile.phase("upload", level=li,
+                                             kind="device"):
+                        batch_upload(ms, lean_except=le)
+
+                stream.push_work(_stream_upload)
             cur = Ac
         return cur
 
@@ -393,7 +407,9 @@ class AMGHierarchy:
             kind, data = struct
             if kind == "aggregation":
                 agg, nc = data
-                Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
+                with setup_profile.phase("rap", level=i):
+                    Ac_host = galerkin_coarse(cur.host, agg,
+                                              cur.block_dim)
                 lvl = AggregationLevel(cur, i, agg, nc)
                 nxt = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             elif kind == "pairwise":
@@ -424,12 +440,14 @@ class AMGHierarchy:
                 P_host, = data
                 R_host = sp.csr_matrix(P_host.T)
                 Asc_r = cur.scalar_csr()
-                Ac_host = sp.csr_matrix(R_host @ Asc_r @ P_host)
-                if self.algorithm == "CLASSICAL":
-                    # keep the symbolic pattern stable across resetups
-                    # so recorded device plans stay applicable
-                    Ac_host = _symbolic_pad_galerkin(Ac_host, Asc_r,
-                                                     P_host)
+                with setup_profile.phase("rap", level=i):
+                    Ac_host = sp.csr_matrix(R_host @ Asc_r @ P_host)
+                    if self.algorithm == "CLASSICAL":
+                        # keep the symbolic pattern stable across
+                        # resetups so recorded device plans stay
+                        # applicable
+                        Ac_host = _symbolic_pad_galerkin(Ac_host, Asc_r,
+                                                         P_host)
                 lvl = ClassicalLevel(cur, i,
                                      _child_matrix(cur, P_host),
                                      _child_matrix(cur, R_host))
@@ -483,7 +501,8 @@ class AMGHierarchy:
         if plans["fine_map_dev"] is None:
             plans["fine_map_dev"] = jax.device_put(
                 plans["fine_map"].astype(np.int32))
-        with cpu_profiler("classical_device_resetup"):
+        with cpu_profiler("classical_device_resetup"), \
+                setup_profile.phase("resetup_device", kind="device"):
             vA = curd.vals.reshape(-1)[plans["fine_map_dev"]]
             for i, (level, struct) in enumerate(old):
                 plan = plans["levels"][i]
@@ -604,7 +623,8 @@ class AMGHierarchy:
         if curd.fmt != "dia":
             return cur
         dvals = curd.vals if keep is None else curd.vals[keep]
-        with cpu_profiler("dia_device_derive"):
+        with cpu_profiler("dia_device_derive"), \
+                setup_profile.phase("dia_derive", kind="device"):
             outs = derive_hierarchy_device(steps, offs, dvals)
         return self._append_dia_levels(cur, steps, outs)
 
@@ -650,7 +670,8 @@ class AMGHierarchy:
         if curd.fmt != "dia":
             return 0, cur
         dvals = curd.vals if keep is None else curd.vals[keep]
-        with cpu_profiler("dia_device_derive"):
+        with cpu_profiler("dia_device_derive"), \
+                setup_profile.phase("dia_derive", kind="device"):
             outs = derive_hierarchy_device(steps, offs, dvals)
         return len(steps), self._append_dia_levels(cur, steps, outs)
 
@@ -754,7 +775,9 @@ class AMGHierarchy:
         seed = _tiebreak_seed(self.cfg)
         n = cur.n_block_rows
         dvals = curd.vals if keep is None else curd.vals[keep]
-        with cpu_profiler("classical_device_fine_embedded"):
+        with cpu_profiler("classical_device_fine_embedded"), \
+                setup_profile.phase("device_fine", level=0,
+                                    kind="device"):
             res = coarsen_fine_embedded(offs, dvals, n, seed=seed,
                                         **params)
         if res is None or res.nc >= self.coarsen_threshold * n or \
@@ -799,7 +822,8 @@ class AMGHierarchy:
         # ---- compact continuation ----
         cur_m, cols, vals, n_log = A1m, res.cols, res.vals, res.nc
         foc = res.foc            # embedded↔compact map of level 1
-        with cpu_profiler("classical_device_coarse_levels"):
+        with cpu_profiler("classical_device_coarse_levels"), \
+                setup_profile.phase("device_coarse", kind="device"):
             while True:
                 if len(self.levels) + 1 >= self.max_levels or \
                         n_log <= max(self.min_coarse_rows,
@@ -853,7 +877,11 @@ class AMGHierarchy:
             self._structure.pop()
             return None
         # ---- tail: hand the (small, padded) matrix to the host loop
-        with cpu_profiler("classical_device_tail_download"):
+        with cpu_profiler("classical_device_tail_download"), \
+                setup_profile.phase("tail_download", kind="device"), \
+                setup_profile.transfer(int(cols.nbytes)
+                                       + int(vals.nbytes), 2,
+                                       "download"):
             cur_m._host = self._compact_to_host(cols, vals)
             cur_m.dtype = np.dtype(np.float64)
         return cur_m
@@ -908,7 +936,9 @@ class AMGHierarchy:
         # on/off A/B runs must differ only in representation
         seed = _tiebreak_seed(self.cfg)
         g = lambda p: self.cfg.get(p, self.scope)
-        with cpu_profiler("classical_fine_device"):
+        with cpu_profiler("classical_fine_device"), \
+                setup_profile.phase("device_fine", level=idx,
+                                    kind="device"):
             cf_map, P_host = classical_fine_device(
                 offs, dvals, cur.n_block_rows,
                 float(g("strength_threshold")), float(g("max_row_sum")),
@@ -921,11 +951,13 @@ class AMGHierarchy:
         Asc = cur.scalar_csr()
         P_host = P_host.astype(Asc.dtype)
         R_host = sp.csr_matrix(P_host.T)
-        Ac_host = sp.csr_matrix(R_host @ Asc @ P_host).astype(Asc.dtype)
-        if self.structure_reuse_levels != 0:
-            Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
-        Ac_host.sum_duplicates()
-        Ac_host.sort_indices()
+        with setup_profile.phase("rap", level=idx):
+            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host) \
+                .astype(Asc.dtype)
+            if self.structure_reuse_levels != 0:
+                Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
+            Ac_host.sum_duplicates()
+            Ac_host.sort_indices()
         level = ClassicalLevel(cur, idx, _child_matrix(cur, P_host),
                                _child_matrix(cur, R_host), cf_map)
         return level, _child_matrix(cur, Ac_host), \
@@ -952,11 +984,13 @@ class AMGHierarchy:
                 # attached per-row coordinates feed the GEO selector
                 # (AMGX_matrix_attach_geometry → geo_selector.cu)
                 Asc._amgx_geometry = geom
-            agg = selector.select(Asc)
+            with setup_profile.phase("selector", level=idx):
+                agg = selector.select(Asc)
             nc = int(agg.max()) + 1 if len(agg) else 0
             if nc == 0:
                 return None, None, None
-            Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
+            with setup_profile.phase("rap", level=idx):
+                Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
             level = AggregationLevel(cur, idx, agg, nc)
             Ac = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             if geom is not None:
@@ -1009,21 +1043,28 @@ class AMGHierarchy:
                 if out is not None:
                     return out
             Asc = cur.scalar_csr()
-            S = strength.compute(Asc)
+            with setup_profile.phase("strength", level=idx):
+                S = strength.compute(Asc)
             selector = create_cf_selector(sel_name, self.cfg, self.scope)
-            cf_map = selector.select(S)
+            with setup_profile.phase("selector", level=idx):
+                cf_map = selector.select(S)
             nc = int(cf_map.sum())
             if nc == 0 or nc >= Asc.shape[0]:
                 return None, None, None
             interp = create_interpolator(interp_name, self.cfg, self.scope)
-            P_host = interp.compute(Asc, S, cf_map).astype(Asc.dtype)
+            with setup_profile.phase("interpolation", level=idx):
+                P_host = interp.compute(Asc, S, cf_map).astype(Asc.dtype)
             R_host = sp.csr_matrix(P_host.T)
-            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host).astype(Asc.dtype)
-            if self.algorithm == "CLASSICAL" and \
-                    self.structure_reuse_levels != 0 and cur.dist is None:
-                Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
-            Ac_host.sum_duplicates()
-            Ac_host.sort_indices()
+            with setup_profile.phase("rap", level=idx):
+                Ac_host = sp.csr_matrix(R_host @ Asc @ P_host) \
+                    .astype(Asc.dtype)
+                if self.algorithm == "CLASSICAL" and \
+                        self.structure_reuse_levels != 0 and \
+                        cur.dist is None:
+                    Ac_host = _symbolic_pad_galerkin(Ac_host, Asc,
+                                                     P_host)
+                Ac_host.sum_duplicates()
+                Ac_host.sort_indices()
             if cur.dist is not None:
                 # fallback (non-row-local strength, HMIS/RS, MULTIPASS,
                 # consolidation-small grids): embed P/R into the padded
@@ -1146,14 +1187,16 @@ class AMGHierarchy:
         if dims is not None and max(dims) > 1:
             offs3 = decompose_offsets(offs, dims)
             if offs3 is not None:
-                out = self._structured_numeric(offs3, vals, dims)
+                with setup_profile.phase("rap", level=idx):
+                    out = self._structured_numeric(offs3, vals, dims)
                 if out is not None:
                     flat, vals_c, cdims = out
                     level = StructuredLevel(cur, idx, dims, cdims)
                     Ac = _child_matrix_dia(cur, flat, vals_c)
                     Ac.grid_dims = cdims
                     return level, Ac, ("structured", (dims,))
-        offs_c, vals_c = self._pairwise_numeric(arrs)
+        with setup_profile.phase("rap", level=idx):
+            offs_c, vals_c = self._pairwise_numeric(arrs)
         level = PairwiseLevel(cur, idx, n)
         Ac = _child_matrix_dia(cur, offs_c, vals_c)
         return level, Ac, ("pairwise", (n,))
@@ -1306,10 +1349,12 @@ class AMGHierarchy:
         if stream is not None:
             # wait out the per-level uploads streamed during coarsening
             # (only the residual wire time shows up here)
-            with cpu_profiler("hierarchy_upload_drain"):
+            with cpu_profiler("hierarchy_upload_drain"), \
+                    setup_profile.phase("upload", kind="device"):
                 stream.join_threads()
             self._stream_uploader = None
-        with cpu_profiler("hierarchy_upload"):
+        with cpu_profiler("hierarchy_upload"), \
+                setup_profile.phase("upload", kind="device"):
             mats, fine_ids = [], set()
             for lvl in self.levels:
                 ms, le = self._level_pack_mats(lvl)
@@ -1319,9 +1364,14 @@ class AMGHierarchy:
 
         def smoother_task(lvl):
             def run():
-                lvl.smoother = SolverFactory.allocate(
-                    self.cfg, self.scope, "smoother")
-                lvl.smoother.setup(lvl.A)
+                # worker-thread phase: OVERLAPS the main thread's
+                # smoother_setup wall (excluded from coverage) but owns
+                # the smoother-setup jit compiles for attribution
+                with setup_profile.phase("smoother_setup",
+                                         level=lvl.level_index):
+                    lvl.smoother = SolverFactory.allocate(
+                        self.cfg, self.scope, "smoother")
+                    lvl.smoother.setup(lvl.A)
             return run
 
         # per-level smoother setups are independent — overlap their host
@@ -1330,12 +1380,14 @@ class AMGHierarchy:
         # forces the serial order for debugging)
         serialize = bool(self.cfg.get("serialize_threads"))
         with cpu_profiler("setup_smoothers"), \
+                setup_profile.phase("smoother_setup"), \
                 ThreadManager(serialize=serialize) as tm:
             for lvl in self.levels:
                 tm.push_work(smoother_task(lvl))
             tm.wait_threads()
         self.coarsest = coarsest
-        with cpu_profiler("setup_coarse_solver"):
+        with cpu_profiler("setup_coarse_solver"), \
+                setup_profile.phase("coarse_solver"):
             self.coarse_solver = SolverFactory.allocate(
                 self.cfg, self.scope, "coarse_solver")
             self.coarse_solver.setup(coarsest)

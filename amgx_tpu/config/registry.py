@@ -300,6 +300,13 @@ def register_default_parameters():
     R("forensics", int, 0,
       "enable convergence forensics (cycle anatomy + hierarchy probes)",
       _BOOL)
+    # setup profiler (telemetry/setup_profile.py): per-level ×
+    # per-component setup phase tree with compile/transfer/memory
+    # attribution.  Off by default: the setup hot path then pays one
+    # attribute check per marker and is otherwise byte-identical
+    R("setup_profile", int, 0,
+      "enable setup attribution (phase tree, compile/transfer split, "
+      "HBM watermarks)", _BOOL)
     # serving subsystem (amgx_tpu/serve/): request-level concurrency —
     # sessions with a pattern-keyed setup cache, micro-batched multi-RHS
     # solves, bounded-queue admission control

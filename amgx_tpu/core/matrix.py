@@ -1119,13 +1119,17 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
     ship, and for complex dtypes hang, on a backend that cannot hold
     the data)."""
     import jax
+
+    from ..telemetry import setup_profile
     arrays, meta = pack_host_arrays(host, block_dim, dtype,
                                     ell_max_width, dia_max_diags,
                                     use_shift=use_shift)
     keys = sorted(arrays)
-    devs = jax.device_put([arrays[k] for k in keys], device) \
-        if device is not None else \
-        jax.device_put([arrays[k] for k in keys])
+    with setup_profile.transfer(sum(arrays[k].nbytes for k in keys),
+                                len(keys), "upload"):
+        devs = jax.device_put([arrays[k] for k in keys], device) \
+            if device is not None else \
+            jax.device_put([arrays[k] for k in keys])
     return assemble_device_matrix(dict(zip(keys, devs)), meta)
 
 
@@ -1196,12 +1200,17 @@ def _pack_dia_arrays(offsets, vals: np.ndarray, n_cols: int, dtype,
     explicit two-array put — a device-side slice would land on the
     default backend, not the pinned device."""
     import jax
+
+    from ..telemetry import setup_profile
     vals32 = vals.astype(dtype, copy=False)
     if device is not None:
         diag = _dia_diag_row(offsets, vals32)
-        dvals, ddiag = jax.device_put([vals32, diag], device)
+        with setup_profile.transfer(vals32.nbytes + diag.nbytes, 2,
+                                    "upload"):
+            dvals, ddiag = jax.device_put([vals32, diag], device)
     else:
-        dvals = jax.device_put(vals32)
+        with setup_profile.transfer(vals32.nbytes, 1, "upload"):
+            dvals = jax.device_put(vals32)
         ddiag = _dia_device_diag(offsets, dvals)
     return _dia_device_matrix(offsets, dvals, ddiag, n_cols)
 
@@ -1231,11 +1240,13 @@ def arena_upload(array_dicts, device=None):
     Returns one dict of device arrays per input dict."""
     import jax
 
+    from ..telemetry import setup_profile
     from ..utils.profiler import cpu_profiler
     items = [(i, k, d[k]) for i, d in enumerate(array_dicts)
              for k in sorted(d)]
     nb = sum(a.nbytes for _, _, a in items)
-    with cpu_profiler(f"arena_put_{len(items)}arrs_{nb >> 20}MB"):
+    with cpu_profiler(f"arena_put_{len(items)}arrs_{nb >> 20}MB"), \
+            setup_profile.transfer(nb, len(items), "upload"):
         arrs = [a for _, _, a in items]
         devs = jax.device_put(arrs) if device is None else \
             jax.device_put(arrs, device)

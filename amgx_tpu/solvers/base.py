@@ -182,6 +182,12 @@ class Solver:
         self.forensics = bool(int(g("forensics")))
         if self.forensics:
             self.store_res_history = True
+        # setup profiler (telemetry/setup_profile.py): per-phase setup
+        # attribution with compile/transfer/memory splits; the knob
+        # enables the process-global profiler (which enables the
+        # telemetry recorder — phase records live in the same ring)
+        if int(g("setup_profile")):
+            telemetry.setup_profile.enable()
         # an EXPLICIT verbosity_level drives the level-gated output
         # stream; the registry default must not clobber a verbosity the
         # host application set programmatically
@@ -210,8 +216,14 @@ class Solver:
         # user-facing setup would inflate every aggregate
         toplevel = bool(getattr(self, "_toplevel", False))
         t0 = time.perf_counter()
+        # setup attribution (telemetry/setup_profile.py): only the
+        # TOP-LEVEL setup opens a profile scope — nested smoother/
+        # coarse-solver setups contribute phases into it
+        _sp = telemetry.setup_profile
+        prof = _sp.profile_setup(self.config_name) if toplevel \
+            else _sp.null()
         with telemetry.span(phase, solver=self.config_name,
-                            scope=self.scope, toplevel=toplevel):
+                            scope=self.scope, toplevel=toplevel), prof:
             self._setup_impl(A)
         self.setup_time = time.perf_counter() - t0
         if toplevel and telemetry.is_enabled():
@@ -249,7 +261,8 @@ class Solver:
                 # solver.cu:441-475 documents that workaround — a copy is
                 # cleaner and setup-phase only)
                 from .scalers import create_scaler
-                with cpu_profiler("setup_scaling"):
+                with cpu_profiler("setup_scaling"), \
+                        telemetry.setup_profile.phase("scaling"):
                     self.scaler = create_scaler(scaling, self.cfg,
                                                 self.scope)
                     self.scaler.setup(A.scalar_csr())
@@ -259,11 +272,13 @@ class Solver:
                 # solve() has the permute boundary — a nested smoother/
                 # preconditioner permuting its operator would be fed
                 # residuals in the un-permuted level ordering
-                A2 = self._maybe_reorder(A)
+                with telemetry.setup_profile.phase("reorder"):
+                    A2 = self._maybe_reorder(A)
                 if A2 is not None:
                     A = A2
             self.A = A
-            with cpu_profiler("matrix_pack_device"):
+            with cpu_profiler("matrix_pack_device"), \
+                    telemetry.setup_profile.phase("pack", kind="device"):
                 self.Ad = A.device()
         else:
             self.A = None

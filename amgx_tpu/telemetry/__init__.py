@@ -34,7 +34,8 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import costmodel, export, forensics, metrics, recorder, tracefile
+from . import (costmodel, export, forensics, metrics, recorder,
+               setup_profile, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -55,7 +56,7 @@ __all__ = [
     "validate_record", "validate_jsonl",
     "read_sessions", "aggregate_sessions",
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
-    "costmodel", "forensics",
+    "costmodel", "forensics", "setup_profile",
     "reset",
 ]
 
@@ -67,3 +68,4 @@ def reset():
     recorder.clear()
     recorder.reset_dropped()
     metrics.registry().reset()
+    setup_profile.reset()

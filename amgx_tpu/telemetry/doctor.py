@@ -298,6 +298,11 @@ def diagnose(paths: List[str]) -> dict:
     fr = _forensics.analyze(r for s in agg["sessions"]
                             for r in s["records"])
 
+    # ---- setup attribution (telemetry/setup_profile.py) -------------
+    from . import setup_profile as _setup_profile
+    setup = _setup_profile.analyze(r for s in agg["sessions"]
+                                   for r in s["records"])
+
     # ---- hints ------------------------------------------------------
     hints: List[str] = []
     if agg["dropped_records"]:
@@ -349,6 +354,7 @@ def diagnose(paths: List[str]) -> dict:
         hints.append(f"{int(divergences)} divergence event(s): a "
                      "residual went non-finite")
     hints.extend(_forensics_hints(fr))
+    hints.extend(_setup_hints(setup))
     jit, _ = csum("amgx_jit_compile_total")
     if jit:
         hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
@@ -397,6 +403,7 @@ def diagnose(paths: List[str]) -> dict:
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
         "forensics": fr,
+        "setup": setup,
         "hints": hints,
     }
 
@@ -485,6 +492,65 @@ def _forensics_hints(fr: Optional[dict]) -> List[str]:
                 f"level {lvl}: operator no longer annihilates the "
                 f"constant vector (|A·1|/|A| = {ns:.2f}) — the "
                 "near-nullspace was lost in coarsening")
+    return hints
+
+
+#: setup components whose dominance reads "the algorithm runs host-side"
+_HOST_SETUP_COMPONENTS = ("strength", "selector", "interpolation", "rap")
+
+
+def _setup_hints(setup: Optional[dict]) -> List[str]:
+    """Actionable setup-attribution hints (telemetry/setup_profile.py):
+    compile-bound setups earn the persistent-cache/AOT advice,
+    host-dominated classical components point at the device-side setup
+    work (ROADMAP item 1), chatty transfers point at batching."""
+    if not setup:
+        return []
+    from .setup_profile import (COMPILE_HINT, DOMINANT_HINT,
+                                TRANSFER_HINT, UPLOAD_DRAIN_HINT)
+    hints: List[str] = []
+    s = setup.get("summary") or {}
+    total = setup.get("total_s") or 0.0
+    if total:
+        # worker-thread compile (smoother-setup tasks) overlaps the
+        # owner's wait phases but is still compile work a persistent
+        # cache would remove — count it toward the hint, capped at 1
+        cshare = min(((s.get("compile_s") or 0.0)
+                      + (s.get("worker_compile_s") or 0.0)) / total, 1.0)
+        if cshare >= COMPILE_HINT:
+            hints.append(
+                f"compile is {cshare:.0%} of setup → enable the "
+                "persistent compilation cache / AOT-lower the setup "
+                "executables so reruns skip it")
+        tshare = (s.get("transfer_s") or 0.0) / total
+        if tshare >= TRANSFER_HINT:
+            hints.append(
+                f"host↔device transfers are {tshare:.0%} of setup "
+                f"({_fmt_bytes(s.get('transfer_bytes'))}) — keep the "
+                "hierarchy on device / batch the uploads")
+    for p in setup.get("phases", [])[:3]:
+        if p.get("overlapped"):
+            continue
+        if p.get("share", 0.0) >= DOMINANT_HINT and \
+                p["component"] in _HOST_SETUP_COMPONENTS and \
+                p.get("host_s", 0.0) > p.get("compile_s", 0.0):
+            where = f" at level {p['level']}" \
+                if p.get("level") is not None else ""
+            hints.append(
+                f"{p['component']}{where} runs host-side and is "
+                f"{p['share']:.0%} of setup → device-side setup "
+                "kernels (SpGEMM/Galerkin RAP, ROADMAP item 1)")
+            break
+    uploads = int(s.get("uploads") or 0)
+    if uploads > UPLOAD_DRAIN_HINT:
+        hints.append(
+            f"upload drained {uploads} times during setup — arena-batch "
+            "the hierarchy transfer (one device_put round trip)")
+    cov = s.get("coverage")
+    if isinstance(cov, (int, float)) and cov < 0.9:
+        hints.append(
+            f"setup attribution covers only {cov:.0%} of the wall — "
+            "un-instrumented phases; extend the setup_profile markers")
     return hints
 
 
@@ -594,6 +660,10 @@ def render(d: dict) -> str:
             L.append(f"  latency p50/p95/p99: {lat['p50']*1e3:.1f}/"
                      f"{lat['p95']*1e3:.1f}/{lat['p99']*1e3:.1f} ms")
 
+    setup = d.get("setup")
+    if setup:
+        L.extend(_render_setup(setup))
+
     conv = d["convergence"]
     if conv:
         L.append("")
@@ -623,6 +693,63 @@ def render(d: dict) -> str:
     else:
         L.append("hints: none — the trace looks healthy")
     return "\n".join(L) + "\n"
+
+
+def _render_setup(setup: dict) -> List[str]:
+    """The setup-attribution report block: totals with the
+    execute/compile/transfer/host split, coverage + HBM watermark, and
+    the ranked phase table (telemetry/setup_profile.py)."""
+    L: List[str] = []
+    L.append("")
+    L.append("setup attribution (per phase)")
+    L.append("-" * 40)
+    s = setup.get("summary") or {}
+    total = setup.get("total_s") or 0.0
+
+    def pct(v):
+        return f"{(v or 0.0) / total:.0%}" if total else "?"
+
+    if s:
+        L.append(f"  setup {total:.3f} s = "
+                 f"compile {s.get('compile_s', 0.0):.3f} s ({pct(s.get('compile_s'))})"
+                 f" + transfer {s.get('transfer_s', 0.0):.3f} s ({pct(s.get('transfer_s'))})"
+                 f" + execute {s.get('execute_s', 0.0):.3f} s ({pct(s.get('execute_s'))})"
+                 f" + host {s.get('host_s', 0.0):.3f} s ({pct(s.get('host_s'))})")
+        wc = s.get("worker_compile_s") or 0.0
+        wt = s.get("worker_transfer_s") or 0.0
+        if wc or wt:
+            parts = []
+            if wc:
+                parts.append(f"{wc:.3f} s compile")
+            if wt:
+                parts.append(f"{wt:.3f} s transfer")
+            L.append(f"  (+{' + '.join(parts)} on worker threads, "
+                     "overlapped with the owner's wait phases)")
+        cov = s.get("coverage")
+        wm = s.get("mem_watermark_bytes")
+        L.append("  coverage: "
+                 + (f"{cov:.0%} of setup wall attributed"
+                    if isinstance(cov, (int, float)) else "?")
+                 + (f"   HBM watermark: {_fmt_bytes(wm)}"
+                    if wm else "")
+                 + (f"   uploads/downloads: {int(s.get('uploads', 0))}"
+                    f"/{int(s.get('downloads', 0))}"
+                    if s.get("uploads") or s.get("downloads") else ""))
+    L.append(f"  {'phase':<22}{'self_s':>9}{'share':>7}{'compile':>9}"
+             f"{'transfer':>10}{'rest':>9}  kind")
+    shown = 0
+    for p in setup.get("phases", []):
+        if shown >= 12:
+            break
+        shown += 1
+        rest = p.get("execute_s", p.get("host_s", 0.0))
+        L.append(
+            f"  {p['name']:<22}{p['self_s']:>9.3f}"
+            f"{p.get('share', 0.0):>7.1%}{p['compile_s']:>9.3f}"
+            f"{p.get('transfer_s', 0.0):>10.3f}{rest:>9.3f}  "
+            f"{p.get('kind', '?')}"
+            + ("  (overlapped)" if p.get("overlapped") else ""))
+    return L
 
 
 def _fmt_factor(f) -> str:

@@ -123,6 +123,20 @@ def validate_record(rec: dict):
             # a level that stopped being an int mis-buckets silently
             need(isinstance(rec["attrs"].get("level"), int),
                  "forensics event missing integer level")
+        if rec["name"] == "setup_phase":
+            # setup-profiler phase records are the analysis input of
+            # setup_profile.analyze / the doctor "setup" section
+            a = rec["attrs"]
+            need(isinstance(a.get("component"), str) and a["component"],
+                 "setup_phase event missing component")
+            need(isinstance(a.get("wall_s"), (int, float)) and
+                 isinstance(a.get("self_s"), (int, float)),
+                 "setup_phase event missing wall_s/self_s")
+            need(a.get("level") is None or isinstance(a["level"], int),
+                 "setup_phase event has non-integer level")
+        if rec["name"] == "setup_profile":
+            need(isinstance(rec["attrs"].get("wall_s"), (int, float)),
+                 "setup_profile summary missing wall_s")
     else:   # counter / gauge / hist
         need(isinstance(rec.get("labels"), dict), "metric missing labels")
         v = rec.get("value")

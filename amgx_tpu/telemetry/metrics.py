@@ -97,6 +97,28 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_level_padding_waste":
         ("gauge", "stored slots / nnz of one level's device pack "
                   "{level}"),
+    # ---- setup profiler (telemetry/setup_profile.py) ----------------
+    "amgx_setup_phase_seconds":
+        ("gauge", "exclusive wall seconds of one setup phase component "
+                  "of the last profiled setup {component}"),
+    "amgx_setup_compile_seconds":
+        ("gauge", "XLA backend-compile seconds attributed to the last "
+                  "profiled setup"),
+    "amgx_setup_trace_seconds":
+        ("gauge", "jaxpr-trace seconds attributed to the last profiled "
+                  "setup"),
+    "amgx_setup_transfer_seconds":
+        ("gauge", "blocking host<->device transfer seconds of the last "
+                  "profiled setup"),
+    "amgx_setup_mem_watermark_bytes":
+        ("gauge", "device-memory high-water mark sampled at phase "
+                  "boundaries of the last profiled setup"),
+    "amgx_setup_transfer_bytes_total":
+        ("counter", "host<->device bytes moved by instrumented setup "
+                    "transfers {kind=upload|download}"),
+    "amgx_setup_transfers_total":
+        ("counter", "blocking transfer calls instrumented during setup "
+                    "{kind=upload|download}"),
     "amgx_setup_seconds":
         ("histogram", "solver setup wall seconds"),
     "amgx_resetup_seconds":

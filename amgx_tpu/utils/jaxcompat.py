@@ -51,6 +51,16 @@ def install_compile_counter() -> bool:
                 metrics.counter_inc("amgx_jit_compile_total")
                 metrics.hist_observe("amgx_jit_compile_seconds",
                                      float(duration))
+            else:
+                return
+            # setup attribution (telemetry/setup_profile.py): the
+            # duration lands on the innermost open setup phase of the
+            # firing thread — compiles run synchronously on the thread
+            # that triggered them, so this answers "which setup phase
+            # paid that compile" exactly
+            from ..telemetry import setup_profile
+            setup_profile.note_duration(event == _COMPILE_EVENT,
+                                        float(duration))
         except Exception:   # a metrics bug must never break compilation
             pass
 

@@ -199,6 +199,13 @@ def _tel_case_summary(tel):
                                     if isinstance(fa["asymptotic_rate"],
                                                   float) else None),
             }
+    # setup-attribution block (AMGX_BENCH_SETUP_PROFILE=1): totals,
+    # compile share and the top phases — the columns bench_trend.py and
+    # the perf-gate triage read
+    sprof = None
+    if tel.events("setup_profile") or tel.events("setup_phase"):
+        from amgx_tpu.telemetry import setup_profile as _sp
+        sprof = _sp.summarize(_sp.analyze(tel.records))
     return {
         "packs": {str(k): int(v) for k, v in sorted(
             tel.counter_totals("amgx_spmv_dispatch_total",
@@ -210,6 +217,7 @@ def _tel_case_summary(tel):
         **({"operator_cost": cost} if cost else {}),
         **({"halo": halo} if halo else {}),
         **({"forensics": fore} if fore else {}),
+        **({"setup_profile": sprof} if sprof else {}),
     }
 
 
@@ -379,6 +387,12 @@ def main():
     # telemetry-off parity mode; use for convergence investigations)
     fore_knob = ", forensics=1" \
         if os.environ.get("AMGX_BENCH_FORENSICS") == "1" else ""
+    # AMGX_BENCH_SETUP_PROFILE=1: setup attribution
+    # (telemetry/setup_profile.py) — per-phase compile/transfer/memory
+    # splits embedded in every case's telemetry block, so BENCH rounds
+    # carry WHERE setup time went, not just how much there was
+    if os.environ.get("AMGX_BENCH_SETUP_PROFILE") == "1":
+        fore_knob += ", setup_profile=1"
 
     dtype = np.dtype(np.float32 if on_tpu else np.float64)
     # generated ON DEVICE (io/device_gen.py) — the reference's built-in

@@ -44,7 +44,43 @@ CASES = (
     ("resetup_s", _x(("extras", "classical_device_resetup48",
                       "resetup_warm_s"))),
     ("serve_p50_ms", _x(("extras", "serving", "p50_ms"))),
+    # setup attribution (AMGX_BENCH_SETUP_PROFILE=1 rounds): compile
+    # share of the classical-64³ setup — the number whose silent growth
+    # WAS the r02→r04 regression.  Older rounds lack the block and
+    # render "-"
+    ("cla64_comp%", lambda d: _pct(_x(
+        ("extras", "pcg_classical64", "telemetry", "setup_profile",
+         "compile_share"))(d))),
 )
+
+
+def _pct(v):
+    return round(v * 100.0, 1) if isinstance(v, (int, float)) else None
+
+
+#: cases whose setup-profile top phases are worth a per-round
+#: annotation line: (row label, path to the case's telemetry block)
+SETUP_DETAIL = (
+    ("headline", ("extras", "telemetry", "setup_profile")),
+    ("cla64", ("extras", "pcg_classical64", "telemetry",
+               "setup_profile")),
+    ("cla128", ("extras", "pcg_classical128", "telemetry",
+                "setup_profile")),
+)
+
+
+def _setup_detail(parsed: dict):
+    """{label: {"top": [...], "compile_share": x}} for the cases whose
+    bench telemetry carries the setup-profile block; {} on old rounds."""
+    out = {}
+    for label, path in SETUP_DETAIL:
+        cur = parsed
+        for k in path:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, dict) and cur.get("top"):
+            out[label] = {"top": cur["top"][:2],
+                          "compile_share": cur.get("compile_share")}
+    return out
 
 
 def _extract_parsed(rec: dict):
@@ -108,7 +144,8 @@ def load_rounds(repo_dir: str):
         out.append({"round": rnd, "usable": True,
                     "metric": parsed.get("metric"),
                     "values": {label: fn(parsed)
-                               for label, fn in CASES}})
+                               for label, fn in CASES},
+                    "setup_profile": _setup_detail(parsed)})
     return out
 
 
@@ -129,6 +166,17 @@ def render(rounds) -> str:
             cells.append((f"{v:.4g}" if isinstance(v, (int, float))
                           else "-").rjust(widths[label]))
         L.append(f"r{r['round']:<6} " + "  ".join(cells))
+        # setup-attribution annotation (rounds run with
+        # AMGX_BENCH_SETUP_PROFILE=1): top phases + compile share per
+        # profiled case; older rounds simply have no line
+        for label, sp in sorted((r.get("setup_profile") or {}).items()):
+            tops = " · ".join(
+                f"{t['name']} {t['share']:.0%}" for t in sp["top"]
+                if isinstance(t.get("share"), (int, float)))
+            cs = sp.get("compile_share")
+            L.append(f"        setup[{label}]: {tops}"
+                     + (f" · compile {cs:.0%}"
+                        if isinstance(cs, (int, float)) else ""))
     usable = [r for r in rounds if r["usable"]]
     L.append("")
     L.append(f"{len(usable)}/{len(rounds)} rounds usable")

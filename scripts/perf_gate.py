@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Bench perf regression gate: fail loudly when a round regresses.
+
+The r02→r04 classical-setup regression (10.9 s → 19.2 s) sat in the
+BENCH records unnoticed until a human diffed them.  This gate makes the
+trajectory self-policing: it compares the newest usable ``BENCH_r*.json``
+round against the committed per-case baseline (``PERF_BASELINE.json``)
+on the numbers that matter — setup seconds, solve seconds, iteration
+counts — and exits non-zero on any regression past the thresholds, so a
+CI step (or the bench driver itself) can block the round instead of
+archiving it.
+
+Usage:
+    python scripts/perf_gate.py [round.json] [--baseline PATH]
+        [--time-ratio R] [--iters-ratio R] [--strict] [--json]
+    python scripts/perf_gate.py --update [round.json]
+
+* default round: the newest usable ``BENCH_r*.json`` in the repo root;
+* ``--time-ratio`` (default 1.4): a time metric regresses when
+  ``new > baseline * R`` — the tunnel adds one-sided noise, so the
+  threshold is deliberately loose; tighten per-case in the baseline
+  file via ``"thresholds": {"time_ratio": ...}``;
+* ``--iters-ratio`` (default 1.3): iteration counts regress faster than
+  they drift — a growing count is a convergence bug, not noise;
+* ``--strict``: a case present in the baseline but missing from the
+  round fails the gate (default: warns — a flaky extra case must not
+  mask the headline);
+* ``--update``: rewrite the baseline from the round (the
+  baseline-update workflow: run it after a verified improvement and
+  commit the result, one line in CHANGES.md saying why).
+
+Exit codes: 0 pass, 1 regression (or unusable round), 2 usage error.
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_TIME_RATIO = 1.4
+DEFAULT_ITERS_RATIO = 1.3
+#: absolute floor below which a time metric never regresses (tunnel
+#: latency noise dominates sub-second measurements)
+TIME_FLOOR_S = 0.25
+
+#: per-case metrics the gate tracks: (key in the case dict, kind)
+TRACKED = (("setup_s", "time"), ("solve_s", "time"),
+           ("iterations", "iters"))
+
+
+def _extract_parsed(rec: dict):
+    """The bench JSON of one driver record (same contract as
+    scripts/bench_trend.py): ``parsed`` when the driver parsed it, else
+    the last JSON-looking line of the recorded tail."""
+    pv = rec.get("parsed")
+    if isinstance(pv, dict) and ("metric" in pv or "error_kind" in pv):
+        return pv
+    for line in reversed(str(rec.get("tail", "")).splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and ("metric" in cand
+                                           or "error_kind" in cand):
+                return cand
+    return None
+
+
+def _round_key(path: str):
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else 1 << 30, path)
+
+
+def newest_round(repo_dir: str):
+    """Path of the newest USABLE bench round (rc==0 and parseable), or
+    None."""
+    for path in sorted(glob.glob(os.path.join(repo_dir,
+                                              "BENCH_r*.json")),
+                       key=_round_key, reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("rc") not in (0, None):
+            continue
+        parsed = _extract_parsed(rec)
+        if parsed is not None and parsed.get("metric"):
+            return path
+    return None
+
+
+def load_round(path: str) -> dict:
+    """Per-case tracked metrics of one bench round:
+    ``{case: {setup_s, solve_s, iterations}}`` (cases whose run failed
+    — an ``error`` key — are omitted).  The headline case is named
+    ``headline``; raises ValueError on an unusable round."""
+    with open(path) as f:
+        rec = json.load(f)
+    parsed = rec if "metric" in rec else _extract_parsed(rec)
+    if parsed is None or parsed.get("metric") is None:
+        raise ValueError(
+            f"{path}: unusable round (rc={rec.get('rc')}, "
+            f"error_kind={ (parsed or {}).get('error_kind') })")
+    extras = parsed.get("extras") or {}
+    cases = {"headline": {"setup_s": extras.get("setup_s"),
+                          "solve_s": parsed.get("value"),
+                          "iterations": extras.get("iterations")}}
+    for name, d in extras.items():
+        # telemetry/serving are per-round observability blocks, not
+        # solve cases — their numeric fields must not become baselines
+        if not isinstance(d, dict) or "error" in d or \
+                name in ("telemetry", "serving",
+                         "spmv_gflops_by_format"):
+            continue
+        vals = {k: d.get(k) for k, _ in TRACKED
+                if isinstance(d.get(k), (int, float))}
+        if vals:
+            cases[name] = vals
+    return cases
+
+
+def compare(baseline: dict, cases: dict, time_ratio=None,
+            iters_ratio=None, strict=False) -> dict:
+    """Gate one round against the baseline.  Returns
+    ``{"ok": bool, "regressions": [...], "missing": [...],
+    "checked": n, "improved": [...]}``.  Thresholds resolve in order:
+    explicit argument > baseline file ``thresholds`` > defaults."""
+    th = baseline.get("thresholds", {})
+    t_ratio = time_ratio if time_ratio is not None else \
+        float(th.get("time_ratio", DEFAULT_TIME_RATIO))
+    i_ratio = iters_ratio if iters_ratio is not None else \
+        float(th.get("iters_ratio", DEFAULT_ITERS_RATIO))
+    regressions, improved, missing = [], [], []
+    checked = 0
+    for case, base_vals in sorted(baseline.get("cases", {}).items()):
+        cur = cases.get(case)
+        if cur is None:
+            missing.append(case)
+            continue
+        for key, kind in TRACKED:
+            b = base_vals.get(key)
+            v = cur.get(key)
+            if not isinstance(b, (int, float)) or \
+                    not isinstance(v, (int, float)):
+                continue
+            checked += 1
+            ratio = t_ratio if kind == "time" else i_ratio
+            limit = b * ratio
+            if kind == "time" and limit < TIME_FLOOR_S:
+                limit = TIME_FLOOR_S
+            if v > limit:
+                regressions.append({
+                    "case": case, "metric": key, "baseline": b,
+                    "value": v, "ratio": round(v / b, 3)
+                    if b else None, "limit": round(limit, 4)})
+            elif kind == "time" and b > TIME_FLOOR_S and v < b / ratio:
+                improved.append({"case": case, "metric": key,
+                                 "baseline": b, "value": v})
+    ok = not regressions and not (strict and missing)
+    return {"ok": ok, "regressions": regressions, "missing": missing,
+            "improved": improved, "checked": checked,
+            "time_ratio": t_ratio, "iters_ratio": i_ratio}
+
+
+def make_baseline(cases: dict, source: str) -> dict:
+    return {"source": os.path.basename(source),
+            "thresholds": {"time_ratio": DEFAULT_TIME_RATIO,
+                           "iters_ratio": DEFAULT_ITERS_RATIO},
+            "cases": cases}
+
+
+def render(result: dict, baseline_path: str, round_path: str) -> str:
+    L = [f"perf gate: {round_path} vs {baseline_path}"]
+    L.append(f"  checked {result['checked']} metrics "
+             f"(time x{result['time_ratio']}, "
+             f"iters x{result['iters_ratio']})")
+    for r in result["regressions"]:
+        L.append(f"  REGRESSION {r['case']}.{r['metric']}: "
+                 f"{r['baseline']} -> {r['value']} "
+                 f"({r['ratio']}x, limit {r['limit']})")
+    for m in result["missing"]:
+        L.append(f"  missing case: {m} (baseline has it, round lacks it)")
+    for i in result["improved"]:
+        L.append(f"  improved {i['case']}.{i['metric']}: "
+                 f"{i['baseline']} -> {i['value']} — consider "
+                 "--update after verifying")
+    L.append("  PASS" if result["ok"] else "  FAIL")
+    return "\n".join(L)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    as_json = "--json" in argv
+    strict = "--strict" in argv
+    update = "--update" in argv
+    argv = [a for a in argv if a not in ("--json", "--strict",
+                                         "--update")]
+
+    def opt(name, cast):
+        if name in argv:
+            i = argv.index(name)
+            try:
+                val = cast(argv[i + 1])
+            except (IndexError, ValueError):
+                print(f"perf_gate: {name} needs a {cast.__name__} "
+                      "operand", file=sys.stderr)
+                raise SystemExit(2)
+            del argv[i:i + 2]
+            return val
+        return None
+
+    baseline_path = opt("--baseline", str) or \
+        os.path.join(repo, "PERF_BASELINE.json")
+    time_ratio = opt("--time-ratio", float)
+    iters_ratio = opt("--iters-ratio", float)
+    round_path = argv[0] if argv else newest_round(repo)
+    if round_path is None:
+        print(f"perf_gate: no usable BENCH_r*.json under {repo}",
+              file=sys.stderr)
+        return 1
+    try:
+        cases = load_round(round_path)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: {e}", file=sys.stderr)
+        return 1
+    if update:
+        new_baseline = make_baseline(cases, round_path)
+        try:
+            # an operator-tuned thresholds block survives the update —
+            # --update refreshes the NUMBERS, not the policy
+            with open(baseline_path) as f:
+                prev = json.load(f)
+            if isinstance(prev.get("thresholds"), dict):
+                new_baseline["thresholds"] = prev["thresholds"]
+        except (OSError, ValueError):
+            pass
+        with open(baseline_path, "w") as f:
+            json.dump(new_baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"perf_gate: baseline updated from {round_path} -> "
+              f"{baseline_path} (commit it, and note why in CHANGES.md)")
+        return 0
+    try:
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read baseline: {e}", file=sys.stderr)
+        return 1
+    result = compare(baseline, cases, time_ratio, iters_ratio, strict)
+    if as_json:
+        print(json.dumps(dict(result, round=round_path,
+                              baseline=baseline_path), indent=2))
+    else:
+        print(render(result, baseline_path, round_path))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,18 +1,46 @@
 #!/usr/bin/env python
-"""Profile the classical bench case in isolation (setup/solve split)."""
+"""Profile the classical bench case through the setup profiler.
+
+Same code path as ``setup_profile=1`` everywhere else (no ad-hoc
+prints): the solver config enables the setup profiler + JSONL
+telemetry, the run writes one trace file, and the report printed here
+IS the doctor's — ``python -m amgx_tpu.telemetry.doctor <trace>`` on
+the same file reproduces it, and the trace feeds ``--diff`` A/B
+comparisons across rounds.
+
+Usage: scripts/profile_cla.py [n_side] [--trace out.jsonl]
+       (default n_side 128; default trace ./profile_cla_<n>.jsonl)
+"""
 import os
 import sys
 import time
 
-os.environ.setdefault("AMGX_BENCH_PROFILE", "1")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import numpy as np
 
 import amgx_tpu as amgx
 from amgx_tpu.io import poisson7pt
+from amgx_tpu.telemetry import doctor
 
-n_side = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+argv = list(sys.argv[1:])
+trace = None
+if "--trace" in argv:
+    i = argv.index("--trace")
+    try:
+        trace = argv[i + 1]
+    except IndexError:
+        print("profile_cla: --trace requires a path", file=sys.stderr)
+        sys.exit(2)
+    del argv[i:i + 2]
+n_side = int(argv[0]) if argv else 128
+if trace is None:
+    trace = f"profile_cla_{n_side}.jsonl"
+if os.path.exists(trace):
+    os.unlink(trace)      # the solver appends; start a fresh session
 
+# the bench classical config (bench.py CFG_CLA) + the profiler knobs
 CFG_CLA = (
     "config_version=2, solver(out)=PCG, out:max_iters=100, "
     "out:monitor_residual=1, out:tolerance=1e-8, "
@@ -23,44 +51,27 @@ CFG_CLA = (
     "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
     "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
     "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER, "
-    "amg:print_grid_stats=1")
+    f"setup_profile=1, out:telemetry=1, out:telemetry_path={trace}")
 
 A = poisson7pt(n_side, n_side, n_side)
 m = amgx.Matrix(A)
 m.device_dtype = np.float32
-cfg = amgx.AMGConfig(CFG_CLA)
-slv = amgx.create_solver(cfg)
-
-t0 = time.perf_counter()
-md = m.device()
-print(f"[prof] pack+upload fine: {time.perf_counter()-t0:.2f}s",
-      flush=True)
+slv = amgx.create_solver(amgx.AMGConfig(CFG_CLA))
 
 t0 = time.perf_counter()
 slv.setup(m)
-t_host = time.perf_counter() - t0
-hier = slv.preconditioner.hierarchy
-import jax
-jax.device_get(hier.levels[-1].Ad.diag)
-t_all = time.perf_counter() - t0
-print(f"[prof] setup host {t_host:.2f}s + drain "
-      f"{t_all - t_host:.2f}s = {t_all:.2f}s", flush=True)
-
-from amgx_tpu.utils.profiler import profiler_tree
-print(profiler_tree().report(), flush=True)
-profiler_tree().reset()
+print(f"[prof] setup {time.perf_counter() - t0:.2f}s", flush=True)
 
 import jax.numpy as jnp
+
 b = jnp.ones(A.shape[0], jnp.float32)
-res = slv.solve(b)                      # warm
+res = slv.solve(b)                      # warm/compile
 t0 = time.perf_counter()
 res = slv.solve(b)
-print(f"[prof] solve {time.perf_counter()-t0:.2f}s "
+print(f"[prof] solve {time.perf_counter() - t0:.2f}s "
       f"iters={res.iterations}", flush=True)
 
-# per-level info
-for i, lvl in enumerate(hier.levels):
-    Ad = lvl.Ad
-    nn = lvl.A.shape[0]
-    print(f"[prof] level {i}: n={nn} fmt={Ad.fmt} "
-          f"nnz={getattr(lvl.A, 'nnz', '?')}", flush=True)
+# the doctor report (setup attribution + phases + hints) from the trace
+# this run just wrote — the one code path both tools share
+print(doctor.render(doctor.diagnose([trace])), flush=True)
+print(f"[prof] trace: {trace}  (doctor/--diff ready)", flush=True)

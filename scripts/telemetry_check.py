@@ -216,13 +216,70 @@ def main():
     if rc_diff != 0 or "convergence diff" not in diff_out.getvalue():
         fail("doctor --diff CLI failed")
 
+    # 10. setup profiler (setup_profile=1): the trace carries
+    # schema-valid setup_phase/setup_profile events, the attribution
+    # covers most of the setup wall, and the doctor "setup" section
+    # renders with the execute/compile/transfer/host split
+    telemetry.reset()
+    telemetry.disable()
+    telemetry.setup_profile.disable()
+    path_s = path + ".setup_profile"
+    if os.path.exists(path_s):
+        os.unlink(path_s)
+    cfg_s = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+        "amg:selector=PMIS, amg:interpolator=D1, amg:max_iters=1, "
+        "amg:max_levels=10, amg:smoother(sm)=JACOBI_L1, "
+        "sm:max_iters=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, setup_profile=1, "
+        f"out:telemetry=1, out:telemetry_path={path_s}")
+    slv_s = amgx.create_solver(cfg_s)
+    slv_s.setup(amgx.Matrix(A))
+    slv_s.solve(np.ones(A.shape[0]))
+    with open(path_s) as f:
+        lines_s = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_s)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"setup-profile trace: {e}")
+    recs_s = [json.loads(l) for l in lines_s if l.strip()]
+    ev_s = {r["name"] for r in recs_s if r["kind"] == "event"}
+    for name in ("setup_phase", "setup_profile"):
+        if name not in ev_s:
+            fail(f"setup-profile trace is missing {name!r} events")
+    comps = {r["attrs"]["component"] for r in recs_s
+             if r["kind"] == "event" and r["name"] == "setup_phase"}
+    for comp in ("rap", "upload", "smoother_setup", "coarse_solver"):
+        if comp not in comps:
+            fail(f"setup-profile trace is missing the {comp!r} phase "
+                 f"(saw: {sorted(comps)})")
+    diag_s = doctor.diagnose([path_s])
+    setup = diag_s.get("setup")
+    if not setup or not setup.get("phases"):
+        fail("doctor setup section is empty for a setup_profile trace")
+    cov = (setup.get("summary") or {}).get("coverage")
+    if not isinstance(cov, (int, float)) or cov < 0.5:
+        fail(f"setup attribution coverage too low: {cov}")
+    report_s = doctor.render(diag_s)
+    if "setup attribution" not in report_s:
+        fail("doctor report is missing the setup attribution section")
+    for word in ("compile", "transfer", "execute", "host"):
+        if word not in report_s:
+            fail(f"setup attribution split is missing {word!r}")
+    telemetry.setup_profile.disable()
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
-          f"{n_ev} chrome-trace events, doctor OK, forensics OK)")
+          f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
+          f"setup-profile OK, coverage {cov:.0%})")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
+        os.unlink(path_s)
 
 
 if __name__ == "__main__":

@@ -44,6 +44,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "forensics: convergence-forensics fast tests "
                    "(tier-1; pytest -m forensics selects just these)")
+    config.addinivalue_line(
+        "markers", "setup_profile: setup-profiler fast tests "
+                   "(tier-1; pytest -m setup_profile selects just "
+                   "these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

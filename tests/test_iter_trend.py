@@ -1,0 +1,89 @@
+"""Iteration-count trend enforcement (ROADMAP item 2, tier-1 sizes).
+
+Grid-independent convergence is the multigrid promise: PCG+AMG
+iteration counts must stay flat as the Poisson problem grows.  The
+aggregation path (the bench headline configuration: GEO selector,
+CG-cycle) currently IS flat at 16³ → 32³ → 48³ and this test pins that
+down; the classical path (PMIS/D1) currently grows with size — the
+same regression BENCH_r04 shows at scale (21 iters at 64³ → 39 at
+128³) — so its variant is ``xfail``: the gap stays visible in every
+run without failing the tier, and fixing it flips the test to XPASS.
+
+Band: counts within ``TREND_RATIO`` of the smallest size's count (and
+never above the absolute ceiling) — a uniform convergence regression
+that stays "flat" still trips the ceiling.
+"""
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu.io import poisson7pt
+
+#: max allowed iters(largest) / iters(smallest) — "flat within ±30%"
+TREND_RATIO = 1.3
+#: absolute slack on top of the ratio (tiny counts quantise coarsely)
+TREND_SLACK = 2
+
+_COMMON = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
+    "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER, ")
+
+#: bench-headline aggregation stack (GEO structured coarsening,
+#: CG-cycle) — currently 11/12/12 iterations at the tier-1 sizes
+CFG_AGG = _COMMON + (
+    "amg:algorithm=AGGREGATION, amg:selector=GEO, amg:cycle=CG, "
+    "amg:cycle_iters=2, amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1")
+
+#: classical PMIS/D1 stack — currently ~10/15/18: grows with size
+CFG_CLA = _COMMON + (
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, amg:interpolator=D1, "
+    "amg:max_row_sum=0.9, amg:max_levels=16, "
+    "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1")
+
+
+def _iters_trend(cfg_str, sizes):
+    counts = []
+    for ns in sizes:
+        A = poisson7pt(ns, ns, ns)
+        slv = amgx.create_solver(amgx.AMGConfig(cfg_str))
+        slv.setup(amgx.Matrix(A))
+        res = slv.solve(np.ones(A.shape[0]))
+        assert int(res.status) == 0, \
+            f"{ns}^3 solve did not converge (status {res.status})"
+        counts.append(int(res.iterations))
+    return counts
+
+
+def _assert_flat(counts, sizes, ceiling):
+    lo = max(min(counts), 1)
+    hi = max(counts)
+    assert hi <= lo * TREND_RATIO + TREND_SLACK, (
+        f"iteration counts grow with size: "
+        f"{dict(zip(sizes, counts))} — grid-dependent convergence "
+        "(ROADMAP item 2)")
+    # a uniformly-worse hierarchy is flat too; the ceiling catches it
+    assert hi <= ceiling, (
+        f"iteration counts regressed above the ceiling {ceiling}: "
+        f"{dict(zip(sizes, counts))}")
+
+
+def test_aggregation_iterations_flat_across_sizes():
+    sizes = (16, 32, 48)
+    counts = _iters_trend(CFG_AGG, sizes)
+    # current trend: 11/12/12; the ceiling leaves ~50% headroom
+    _assert_flat(counts, sizes, ceiling=18)
+
+
+@pytest.mark.xfail(
+    reason="classical PMIS/D1 iteration counts grow with problem size "
+           "(10 -> 15 -> 18 at these sizes; 21@64^3 -> 39@128^3 in "
+           "BENCH_r04) — ROADMAP item 2; flip to a plain test when "
+           "the hierarchy is fixed",
+    strict=False)
+def test_classical_iterations_flat_across_sizes():
+    sizes = (8, 16, 24)
+    counts = _iters_trend(CFG_CLA, sizes)
+    _assert_flat(counts, sizes, ceiling=16)
