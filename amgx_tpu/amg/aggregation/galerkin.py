@@ -6,8 +6,14 @@ Reference: ``core/src/aggregation/coarseAgenerators/`` — LOW_DEG
 
 With unsmoothed aggregation R = Sᵀ and P = S for the 0/1 selector matrix S,
 so RAP collapses to a segment-sum over (agg[row], agg[col]) block pairs —
-no general SpGEMM needed.  Host numpy (sort-based, like THRUST's
-generator); runs once per setup.
+no general SpGEMM needed.
+
+These host generators (sort-based, like THRUST's) are the FALLBACK and
+the A/B reference: the hot path runs the same segment semantics on
+device through the pattern-keyed setup engine
+(:meth:`amgx_tpu.amg.device_setup.DeviceSetupEngine.galerkin_agg` —
+``AMGHierarchy._galerkin_agg`` routes there and lands here when a gate
+declines).
 """
 from __future__ import annotations
 

@@ -43,6 +43,10 @@ from __future__ import annotations
 import functools
 from typing import NamedTuple
 
+# the sort-algebra SpGEMM primitives (expand/dedup, ELL·ELL product,
+# flat transpose) are shared engine parts now — ops/spgemm.py is their
+# single home; this module composes them into the classical coarsening
+from ...ops.spgemm import dedup_rows, ell_spgemm_fn, ell_transpose_fn
 from .device_pipeline import bucket, width_bucket
 
 
@@ -50,60 +54,6 @@ from .device_pipeline import bucket, width_bucket
 def _rowwise(x):
     import jax.numpy as jnp
     return jnp.arange(x.shape[0], dtype=jnp.int32)[:, None]
-
-
-def _seg_sum_scan(vals, new):
-    """Segmented inclusive sum along the LAST axis: runs delimited by
-    ``new`` flags; at a run's last position this is the run total."""
-    import jax
-    import jax.numpy as jnp
-
-    def op(a, b):
-        va, fa = a
-        vb, fb = b
-        return jnp.where(fb, vb, va + vb), fa | fb
-
-    out, _ = jax.lax.associative_scan(op, (vals, new), axis=-1)
-    return out
-
-
-def dedup_rows(cols, val_list, out_width: int):
-    """Per-row (col → Σ vals) dedup of an expanded product block.
-
-    ``cols`` (n, W) int32 with dead entries = -1; ``val_list`` is a list
-    of (n, W) arrays, each summed over duplicate columns.  Returns
-    (cols (n, K), [vals (n, K)...], live (n, K)) with columns ascending
-    and dead entries (-1, 0) packed to the right."""
-    import jax
-    import jax.numpy as jnp
-
-    n, W = cols.shape
-    order = jnp.argsort(cols, axis=1)            # dead (-1) sort first
-    sc = jnp.take_along_axis(cols, order, axis=1)
-    new = jnp.ones((n, W), dtype=bool)
-    new = new.at[:, 1:].set(sc[:, 1:] != sc[:, :-1])
-    runs = [_seg_sum_scan(jnp.take_along_axis(v, order, axis=1), new)
-            for v in val_list]
-    last = jnp.ones((n, W), dtype=bool)
-    last = last.at[:, :-1].set(new[:, 1:])
-    live = last & (sc >= 0)
-    # keep ≤out_width live entries in ascending-column (== ascending
-    # position) order: key = live·BIG − position
-    pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (n, W))
-    kkey = jnp.where(live, jnp.int32(4 * W), jnp.int32(0)) - pos
-    k = min(out_width, W)
-    _, topi = jax.lax.top_k(kkey, k)
-    oc = jnp.take_along_axis(sc, topi, axis=1)
-    ovs = [jnp.take_along_axis(r, topi, axis=1) for r in runs]
-    ol = jnp.take_along_axis(live, topi, axis=1)
-    if out_width > k:
-        pad = out_width - k
-        oc = jnp.pad(oc, ((0, 0), (0, pad)), constant_values=-1)
-        ovs = [jnp.pad(v, ((0, 0), (0, pad))) for v in ovs]
-        ol = jnp.pad(ol, ((0, 0), (0, pad)))
-    oc = jnp.where(ol, oc, -1)
-    ovs = [jnp.where(ol, v, 0.0) for v in ovs]
-    return oc, ovs, ol
 
 
 # ------------------------------------------------------ strength + PMIS
@@ -384,99 +334,11 @@ def _interp_fn(nb: int, K: int, Kc: int, Kfs: int, Kp: int,
 
 
 # --------------------------------------------------------------- RAP
-@functools.lru_cache(maxsize=128)
-def _transpose_fn(nb: int, Kpx: int, ncb: int, Kr: int):
-    """jit: (P_cols (nb, Kpx) coarse-local, P_vals) →
-    (R_cols (ncb, Kr) i32 = fine-source ids, R_vals, maxdeg i32).
-
-    Transpose via ONE flat argsort of (col, row) keys + rank-in-run via
-    segmented scan; a single scatter builds the (ncb, Kr) table."""
-    import jax
-    import jax.numpy as jnp
-
-    def run(pc, pv):
-        n = pc.shape[0]
-        rows = jnp.broadcast_to(
-            jnp.arange(n, dtype=jnp.int64)[:, None], pc.shape
-        ).reshape(-1)
-        cols = pc.reshape(-1).astype(jnp.int64)
-        vals = pv.reshape(-1)
-        live = (vals != 0) & (cols >= 0)
-        key = jnp.where(live, cols * n + rows,
-                        jnp.int64(ncb) * n + rows)
-        order = jnp.argsort(key)
-        sk = key[order]
-        sv = jnp.where(live, vals, 0.0)[order]
-        scol = (sk // n).astype(jnp.int32)
-        srow = (sk % n).astype(jnp.int32)
-        new = jnp.ones(sk.shape, dtype=bool).at[1:].set(
-            scol[1:] != scol[:-1])
-        rank = (_seg_sum_scan(jnp.ones_like(sv), new) - 1.0
-                ).astype(jnp.int32)
-        ok = (scol < ncb) & (rank < Kr)
-        flat = jnp.where(ok, scol * Kr + rank, 0)
-        rv = jnp.zeros((ncb * Kr,), sv.dtype).at[flat].add(
-            jnp.where(ok, sv, 0.0))
-        rc = jnp.full((ncb * Kr,), -1, jnp.int32).at[flat].max(
-            jnp.where(ok, srow, -1))
-        maxdeg = jnp.max(jnp.where(scol < ncb, rank, -1)) + 1
-        return rc.reshape(ncb, Kr), rv.reshape(ncb, Kr), maxdeg
-
-    return jax.jit(run)
-
-
-@functools.lru_cache(maxsize=128)
-def _ap_fn(nb: int, K: int, Kpx: int, Kap: int):
-    """jit: (A_cols, A_vals, P_cols, P_vals) → AP ELL (nb, Kap) (cols
-    -1-padded) + kmax.  Expand via row gathers of P rows, dedup via
-    sort+scan."""
-    import jax
-    import jax.numpy as jnp
-
-    def run(ac, av, pc, pv):
-        n = ac.shape[0]
-        live = av != 0
-        acc = jnp.where(live, ac, 0)
-        g_c = pc[acc]                         # (n, K, Kpx)
-        g_v = pv[acc]
-        keep = live[:, :, None] & (g_c >= 0) & (g_v != 0)
-        ec = jnp.where(keep, g_c, -1).reshape(n, K * Kpx)
-        ev = jnp.where(keep, av[:, :, None] * g_v,
-                       0.0).reshape(n, K * Kpx)
-        oc, (ov,), ol = dedup_rows(ec, [ev], Kap)
-        kmax = jnp.max(jnp.sum(ol.astype(jnp.int32), axis=1))
-        return oc, ov, kmax
-
-    return jax.jit(run)
-
-
-@functools.lru_cache(maxsize=128)
-def _rap_fn(ncb: int, Kr: int, Kap: int, Kc2: int):
-    """jit: (R_cols, R_vals, AP_cols, AP_vals) → coarse ELL
-    (ncb, Kc2) in standard conventions (self-pad entries, unit-diagonal
-    pad rows) + kmax."""
-    import jax
-    import jax.numpy as jnp
-
-    def run(rc, rv, apc, apv):
-        live = (rv != 0) & (rc >= 0)
-        rcc = jnp.where(live, rc, 0)
-        g_c = apc[rcc]                        # (ncb, Kr, Kap)
-        g_v = apv[rcc]
-        keep = live[:, :, None] & (g_c >= 0) & (g_v != 0)
-        ec = jnp.where(keep, g_c, -1).reshape(ncb, Kr * Kap)
-        ev = jnp.where(keep, rv[:, :, None] * g_v,
-                       0.0).reshape(ncb, Kr * Kap)
-        oc, (ov,), ol = dedup_rows(ec, [ev], Kc2)
-        kmax = jnp.max(jnp.sum(ol.astype(jnp.int32), axis=1))
-        rown = _rowwise(oc)
-        oc = jnp.where(ol, oc, rown)
-        empty = ~jnp.any(ol, axis=1)
-        first = jnp.arange(oc.shape[1]) == 0
-        ov = jnp.where(empty[:, None] & first, 1.0, ov)
-        return oc, ov, kmax
-
-    return jax.jit(run)
+# A·P and R·AP are the SAME ELL·ELL product (ops.spgemm.ell_spgemm_fn):
+# expand by row gather, dedup by sort+scan; only the epilogue differs —
+# the intermediate AP keeps -1-padded columns, the coarse operator gets
+# the standard conventions (self-pad entries, unit-diagonal pad rows)
+# via ``self_pad=True``.  The transpose is ops.spgemm.ell_transpose_fn.
 
 
 # ------------------------------------------------------------- driver
@@ -552,23 +414,24 @@ def coarsen_compact(cols, vals, n_logical: int, *, theta: float,
     ncb2 = bucket(nc, compact_step)
     Kr = width_bucket(max(8, 2 * Kpx))
     while True:
-        rc, rv, maxdeg = _transpose_fn(nb, Kpx, ncb2, Kr)(pfull_c,
-                                                          pfull_v)
+        rc, rv, maxdeg = ell_transpose_fn(nb, Kpx, ncb2, Kr)(pfull_c,
+                                                             pfull_v)
         maxdeg = int(jax.device_get(maxdeg))
         if maxdeg <= Kr:
             break
         Kr = width_bucket(maxdeg)
     Kap = width_bucket(min(K * Kpx, 4 * K))
     while True:
-        apc, apv, apk = _ap_fn(nb, K, Kpx, Kap)(cols, vals, pfull_c,
-                                                pfull_v)
+        apc, apv, apk = ell_spgemm_fn(nb, K, Kpx, Kap)(cols, vals,
+                                                       pfull_c, pfull_v)
         apk = int(jax.device_get(apk))
         if apk < Kap or Kap >= K * Kpx:
             break
         Kap = width_bucket(min(K * Kpx, 2 * Kap + 1))
     Kc2 = width_bucket(min(Kr * Kap, max(2 * K, 16)))
     while True:
-        acc, acv, ack = _rap_fn(ncb2, Kr, Kap, Kc2)(rc, rv, apc, apv)
+        acc, acv, ack = ell_spgemm_fn(ncb2, Kr, Kap, Kc2,
+                                      self_pad=True)(rc, rv, apc, apv)
         ack = int(jax.device_get(ack))
         if ack < Kc2 or Kc2 >= Kr * Kap:
             break

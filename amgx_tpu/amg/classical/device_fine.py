@@ -43,19 +43,10 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
-
-def _shift(x, d: int, fill=0):
-    """y[i] = x[i+d] with ``fill`` outside — the DIA neighbour read.
-    |d| ≥ n (tiny grids meeting a D2 pairwise-sum offset) is all-fill."""
-    import jax.numpy as jnp
-    if d == 0:
-        return x
-    n = x.shape[0]
-    if abs(d) >= n:
-        return jnp.full((n,), fill, x.dtype)
-    f = jnp.full((abs(d),), fill, x.dtype)
-    return jnp.concatenate([x[d:], f]) if d > 0 else \
-        jnp.concatenate([f, x[:d]])
+# the DIA neighbour read lives with the other SpGEMM/shift-algebra
+# primitives (ops/spgemm.py); kept under its historic local name — the
+# whole device classical pipeline reads through it
+from ...ops.spgemm import shift as _shift
 
 
 def ahat_plan(offs: Sequence[int]) -> Tuple[Tuple[int, ...], list]:

@@ -44,37 +44,15 @@ from typing import List, Sequence, Tuple
 
 import numpy as np
 
+# the static offset algebra and the embedded Galerkin kernel live with
+# the other SpGEMM primitives now (ops/spgemm.py); re-exported here for
+# the existing import sites (hierarchy.py pulls rap_candidate_offsets
+# from this module)
+from ...ops.spgemm import (compose_diff, compose_sum, dia_galerkin_fn,
+                           rap_candidate_offsets)
 from .device_fine import (_shift, ahat_plan, dia_ahat, dia_d1_weights,
                           dia_pmis, dia_strength, dia_truncate,
                           pmis_multiplier)
-
-
-# --------------------------------------------------------------- statics
-def compose_sum(a_offs: Sequence[int], b_offs: Sequence[int]):
-    """G = sorted {a+b} with, per g, the (a_idx, b_idx) pair list."""
-    pairs = {}
-    for ai, a in enumerate(a_offs):
-        for bi, b in enumerate(b_offs):
-            pairs.setdefault(int(a) + int(b), []).append((ai, bi))
-    G = tuple(sorted(pairs))
-    return G, [pairs[g] for g in G]
-
-
-def compose_diff(p_offs: Sequence[int], g_offs: Sequence[int]):
-    """Δ = sorted {g−o} with, per δ, the (p_idx, g_idx) pair list."""
-    pairs = {}
-    for pi, o in enumerate(p_offs):
-        for gi, g in enumerate(g_offs):
-            pairs.setdefault(int(g) - int(o), []).append((pi, gi))
-    D = tuple(sorted(pairs))
-    return D, [pairs[d] for d in D]
-
-
-def rap_candidate_offsets(a_offs: Sequence[int],
-                          p_offs: Sequence[int]) -> Tuple[int, ...]:
-    G, _ = compose_sum(a_offs, p_offs)
-    D, _ = compose_diff(p_offs, G)
-    return D
 
 
 # ------------------------------------------------------ fine-level program
@@ -130,46 +108,6 @@ def _fine_slots_fn(offs: Tuple[int, ...], n: int, theta: float,
         return cf, jnp.stack(rows)
 
     return jax.jit(run), hat_offs
-
-
-# --------------------------------------------------------------- RAP
-@functools.lru_cache(maxsize=32)
-def _rap_fn(a_offs: Tuple[int, ...], p_offs: Tuple[int, ...], n: int,
-            dtype_str: str):
-    """jit: (avals (nd, n), P_rows (np, n), cf) →
-    (Ac (nΔ, n), realized (nΔ,) bool, nc i32, kmax i32).
-
-    Candidate Δ is static from the offset lists; ``realized`` lets the
-    host prune all-zero diagonals before the solve pack."""
-    import jax
-    import jax.numpy as jnp
-
-    G, ap_pairs = compose_sum(a_offs, p_offs)
-    D, ac_pairs = compose_diff(p_offs, G)
-    dt = jnp.dtype(dtype_str)
-
-    def run(avals, P_rows, cf):
-        AP = []
-        for gi, g in enumerate(G):
-            acc = jnp.zeros(n, dtype=dt)
-            for (ai, pi) in ap_pairs[gi]:
-                acc = acc + avals[ai] * _shift(P_rows[pi],
-                                               int(a_offs[ai]))
-            AP.append(acc)
-        Ac = []
-        for di, d in enumerate(D):
-            acc = jnp.zeros(n, dtype=dt)
-            for (pi, gi) in ac_pairs[di]:
-                acc = acc + _shift(P_rows[pi] * AP[gi],
-                                   -int(p_offs[pi]))
-            Ac.append(acc)
-        Ac = jnp.stack(Ac)
-        realized = jnp.any(Ac != 0, axis=1)
-        nc = jnp.sum(cf.astype(jnp.int32))
-        kmax = jnp.max(jnp.sum((Ac != 0).astype(jnp.int32), axis=0))
-        return Ac, realized, nc, kmax
-
-    return jax.jit(run), D
 
 
 # ------------------------------------------------- embedded level arrays
@@ -309,7 +247,7 @@ def coarsen_fine_embedded(offs: Sequence[int], dvals, n: int, *,
         bool(interp_d2), float(trunc_factor), int(max_elements),
         dt.str, int(seed))
     cf, P_rows = fine_fn(dvals)
-    rap, delta = _rap_fn(offs, p_offs, n, dt.str)
+    rap, delta = dia_galerkin_fn(offs, p_offs, n, dt.str)
     Ac, realized, nc_d, kmax_d = rap(dvals, P_rows, cf)
     realized, nc, kmax = jax.device_get((realized, nc_d, kmax_d))
     nc, kmax = int(nc), int(kmax)

@@ -33,34 +33,10 @@ from typing import List, Optional
 import numpy as np
 import scipy.sparse as sp
 
-
-def _range_concat(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
-    """[starts[0]..+counts[0], starts[1]..+counts[1], ...] flattened."""
-    csum = np.concatenate([[0], np.cumsum(counts)])
-    return (np.arange(csum[-1], dtype=np.int64)
-            - np.repeat(csum[:-1], counts)
-            + np.repeat(starts.astype(np.int64), counts))
-
-
-def _spgemm_triples(Aptr, Aind, Bptr, Bind, n_rows: int, n_cols_B: int):
-    """Symbolic product C = A·B as a triple schedule: returns
-    (tA, tB, t_out, C_indptr, C_indices) with
-    ``C.data[t_out[q]] += A.data[tA[q]] * B.data[tB[q]]``."""
-    rowlenB = np.diff(Bptr)
-    cnt = rowlenB[Aind]
-    tA = np.repeat(np.arange(len(Aind), dtype=np.int64), cnt)
-    tB = _range_concat(Bptr[Aind], cnt)
-    i_of = np.repeat(
-        np.repeat(np.arange(n_rows, dtype=np.int64), np.diff(Aptr)), cnt)
-    j_of = Bind[tB].astype(np.int64)
-    key = i_of * n_cols_B + j_of
-    ukey, inv = np.unique(key, return_inverse=True)
-    C_rows = (ukey // n_cols_B).astype(np.int64)
-    C_indices = (ukey % n_cols_B).astype(np.int32)
-    C_indptr = np.concatenate(
-        [[0], np.cumsum(np.bincount(C_rows, minlength=n_rows))]
-    ).astype(np.int64)
-    return (tA, tB, inv.astype(np.int64), C_indptr, C_indices)
+# the symbolic triple-schedule builder is the shared SpGEMM engine's
+# (ops/spgemm.py) — one definition for this resetup path, the device
+# setup engine (amg/device_setup/), and the fresh-setup Galerkin
+from ...ops.spgemm import spgemm_symbolic as _spgemm_triples
 
 
 def _pack_value_maps(Ac: sp.csr_matrix, dtype):

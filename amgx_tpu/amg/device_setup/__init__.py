@@ -1,0 +1,25 @@
+"""Device-side AMG setup engine.
+
+Reference: AmgX performs the entire Galerkin product on the accelerator
+(``CSR_Multiply::csr_galerkin_product`` / ``csr_RAP_sparse_add``,
+PAPER.md layers L5/L9) with a symbolic phase that sizes the output once
+and a numeric phase that re-runs on new values.  This subsystem is the
+TPU equivalent for the HOST classical/aggregation setup paths: a
+pattern-keyed cache of reusable "setup executables" (the
+:class:`~amgx_tpu.ops.spgemm.GalerkinPlan` schedules) whose numeric
+pass runs entirely under ``jit`` with the hierarchy passed as jit
+ARGUMENTS — so the executable for a given (pattern fingerprint, level
+shape bucket) compiles once, and a ``resetup`` on new coefficients is a
+pure device numeric pass with zero recompiles.
+
+Fallback contract: every gate failure (tiny level, schedule budget,
+f64-on-TPU, unexpected error) returns None to the caller — the host
+scipy path stays the correctness net — and emits a
+``device_setup_fallback`` telemetry event carrying the reason, which
+the doctor surfaces per level.
+"""
+from .engine import (DeviceSetupEngine, engine, engine_stats,
+                     reset_engine)
+
+__all__ = ["DeviceSetupEngine", "engine", "engine_stats",
+           "reset_engine"]

@@ -1,0 +1,398 @@
+"""Pattern-keyed device setup engine: cached Galerkin executables.
+
+The engine owns a process-wide LRU of symbolic setup plans keyed by
+(A pattern fingerprint, P pattern fingerprint, dtype).  A cache hit
+skips the host symbolic pass entirely — a structure-reusing resetup
+(or a serve-layer session refreshing coefficients) re-runs ONLY the
+jitted numeric contraction, whose operands are all jit arguments, so
+nothing retraces or recompiles.
+
+Telemetry (one attribute check when disabled, like the rest of
+:mod:`amgx_tpu.telemetry`):
+
+* ``spgemm`` setup phase (host kind) around a symbolic plan build,
+* ``device_rap`` setup phase (device kind) around the numeric pass,
+* ``device_setup_fallback`` events + ``amgx_device_setup_fallback_total``
+  counters with the gate reason when the host path takes over,
+* ``amgx_device_rap_total{path}`` / ``amgx_spgemm_total{op}`` counters
+  and plan-cache gauges.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+import scipy.sparse as sp
+
+from ... import telemetry
+from ...telemetry import setup_profile
+from ...core.matrix import csr_structure_fingerprint
+from ...ops import spgemm
+
+#: default schedule-byte budget of the plan cache (LRU evicts past it);
+#: overridable per call via ``budget_bytes`` (the ``device_setup_cache_mb``
+#: config knob)
+DEFAULT_BUDGET_BYTES = 256 << 20
+
+#: a single plan larger than this fraction of the budget is not worth
+#: caching-and-evicting-everything-else for — it falls back to host
+MAX_PLAN_FRACTION = 1.0
+
+
+def _canon(M) -> sp.csr_matrix:
+    """Canonical CSR view (sorted indices) — plan schedules and numeric
+    data order must agree.  Sorts IN PLACE when needed (idempotent; the
+    setup paths already hold canonical CSR everywhere)."""
+    M = M if isinstance(M, sp.csr_matrix) else sp.csr_matrix(M)
+    if not M.has_sorted_indices:
+        M.sort_indices()
+    return M
+
+
+class DeviceSetupEngine:
+    """LRU cache of :class:`~amgx_tpu.ops.spgemm.GalerkinPlan` /
+    aggregation schedules + the numeric-pass drivers around them."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES):
+        self.budget_bytes = int(budget_bytes)
+        self._plans: "OrderedDict[tuple, object]" = OrderedDict()
+        #: patterns whose plan exceeded the budget: the verdict is
+        #: cached so a resetup-heavy session doesn't rebuild (and
+        #: discard) the full symbolic schedule on every refresh
+        self._rejected: "OrderedDict[tuple, int]" = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0
+        self.numeric_runs = 0
+
+    def _set_budget(self, budget_bytes) -> None:
+        """Per-call budget requests only RATCHET the shared budget up:
+        the engine is process-wide, and a small-budget session must not
+        evict (or budget-reject) the plans a large-budget session's
+        zero-recompile resetups depend on."""
+        if budget_bytes is not None and \
+                int(budget_bytes) > self.budget_bytes:
+            with self._lock:
+                self.budget_bytes = int(budget_bytes)
+                # a raised budget can clear earlier too-big verdicts
+                self._rejected.clear()
+
+    def _budget_rejected(self, key) -> bool:
+        with self._lock:
+            if key in self._rejected:
+                self._rejected.move_to_end(key)
+                return True
+            return False
+
+    def _reject(self, key):
+        with self._lock:
+            self._rejected[key] = 1
+            while len(self._rejected) > 256:
+                self._rejected.popitem(last=False)
+
+    # ------------------------------------------------------------ cache
+    def _get(self, key):
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans.move_to_end(key)
+                self.hits += 1
+            return plan
+
+    def _put(self, key, plan):
+        with self._lock:
+            if key in self._plans:
+                return self._plans[key]
+            self.misses += 1
+            self._plans[key] = plan
+            self._bytes += plan.nbytes
+            while self._bytes > self.budget_bytes and len(self._plans) > 1:
+                _, old = self._plans.popitem(last=False)
+                self._bytes -= old.nbytes
+            if telemetry.is_enabled():
+                telemetry.gauge_set("amgx_spgemm_plan_cache",
+                                    len(self._plans))
+                telemetry.gauge_set("amgx_spgemm_plan_bytes",
+                                    self._bytes)
+            return plan
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"plans": len(self._plans),
+                    "plan_bytes": int(self._bytes),
+                    "hits": int(self.hits),
+                    "misses": int(self.misses),
+                    "fallbacks": int(self.fallbacks),
+                    "numeric_runs": int(self.numeric_runs)}
+
+    # -------------------------------------------------------- fallbacks
+    def _fallback(self, reason: str, level, component: str = "rap"):
+        """Record one host-path takeover; returns None (the caller's
+        fallback contract)."""
+        with self._lock:
+            self.fallbacks += 1
+        if telemetry.is_enabled():
+            telemetry.event("device_setup_fallback", component=component,
+                            level=level, reason=reason)
+            telemetry.counter_inc("amgx_device_setup_fallback_total",
+                                  reason=reason)
+            telemetry.counter_inc("amgx_device_rap_total", path="host")
+        return None
+
+    def _dtype_gate(self, dtype) -> Optional[str]:
+        """f64 has no native TPU lowering — the host path is faster
+        than an emulated contraction.  (CPU/interpret runs keep f64 so
+        the numeric pass is bit-comparable to scipy.)"""
+        import jax
+        if np.dtype(dtype).itemsize > 4 and \
+                jax.default_backend() == "tpu":
+            return "f64-on-tpu"
+        return None
+
+    # ------------------------------------------------------ Galerkin RAP
+    def galerkin_csr(self, A, P, *, dtype, level=None,
+                     keep_pattern: bool = False, min_rows: int = 0,
+                     budget_bytes: Optional[int] = None
+                     ) -> Optional[sp.csr_matrix]:
+        """Device Galerkin product ``Pᵀ·A·P`` for host-CSR operands.
+
+        Returns the coarse CSR (host, data device-computed) or None —
+        the caller then runs the scipy triple product.  The returned
+        pattern is the FULL symbolic one when ``keep_pattern`` (the
+        frozen-structure resetup contract, ex ``_symbolic_pad_galerkin``)
+        and zero-pruned otherwise (scipy parity)."""
+        self._set_budget(budget_bytes)
+        dtype = np.dtype(dtype)
+        try:
+            A = _canon(A)
+            P = _canon(P)
+        except Exception:
+            return self._fallback("non-csr", level)
+        if A.shape[0] < int(min_rows):
+            return self._fallback("small", level)
+        if A.nnz == 0 or P.nnz == 0:
+            return self._fallback("empty", level)
+        gate = self._dtype_gate(dtype)
+        if gate:
+            return self._fallback(gate, level)
+        key = ("rap", csr_structure_fingerprint(A),
+               csr_structure_fingerprint(P), dtype.str)
+        if self._budget_rejected(key):
+            return self._fallback("budget", level)
+        try:
+            plan = self._get(key)
+            if plan is None:
+                with setup_profile.phase("spgemm", level=level):
+                    plan = spgemm.build_galerkin_plan(A, P)
+                if plan.nbytes > self.budget_bytes * MAX_PLAN_FRACTION:
+                    self._reject(key)
+                    return self._fallback("budget", level)
+                plan = self._put(key, plan)
+            import jax.numpy as jnp
+            with setup_profile.phase("device_rap", level=level,
+                                     kind="device"):
+                vA = jnp.asarray(A.data, dtype=dtype)
+                vP = jnp.asarray(P.data, dtype=dtype)
+                vAc = spgemm.galerkin_numeric(plan, vA, vP)
+                data = np.asarray(vAc)[:plan.nnz_Ac]
+        except Exception as e:                  # pragma: no cover
+            return self._fallback(f"error:{type(e).__name__}", level)
+        with self._lock:
+            self.numeric_runs += 1
+        if telemetry.is_enabled():
+            telemetry.counter_inc("amgx_device_rap_total", path="device")
+            telemetry.counter_inc("amgx_spgemm_total", op="rap")
+        Ac = sp.csr_matrix(
+            (data.astype(dtype), plan.Ac_indices.copy(),
+             plan.Ac_indptr.copy()), shape=plan.Ac_shape)
+        if not keep_pattern:
+            # scipy's SpGEMM prunes exact-cancellation entries; match it
+            # so the device and host paths produce the same pattern
+            Ac.eliminate_zeros()
+        return Ac
+
+    # ------------------------------------------------ aggregation RAP
+    def galerkin_agg(self, A_host, agg: np.ndarray, block_dim: int = 1,
+                     *, dtype, level=None, min_rows: int = 0,
+                     budget_bytes: Optional[int] = None):
+        """Device Galerkin for unsmoothed aggregation (R = Sᵀ, P = S):
+        one segment-sum over (agg[row], agg[col]) pairs — scalar CSR or
+        block BSR.  Returns csr/bsr (host, data device-computed) or
+        None for the host generator."""
+        self._set_budget(budget_bytes)
+        dtype = np.dtype(dtype)
+        gate = self._dtype_gate(dtype)
+        if gate:
+            return self._fallback(gate, level, component="agg_rap")
+        try:
+            if block_dim == 1:
+                M = _canon(A_host)
+            else:
+                M = A_host if isinstance(A_host, sp.bsr_matrix) else \
+                    sp.bsr_matrix(A_host, blocksize=(block_dim,
+                                                     block_dim))
+                M.sort_indices()
+        except Exception:
+            return self._fallback("non-csr", level, component="agg_rap")
+        n = M.shape[0] // block_dim
+        if n < int(min_rows):
+            return self._fallback("small", level, component="agg_rap")
+        if M.nnz == 0 or len(agg) == 0:
+            return self._fallback("empty", level, component="agg_rap")
+        agg = np.asarray(agg)
+        nc = int(agg.max()) + 1
+        ah = hashlib.blake2b(np.ascontiguousarray(agg).tobytes(),
+                             digest_size=16).hexdigest()
+        key = ("agg", csr_structure_fingerprint(M), ah, block_dim,
+               dtype.str)
+        if self._budget_rejected(key):
+            return self._fallback("budget", level, component="agg_rap")
+        try:
+            plan = self._get(key)
+            if plan is None:
+                with setup_profile.phase("spgemm", level=level):
+                    plan = _build_agg_plan(M, agg, nc, block_dim)
+                if plan.nbytes > self.budget_bytes * MAX_PLAN_FRACTION:
+                    self._reject(key)
+                    return self._fallback("budget", level,
+                                          component="agg_rap")
+                plan = self._put(key, plan)
+            import jax.numpy as jnp
+            with setup_profile.phase("device_rap", level=level,
+                                     kind="device"):
+                if block_dim == 1:
+                    vals = jnp.asarray(M.data, dtype=dtype)
+                else:
+                    vals = jnp.asarray(
+                        M.data.reshape(len(M.indices), block_dim,
+                                       block_dim), dtype=dtype)
+                out = plan.numeric(vals)
+                data = np.asarray(out)[:plan.nnz_C]
+        except Exception as e:                  # pragma: no cover
+            return self._fallback(f"error:{type(e).__name__}", level,
+                                  component="agg_rap")
+        with self._lock:
+            self.numeric_runs += 1
+        if telemetry.is_enabled():
+            telemetry.counter_inc("amgx_device_rap_total", path="device")
+            telemetry.counter_inc("amgx_spgemm_total", op="agg")
+        if block_dim == 1:
+            Ac = sp.csr_matrix(
+                (data.astype(dtype), plan.C_indices.copy(),
+                 plan.C_indptr.copy()), shape=(nc, nc))
+            Ac.eliminate_zeros()
+            Ac.sort_indices()
+            return Ac
+        b = block_dim
+        return sp.bsr_matrix(
+            (data.astype(dtype), plan.C_indices.copy(),
+             plan.C_indptr.copy()), shape=(nc * b, nc * b))
+
+
+class _AggPlan:
+    """Aggregation Galerkin schedule: ``Ac.data[t_out] += A.data`` with
+    ``t_out = rank of (agg[row]·nc + agg[col])`` — the LOW_DEG
+    generator's segment semantics as one sorted segment-sum."""
+
+    __slots__ = ("t_out", "C_indptr", "C_indices", "nnz_A", "nnz_C",
+                 "block_dim", "buckets", "_dev")
+
+    def __init__(self, t_out, C_indptr, C_indices, nnz_A, nnz_C,
+                 block_dim):
+        self.t_out = t_out
+        self.C_indptr = C_indptr
+        self.C_indices = C_indices
+        self.nnz_A = int(nnz_A)
+        self.nnz_C = int(nnz_C)
+        self.block_dim = int(block_dim)
+        self.buckets = (spgemm.size_bucket(nnz_A),
+                        spgemm.size_bucket(nnz_C))
+        self._dev = None
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.t_out.nbytes) + int(self.C_indices.nbytes) \
+            + int(self.C_indptr.nbytes)
+
+    def numeric(self, vals):
+        import jax
+        if self._dev is None:
+            to = self.t_out.astype(
+                np.int32 if self.nnz_C < 2 ** 31 else np.int64)
+            nA_b = self.buckets[0]
+            pad = np.zeros(nA_b - self.nnz_A, dtype=to.dtype)
+            self._dev = jax.device_put(np.concatenate([to, pad]))
+        b = self.block_dim
+        return _agg_numeric_fn(self.nnz_A, *self.buckets, b)(
+            vals, self._dev)
+
+
+@functools.lru_cache(maxsize=64)
+def _agg_numeric_fn(nnz_A: int, nA_b: int, nC_b: int, b: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def go(vals, t_out):
+        shape = (nA_b - nnz_A,) if b == 1 else (nA_b - nnz_A, b, b)
+        v = jnp.concatenate([vals, jnp.zeros(shape, vals.dtype)])
+        return jax.ops.segment_sum(v, t_out, num_segments=nC_b)
+
+    return go
+
+
+def _build_agg_plan(M, agg: np.ndarray, nc: int,
+                    block_dim: int) -> _AggPlan:
+    """Host symbolic pass of the aggregation Galerkin: the coarse
+    pattern and the entry→coarse-slot rank map, from the structure and
+    aggregate ids alone."""
+    b = block_dim
+    n = M.shape[0] // b
+    rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(M.indptr))
+    ci = agg[rows].astype(np.int64)
+    cj = agg[M.indices].astype(np.int64)
+    key = ci * nc + cj
+    ukey, inv = np.unique(key, return_inverse=True)
+    C_rows = (ukey // nc).astype(np.int64)
+    C_indices = (ukey % nc).astype(np.int32)
+    C_indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(C_rows, minlength=nc))]
+    ).astype(np.int64)
+    return _AggPlan(inv.astype(np.int64), C_indptr, C_indices,
+                    len(key), len(ukey), b)
+
+
+# -------------------------------------------------------- module state
+_ENGINE: Optional[DeviceSetupEngine] = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def engine() -> DeviceSetupEngine:
+    """The process-wide engine (plans shared across solvers, resetups
+    and serve sessions — the whole point of pattern-keyed executables)."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                _ENGINE = DeviceSetupEngine()
+    return _ENGINE
+
+
+def engine_stats() -> Optional[dict]:
+    """Stats of the live engine, or None when nothing instantiated it
+    (keeps the telemetry emit in solvers/base.py import- and cost-free
+    for non-classical runs)."""
+    return _ENGINE.stats() if _ENGINE is not None else None
+
+
+def reset_engine():
+    """Drop the engine and its plan cache (test isolation)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        _ENGINE = None

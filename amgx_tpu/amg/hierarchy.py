@@ -29,6 +29,7 @@ from ..config import AMGConfig
 from ..core.matrix import Matrix
 from ..errors import BadConfigurationError
 from ..solvers.base import SolverFactory
+from ..ops.spgemm import pad_to_symbolic
 from ..utils.logging import amgx_output
 from ..utils.profiler import cpu_profiler
 from .aggregation.galerkin import galerkin_coarse
@@ -74,43 +75,6 @@ def _child_matrix(parent: Matrix, a, block_dim: int = 1) -> Matrix:
     m.device_dtype = parent.device_dtype
     m.placement = parent.placement
     return m
-
-
-def _symbolic_pad_galerkin(Ac_host, Asc, P_host) -> sp.csr_matrix:
-    """Expand a numeric Galerkin product to its full SYMBOLIC pattern.
-
-    scipy's SpGEMM prunes exact-cancellation entries; value-only device
-    resetup (classical/resetup_device.py) refreshes values inside a
-    FROZEN structure, so the structural slots must exist even where the
-    current values cancel — else a refreshed coupling would be silently
-    dropped."""
-    def ones(M):
-        M = sp.csr_matrix(M)
-        return sp.csr_matrix((np.ones(M.nnz), M.indices, M.indptr),
-                             shape=M.shape)
-
-    Pb = ones(P_host)
-    patt = sp.csr_matrix(Pb.T @ ones(Asc) @ Pb)
-    patt.sum_duplicates()
-    patt.sort_indices()
-    Ac = sp.csr_matrix(Ac_host)
-    Ac.sum_duplicates()
-    Ac.sort_indices()
-    # fill the numeric values into the symbolic structure (scipy's
-    # sparse "+" prunes zero-valued entries, so a zero-pad add loses
-    # exactly the slots this function exists to keep)
-    nc = patt.shape[1]
-    rows_p = np.repeat(np.arange(patt.shape[0], dtype=np.int64),
-                       np.diff(patt.indptr))
-    rows_a = np.repeat(np.arange(Ac.shape[0], dtype=np.int64),
-                       np.diff(Ac.indptr))
-    key_p = rows_p * nc + patt.indices
-    key_a = rows_a * nc + Ac.indices
-    pos = np.searchsorted(key_p, key_a)
-    data = np.zeros(patt.nnz, dtype=Ac.data.dtype)
-    data[pos] = Ac.data
-    return sp.csr_matrix((data, patt.indices, patt.indptr),
-                         shape=Ac.shape)
 
 
 def _drop_zero_diagonals(offs, vals: np.ndarray):
@@ -200,6 +164,12 @@ class AMGHierarchy:
         #: convergence forensics (telemetry/forensics.py): cycle-anatomy
         #: instrumentation in build_cycle + setup-time quality probes
         self.forensics = int(g("forensics"))
+        #: device-side setup engine (amg/device_setup/): route the
+        #: classical/aggregation Galerkin RAP through pattern-keyed
+        #: device SpGEMM executables (host scipy stays the fallback)
+        self.device_setup = int(g("device_setup"))
+        self.device_setup_min_rows = int(g("device_setup_min_rows"))
+        self.device_setup_cache_mb = int(g("device_setup_cache_mb"))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.coarse_solver_is_smoother = False
@@ -408,8 +378,7 @@ class AMGHierarchy:
             if kind == "aggregation":
                 agg, nc = data
                 with setup_profile.phase("rap", level=i):
-                    Ac_host = galerkin_coarse(cur.host, agg,
-                                              cur.block_dim)
+                    Ac_host = self._galerkin_agg(cur, agg, i)
                 lvl = AggregationLevel(cur, i, agg, nc)
                 nxt = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             elif kind == "pairwise":
@@ -441,13 +410,11 @@ class AMGHierarchy:
                 R_host = sp.csr_matrix(P_host.T)
                 Asc_r = cur.scalar_csr()
                 with setup_profile.phase("rap", level=i):
-                    Ac_host = sp.csr_matrix(R_host @ Asc_r @ P_host)
-                    if self.algorithm == "CLASSICAL":
-                        # keep the symbolic pattern stable across
-                        # resetups so recorded device plans stay
-                        # applicable
-                        Ac_host = _symbolic_pad_galerkin(Ac_host, Asc_r,
-                                                         P_host)
+                    # CLASSICAL keeps the full symbolic pattern across
+                    # resetups so recorded device plans stay applicable
+                    Ac_host = self._galerkin_classical(
+                        cur, Asc_r, R_host, P_host, i,
+                        keep_pattern=self.algorithm == "CLASSICAL")
                 lvl = ClassicalLevel(cur, i,
                                      _child_matrix(cur, P_host),
                                      _child_matrix(cur, R_host))
@@ -952,12 +919,9 @@ class AMGHierarchy:
         P_host = P_host.astype(Asc.dtype)
         R_host = sp.csr_matrix(P_host.T)
         with setup_profile.phase("rap", level=idx):
-            Ac_host = sp.csr_matrix(R_host @ Asc @ P_host) \
-                .astype(Asc.dtype)
-            if self.structure_reuse_levels != 0:
-                Ac_host = _symbolic_pad_galerkin(Ac_host, Asc, P_host)
-            Ac_host.sum_duplicates()
-            Ac_host.sort_indices()
+            Ac_host = self._galerkin_classical(
+                cur, Asc, R_host, P_host, idx,
+                keep_pattern=self.structure_reuse_levels != 0)
         level = ClassicalLevel(cur, idx, _child_matrix(cur, P_host),
                                _child_matrix(cur, R_host), cf_map)
         return level, _child_matrix(cur, Ac_host), \
@@ -990,7 +954,7 @@ class AMGHierarchy:
             if nc == 0:
                 return None, None, None
             with setup_profile.phase("rap", level=idx):
-                Ac_host = galerkin_coarse(cur.host, agg, cur.block_dim)
+                Ac_host = self._galerkin_agg(cur, agg, idx)
             level = AggregationLevel(cur, idx, agg, nc)
             Ac = _child_matrix(cur, Ac_host, block_dim=cur.block_dim)
             if geom is not None:
@@ -1056,15 +1020,18 @@ class AMGHierarchy:
                 P_host = interp.compute(Asc, S, cf_map).astype(Asc.dtype)
             R_host = sp.csr_matrix(P_host.T)
             with setup_profile.phase("rap", level=idx):
-                Ac_host = sp.csr_matrix(R_host @ Asc @ P_host) \
-                    .astype(Asc.dtype)
-                if self.algorithm == "CLASSICAL" and \
-                        self.structure_reuse_levels != 0 and \
-                        cur.dist is None:
-                    Ac_host = _symbolic_pad_galerkin(Ac_host, Asc,
-                                                     P_host)
-                Ac_host.sum_duplicates()
-                Ac_host.sort_indices()
+                if cur.dist is None:
+                    Ac_host = self._galerkin_classical(
+                        cur, Asc, R_host, P_host, idx,
+                        keep_pattern=self.algorithm == "CLASSICAL"
+                        and self.structure_reuse_levels != 0)
+                else:
+                    # distributed fallback: per-rank RAP owns the hot
+                    # path; this global product is correctness-only
+                    Ac_host = sp.csr_matrix(R_host @ Asc @ P_host) \
+                        .astype(Asc.dtype)
+                    Ac_host.sum_duplicates()
+                    Ac_host.sort_indices()
             if cur.dist is not None:
                 # fallback (non-row-local strength, HMIS/RS, MULTIPASS,
                 # consolidation-small grids): embed P/R into the padded
@@ -1217,6 +1184,70 @@ class AMGHierarchy:
         diagonal arrays → pairwise Galerkin, DIA in / DIA out."""
         offs, vals = arrs
         return pairwise_galerkin_dia(offs, vals)
+
+    # ------------------------------------------- device setup engine
+    def _device_setup_engine(self):
+        """The process-wide device setup engine, or None when the
+        ``device_setup`` knob disables it (the host paths then run
+        without even consulting the engine — no fallback events)."""
+        if not self.device_setup:
+            return None
+        from .device_setup import engine
+        return engine()
+
+    @staticmethod
+    def _galerkin_dtype(host_dtype) -> np.dtype:
+        """Numeric dtype of a device Galerkin pass: the HOST dtype off
+        TPU (bit-comparable to the scipy product it replaces); on TPU —
+        where f64 has no native lowering — always f32: coarse grids are
+        preconditioner data (the same narrowing ``_narrow_dia`` applies
+        to DIA hierarchies), and a bf16 device dtype still RAPs in f32
+        because an 8-bit-mantissa Galerkin product would distort the
+        hierarchy itself."""
+        import jax
+        if jax.default_backend() == "tpu":
+            return np.dtype(np.float32)
+        return np.dtype(host_dtype)
+
+    def _galerkin_classical(self, cur: Matrix, Asc, R_host, P_host,
+                            idx: int, keep_pattern: bool):
+        """Galerkin RAP of one classical level: the device SpGEMM
+        engine when enabled (pattern-keyed setup executable, numeric
+        pass under jit), host scipy triple product as the fallback.
+        ``keep_pattern`` returns the full symbolic pattern (the
+        frozen-structure resetup contract)."""
+        eng = self._device_setup_engine()
+        Ac = None
+        if eng is not None:
+            Ac = eng.galerkin_csr(
+                Asc, P_host, level=idx, keep_pattern=keep_pattern,
+                dtype=self._galerkin_dtype(Asc.dtype),
+                min_rows=self.device_setup_min_rows,
+                budget_bytes=self.device_setup_cache_mb << 20)
+        if Ac is None:
+            Ac = sp.csr_matrix(R_host @ Asc @ P_host)
+            if keep_pattern:
+                Ac = pad_to_symbolic(Ac, Asc, P_host)
+        Ac = Ac.astype(Asc.dtype)
+        Ac.sum_duplicates()
+        Ac.sort_indices()
+        return Ac
+
+    def _galerkin_agg(self, cur: Matrix, agg: np.ndarray, idx: int):
+        """Aggregation Galerkin of one level: device segment-sum path
+        (amg/device_setup/) with the host sort-based generator as the
+        fallback."""
+        eng = self._device_setup_engine()
+        if eng is not None and cur.dist is None:
+            host = cur.host
+            out = eng.galerkin_agg(
+                host, agg, cur.block_dim,
+                dtype=self._galerkin_dtype(host.dtype),
+                level=idx, min_rows=self.device_setup_min_rows,
+                budget_bytes=self.device_setup_cache_mb << 20)
+            if out is not None:
+                return out.astype(host.dtype)
+        return galerkin_coarse(cur.host, agg, cur.block_dim)
 
     @staticmethod
     def _rank_blocks(cur: Matrix, offsets: np.ndarray):

@@ -307,6 +307,20 @@ def register_default_parameters():
     R("setup_profile", int, 0,
       "enable setup attribution (phase tree, compile/transfer split, "
       "HBM watermarks)", _BOOL)
+    # device-side setup engine (amg/device_setup/ + ops/spgemm.py):
+    # pattern-keyed Galerkin RAP executables — host-symbolic once,
+    # device-numeric under jit with zero recompiles on resetup.  Host
+    # scipy remains the fallback for every gated case (the engine emits
+    # device_setup_fallback events with the reason)
+    R("device_setup", int, 1,
+      "route classical/aggregation Galerkin RAP through the device "
+      "SpGEMM engine (0 = host scipy only)", _BOOL)
+    R("device_setup_min_rows", int, 4096,
+      "fine rows below which the host Galerkin is kept (tiny levels "
+      "finish faster on host than a device dispatch)")
+    R("device_setup_cache_mb", int, 256,
+      "schedule-byte budget of the pattern-keyed setup-plan cache "
+      "(LRU evicts past it; an over-budget single plan falls back)")
     # serving subsystem (amgx_tpu/serve/): request-level concurrency —
     # sessions with a pattern-keyed setup cache, micro-batched multi-RHS
     # solves, bounded-queue admission control

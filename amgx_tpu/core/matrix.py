@@ -310,6 +310,23 @@ def ell_layout(indptr: np.ndarray, indices: np.ndarray):
 _FP_TOKENS = itertools.count(1)
 
 
+def csr_structure_fingerprint(M, extra: bytes = b"") -> str:
+    """Stable hex digest of a scipy CSR/BSR sparsity STRUCTURE (shape +
+    indptr/indices, never values) — THE pattern key of the device setup
+    engine's plan cache (amg/device_setup/) and the structural half of
+    :meth:`Matrix.pattern_fingerprint`.  Equal fingerprints ⇒ one
+    symbolic SpGEMM plan (and its compiled numeric executable) serves
+    both matrices."""
+    import hashlib
+    h = hashlib.blake2b(digest_size=16)
+    h.update(repr(tuple(M.shape)).encode())
+    h.update(np.ascontiguousarray(M.indptr).tobytes())
+    h.update(np.ascontiguousarray(M.indices).tobytes())
+    if extra:
+        h.update(extra)
+    return h.hexdigest()
+
+
 def _bsr_from_any(a, block_dim: int) -> sp.bsr_matrix:
     if block_dim == 1:
         return sp.csr_matrix(a)
@@ -641,8 +658,10 @@ class Matrix:
         h.update(repr((tuple(self.shape), self.block_dim)).encode())
         if self._host is not None:
             h.update(b"csr")
-            h.update(np.ascontiguousarray(self._host.indptr).tobytes())
-            h.update(np.ascontiguousarray(self._host.indices).tobytes())
+            # shared structural digest — the SAME key the device setup
+            # engine's plan cache uses, so a serve session's pattern
+            # identity and its cached setup executables agree
+            h.update(csr_structure_fingerprint(self._host).encode())
         elif self.blocks is not None:
             h.update(b"blocks")
             for blk in self.blocks:

@@ -1,0 +1,579 @@
+"""Device-resident sparse-matrix-matrix primitives (SpGEMM + Galerkin).
+
+Reference: ``base/src/csr_multiply.cu`` — AmgX runs the whole Galerkin
+product ``Ac = R·(A·P)`` on the accelerator (``csr_galerkin_product``,
+``csr_RAP_sparse_add``; PAPER.md layers L5/L9): a symbolic phase sizes
+the output pattern once, a numeric phase re-runs on new values without
+re-analysing.  This module is the TPU port of that split, shared by
+every setup path that multiplies sparse matrices:
+
+* **host symbolic pass** (:func:`spgemm_symbolic`,
+  :func:`build_galerkin_plan`): derive the output CSR pattern and the
+  flat contraction schedule ``out[t_out[q]] += a[tA[q]] * b[tB[q]]``
+  from the input patterns alone — run ONCE per sparsity pattern;
+* **device numeric pass** (:func:`spgemm_numeric`,
+  :func:`galerkin_numeric`): two ``jax.ops.segment_sum`` contractions
+  under ``jit``.  Every schedule array is a jit ARGUMENT (not a closure
+  constant, per the jit-args redesign that fixed the 128³ solve) and
+  all shapes are padded to the :func:`size_bucket` ladder, so one
+  compiled executable serves every pattern that lands in the same
+  bucket and a values-only re-run (``resetup``) performs ZERO
+  retraces/recompiles;
+* **ELL primitives** (:func:`ell_spgemm_fn`, :func:`ell_transpose_fn`,
+  :func:`dedup_rows`) — the sort-algebra SpGEMM of the fully-device
+  compact classical pipeline (expand by ROW gather, dedup by per-row
+  argsort + segmented scan; see :mod:`..amg.classical.device_coarse`
+  for the measured-rate rationale);
+* **DIA shift-algebra Galerkin** (:func:`dia_galerkin_fn`,
+  :func:`compose_sum`, :func:`compose_diff`) — the stencil fine-level
+  RAP where offsets compose by integer addition and every term is one
+  shifted multiply-add streaming at HBM rate
+  (:mod:`..amg.classical.device_pipeline` module doc).
+
+ELL conventions match :mod:`..amg.classical.device_coarse`: dead
+entries carry value 0 (cols −1 or self-pads), columns ascend within a
+row, pad rows are unit-diagonal.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import scipy.sparse as sp
+
+
+# ------------------------------------------------------------------ util
+def shift(x, d: int, fill=0):
+    """y[i] = x[i+d] with ``fill`` outside — the DIA neighbour read.
+    |d| ≥ n (tiny grids meeting a composed offset) is all-fill."""
+    import jax.numpy as jnp
+    if d == 0:
+        return x
+    n = x.shape[0]
+    if abs(d) >= n:
+        return jnp.full((n,), fill, x.dtype)
+    f = jnp.full((abs(d),), fill, x.dtype)
+    return jnp.concatenate([x[d:], f]) if d > 0 else \
+        jnp.concatenate([f, x[:d]])
+
+
+def size_bucket(n: int, floor: int = 1024) -> int:
+    """Round a flat array length up to the shared shape ladder (quarter
+    steps between powers of two, ≤25% padding waste) — what lets one
+    compiled numeric executable serve every same-bucket pattern."""
+    n = max(int(n), 1)
+    if n <= floor:
+        return floor
+    p = 1 << (n - 1).bit_length()          # smallest power of two ≥ n
+    for cand in (p // 2 + p // 8, p // 2 + p // 4,
+                 p // 2 + 3 * p // 8, p):
+        if n <= cand:
+            return cand
+    return p
+
+
+def _range_concat(starts: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """[starts[0]..+counts[0], starts[1]..+counts[1], ...] flattened."""
+    csum = np.concatenate([[0], np.cumsum(counts)])
+    return (np.arange(csum[-1], dtype=np.int64)
+            - np.repeat(csum[:-1], counts)
+            + np.repeat(starts.astype(np.int64), counts))
+
+
+# ------------------------------------------------------ host symbolic
+def spgemm_symbolic(Aptr, Aind, Bptr, Bind, n_rows: int, n_cols_B: int):
+    """Symbolic product C = A·B as a triple schedule: returns
+    (tA, tB, t_out, C_indptr, C_indices) with
+    ``C.data[t_out[q]] += A.data[tA[q]] * B.data[tB[q]]``."""
+    rowlenB = np.diff(Bptr)
+    cnt = rowlenB[Aind]
+    tA = np.repeat(np.arange(len(Aind), dtype=np.int64), cnt)
+    tB = _range_concat(Bptr[Aind], cnt)
+    i_of = np.repeat(
+        np.repeat(np.arange(n_rows, dtype=np.int64), np.diff(Aptr)), cnt)
+    j_of = Bind[tB].astype(np.int64)
+    key = i_of * n_cols_B + j_of
+    ukey, inv = np.unique(key, return_inverse=True)
+    C_rows = (ukey // n_cols_B).astype(np.int64)
+    C_indices = (ukey % n_cols_B).astype(np.int32)
+    C_indptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(C_rows, minlength=n_rows))]
+    ).astype(np.int64)
+    return (tA, tB, inv.astype(np.int64), C_indptr, C_indices)
+
+
+def transpose_perm(P: sp.csr_matrix) -> Tuple[np.ndarray, sp.csr_matrix]:
+    """R = Pᵀ with the data permutation recorded:
+    ``R.data = P.data[perm]``.  Returns (perm, R-with-probe-data)."""
+    probe = P.copy()
+    probe.data = np.arange(P.nnz).astype(np.float64)
+    R = sp.csr_matrix(probe.T)
+    R.sort_indices()
+    return np.rint(R.data).astype(np.int64), R
+
+
+def galerkin_pattern(A: sp.csr_matrix, P: sp.csr_matrix) -> sp.csr_matrix:
+    """Full SYMBOLIC pattern of Pᵀ·A·P (unit values): every structural
+    slot, including those where current values cancel exactly."""
+    def ones(M):
+        M = sp.csr_matrix(M)
+        return sp.csr_matrix((np.ones(M.nnz), M.indices, M.indptr),
+                             shape=M.shape)
+
+    Pb = ones(P)
+    patt = sp.csr_matrix(Pb.T @ ones(A) @ Pb)
+    patt.sum_duplicates()
+    patt.sort_indices()
+    return patt
+
+
+def fill_pattern(patt: sp.csr_matrix, M: sp.csr_matrix) -> sp.csr_matrix:
+    """Numeric values of ``M`` scattered into the (superset) symbolic
+    ``patt`` structure — slots absent from ``M`` become explicit zeros.
+    (scipy's sparse "+" prunes zero-valued entries, so a zero-pad add
+    would lose exactly the slots this function exists to keep.)"""
+    M = sp.csr_matrix(M)
+    M.sum_duplicates()
+    M.sort_indices()
+    nc = patt.shape[1]
+    rows_p = np.repeat(np.arange(patt.shape[0], dtype=np.int64),
+                       np.diff(patt.indptr))
+    rows_m = np.repeat(np.arange(M.shape[0], dtype=np.int64),
+                       np.diff(M.indptr))
+    key_p = rows_p * nc + patt.indices
+    key_m = rows_m * nc + M.indices
+    pos = np.searchsorted(key_p, key_m)
+    data = np.zeros(patt.nnz, dtype=M.data.dtype)
+    data[pos] = M.data
+    return sp.csr_matrix((data, patt.indices.copy(),
+                          patt.indptr.copy()), shape=M.shape)
+
+
+def pad_to_symbolic(Ac: sp.csr_matrix, A: sp.csr_matrix,
+                    P: sp.csr_matrix) -> sp.csr_matrix:
+    """Expand a numeric Galerkin product to its full symbolic pattern
+    (value-only device resetup refreshes values inside a FROZEN
+    structure, so the structural slots must exist even where the
+    current values cancel)."""
+    return fill_pattern(galerkin_pattern(A, P), Ac)
+
+
+def _small(a: np.ndarray) -> np.ndarray:
+    """int32 when the index space allows (halves schedule wire bytes)."""
+    return a.astype(np.int32) \
+        if a.size == 0 or a.max(initial=0) < 2 ** 31 else a
+
+
+def _pad_idx(a: np.ndarray, length: int, fill: int) -> np.ndarray:
+    out = np.full(length, fill, dtype=a.dtype)
+    out[:len(a)] = a
+    return out
+
+
+# ------------------------------------------------------- fused Galerkin
+@dataclasses.dataclass
+class GalerkinPlan:
+    """One pattern's reusable Galerkin setup executable: the host
+    symbolic schedules of ``AP = A·P`` and ``Ac = R·AP`` (R = Pᵀ via a
+    recorded data permutation, the sparse-add epilogue folded into the
+    second contraction) plus the bucketed device copies.  Built once
+    per (A pattern, P pattern); the numeric pass is pure device work."""
+    nnz_A: int
+    nnz_P: int
+    nnz_AP: int
+    nnz_Ac: int
+    perm_RP: np.ndarray
+    ap: tuple                      # (tA, tP, t_out)
+    ac: tuple                      # (tR, tAP, t_out)
+    Ac_indptr: np.ndarray
+    Ac_indices: np.ndarray
+    Ac_shape: tuple
+    #: bucketed sizes: (nA_b, nP_b, pairs1_b, nAP_b, pairs2_b, nAc_b)
+    buckets: tuple = ()
+    _dev: Optional[dict] = None
+
+    @property
+    def nbytes(self) -> int:
+        """Host schedule bytes (device copies mirror them 1:1) — the
+        plan-cache accounting unit."""
+        arrs = (self.perm_RP, *self.ap, *self.ac)
+        return int(sum(a.nbytes for a in arrs)) \
+            + int(self.Ac_indices.nbytes) + int(self.Ac_indptr.nbytes)
+
+    def device_arrays(self) -> dict:
+        """Bucket-padded schedule arrays, uploaded once and cached.
+        Pad entries point at the value arrays' guaranteed-zero tail
+        slot, so padded contraction terms contribute exact zeros."""
+        if self._dev is not None:
+            return self._dev
+        import jax
+        nA_b, nP_b, p1_b, nAP_b, p2_b, nAc_b = self.buckets
+        tA, tP, to1 = self.ap
+        tR, tAP, to2 = self.ac
+        host = dict(
+            perm=_pad_idx(_small(self.perm_RP), nP_b + 1, nP_b),
+            tA=_pad_idx(_small(tA), p1_b, nA_b),
+            tP=_pad_idx(_small(tP), p1_b, nP_b),
+            to1=_pad_idx(_small(to1), p1_b, 0),
+            tR=_pad_idx(_small(tR), p2_b, nP_b),
+            tAP=_pad_idx(_small(tAP), p2_b, 0),
+            to2=_pad_idx(_small(to2), p2_b, 0),
+        )
+        keys = sorted(host)
+        devs = jax.device_put([host[k] for k in keys])
+        self._dev = dict(zip(keys, devs))
+        return self._dev
+
+
+def build_galerkin_plan(A: sp.csr_matrix,
+                        P: sp.csr_matrix) -> GalerkinPlan:
+    """Host symbolic pass of the fused ``R·(A·P)`` product.  ``A`` and
+    ``P`` must have sorted indices (callers hold CSR in canonical
+    order); only the patterns are read."""
+    n, nc = P.shape
+    tA, tP, to1, APptr, APind = spgemm_symbolic(
+        A.indptr, A.indices, P.indptr, P.indices, n, nc)
+    nnz_AP = len(APind)
+    perm_RP, R = transpose_perm(P)
+    tR, tAP, to2, Acptr, Acind = spgemm_symbolic(
+        R.indptr, R.indices, APptr, APind, nc, nc)
+    nnz_Ac = len(Acind)
+    buckets = (size_bucket(A.nnz), size_bucket(P.nnz),
+               size_bucket(len(tA)), size_bucket(nnz_AP),
+               size_bucket(len(tR)), size_bucket(nnz_Ac))
+    return GalerkinPlan(
+        nnz_A=A.nnz, nnz_P=P.nnz, nnz_AP=nnz_AP, nnz_Ac=nnz_Ac,
+        perm_RP=perm_RP, ap=(tA, tP, to1), ac=(tR, tAP, to2),
+        Ac_indptr=Acptr, Ac_indices=Acind, Ac_shape=(nc, nc),
+        buckets=buckets)
+
+
+@functools.lru_cache(maxsize=64)
+def _pad_vals_fn(n: int, nb: int):
+    """jit: (n,) values → (nb+1,) with a guaranteed-zero tail (the slot
+    every padded schedule entry points at)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def pad(v):
+        return jnp.concatenate(
+            [v, jnp.zeros((nb + 1 - n,), v.dtype)])
+
+    return pad
+
+
+@functools.lru_cache(maxsize=64)
+def _galerkin_numeric_fn(nAP_b: int, nAc_b: int):
+    """jit: the two-contraction Galerkin numeric pass.  Every operand —
+    values AND schedule — is an argument, so a values-only re-run hits
+    the jit cache (zero retraces) and every same-bucket pattern shares
+    this one executable."""
+    import jax
+
+    @jax.jit
+    def go(vA, vP, perm, tA, tP, to1, tR, tAP, to2):
+        vAP = jax.ops.segment_sum(vA[tA] * vP[tP], to1,
+                                  num_segments=nAP_b)
+        vR = vP[perm]
+        return jax.ops.segment_sum(vR[tR] * vAP[tAP], to2,
+                                   num_segments=nAc_b)
+
+    return go
+
+
+def galerkin_numeric(plan: GalerkinPlan, vA, vP):
+    """Device numeric pass: (A values, P values) → Ac values
+    (device array of bucketed length; slots past ``plan.nnz_Ac`` are
+    zero).  Accepts numpy or device arrays (CSR data order)."""
+    import jax.numpy as jnp
+    nA_b, nP_b, _, nAP_b, _, nAc_b = plan.buckets
+    d = plan.device_arrays()
+    vA = jnp.asarray(vA)
+    vP = jnp.asarray(vP)
+    vA_ext = _pad_vals_fn(plan.nnz_A, nA_b)(vA)
+    vP_ext = _pad_vals_fn(plan.nnz_P, nP_b)(vP)
+    return _galerkin_numeric_fn(nAP_b, nAc_b)(
+        vA_ext, vP_ext, d["perm"], d["tA"], d["tP"], d["to1"],
+        d["tR"], d["tAP"], d["to2"])
+
+
+# --------------------------------------------------------- plain SpGEMM
+@dataclasses.dataclass
+class SpGEMMPlan:
+    """One pattern pair's C = A·B schedule (host symbolic, device
+    numeric) — the single-product sibling of :class:`GalerkinPlan`."""
+    nnz_A: int
+    nnz_B: int
+    nnz_C: int
+    triples: tuple                 # (tA, tB, t_out)
+    C_indptr: np.ndarray
+    C_indices: np.ndarray
+    C_shape: tuple
+    buckets: tuple = ()            # (nA_b, nB_b, pairs_b, nC_b)
+    _dev: Optional[dict] = None
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.triples)) \
+            + int(self.C_indices.nbytes) + int(self.C_indptr.nbytes)
+
+    def device_arrays(self) -> dict:
+        if self._dev is not None:
+            return self._dev
+        import jax
+        nA_b, nB_b, p_b, _ = self.buckets
+        tA, tB, to = self.triples
+        host = dict(tA=_pad_idx(_small(tA), p_b, nA_b),
+                    tB=_pad_idx(_small(tB), p_b, nB_b),
+                    to=_pad_idx(_small(to), p_b, 0))
+        keys = sorted(host)
+        devs = jax.device_put([host[k] for k in keys])
+        self._dev = dict(zip(keys, devs))
+        return self._dev
+
+
+def build_spgemm_plan(A: sp.csr_matrix, B: sp.csr_matrix) -> SpGEMMPlan:
+    tA, tB, to, Cptr, Cind = spgemm_symbolic(
+        A.indptr, A.indices, B.indptr, B.indices, A.shape[0],
+        B.shape[1])
+    buckets = (size_bucket(A.nnz), size_bucket(B.nnz),
+               size_bucket(len(tA)), size_bucket(len(Cind)))
+    return SpGEMMPlan(nnz_A=A.nnz, nnz_B=B.nnz, nnz_C=len(Cind),
+                      triples=(tA, tB, to), C_indptr=Cptr,
+                      C_indices=Cind, C_shape=(A.shape[0], B.shape[1]),
+                      buckets=buckets)
+
+
+@functools.lru_cache(maxsize=64)
+def _spgemm_numeric_fn(nC_b: int):
+    import jax
+
+    @jax.jit
+    def go(vA, vB, tA, tB, to):
+        return jax.ops.segment_sum(vA[tA] * vB[tB], to,
+                                   num_segments=nC_b)
+
+    return go
+
+
+def spgemm_numeric(plan: SpGEMMPlan, vA, vB):
+    """Device numeric pass of C = A·B; returns C values (bucketed
+    length, zeros past ``plan.nnz_C``)."""
+    import jax.numpy as jnp
+    nA_b, nB_b, _, nC_b = plan.buckets
+    d = plan.device_arrays()
+    vA_ext = _pad_vals_fn(plan.nnz_A, nA_b)(jnp.asarray(vA))
+    vB_ext = _pad_vals_fn(plan.nnz_B, nB_b)(jnp.asarray(vB))
+    return _spgemm_numeric_fn(nC_b)(vA_ext, vB_ext, d["tA"], d["tB"],
+                                    d["to"])
+
+
+# ------------------------------------------------------- ELL primitives
+def _rowwise(x):
+    import jax.numpy as jnp
+    return jnp.arange(x.shape[0], dtype=jnp.int32)[:, None]
+
+
+def seg_sum_scan(vals, new):
+    """Segmented inclusive sum along the LAST axis: runs delimited by
+    ``new`` flags; at a run's last position this is the run total."""
+    import jax
+    import jax.numpy as jnp
+
+    def op(a, b):
+        va, fa = a
+        vb, fb = b
+        return jnp.where(fb, vb, va + vb), fa | fb
+
+    out, _ = jax.lax.associative_scan(op, (vals, new), axis=-1)
+    return out
+
+
+def dedup_rows(cols, val_list, out_width: int):
+    """Per-row (col → Σ vals) dedup of an expanded product block.
+
+    ``cols`` (n, W) int32 with dead entries = -1; ``val_list`` is a list
+    of (n, W) arrays, each summed over duplicate columns.  Returns
+    (cols (n, K), [vals (n, K)...], live (n, K)) with columns ascending
+    and dead entries (-1, 0) packed to the right."""
+    import jax
+    import jax.numpy as jnp
+
+    n, W = cols.shape
+    order = jnp.argsort(cols, axis=1)            # dead (-1) sort first
+    sc = jnp.take_along_axis(cols, order, axis=1)
+    new = jnp.ones((n, W), dtype=bool)
+    new = new.at[:, 1:].set(sc[:, 1:] != sc[:, :-1])
+    runs = [seg_sum_scan(jnp.take_along_axis(v, order, axis=1), new)
+            for v in val_list]
+    last = jnp.ones((n, W), dtype=bool)
+    last = last.at[:, :-1].set(new[:, 1:])
+    live = last & (sc >= 0)
+    # keep ≤out_width live entries in ascending-column (== ascending
+    # position) order: key = live·BIG − position
+    pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (n, W))
+    kkey = jnp.where(live, jnp.int32(4 * W), jnp.int32(0)) - pos
+    k = min(out_width, W)
+    _, topi = jax.lax.top_k(kkey, k)
+    oc = jnp.take_along_axis(sc, topi, axis=1)
+    ovs = [jnp.take_along_axis(r, topi, axis=1) for r in runs]
+    ol = jnp.take_along_axis(live, topi, axis=1)
+    if out_width > k:
+        pad = out_width - k
+        oc = jnp.pad(oc, ((0, 0), (0, pad)), constant_values=-1)
+        ovs = [jnp.pad(v, ((0, 0), (0, pad))) for v in ovs]
+        ol = jnp.pad(ol, ((0, 0), (0, pad)))
+    oc = jnp.where(ol, oc, -1)
+    ovs = [jnp.where(ol, v, 0.0) for v in ovs]
+    return oc, ovs, ol
+
+
+@functools.lru_cache(maxsize=256)
+def ell_spgemm_fn(nb: int, Ka: int, Kb: int, Kout: int,
+                  self_pad: bool = False):
+    """jit: one ELL·ELL product C = A·B — (a_cols (nb, Ka), a_vals,
+    b_cols (nB, Kb), b_vals) → (c_cols (nb, Kout), c_vals, kmax i32).
+
+    Expand via ROW gathers of B's rows, dedup via sort+scan (the
+    measured-rate design of the compact classical pipeline).  A's dead
+    entries are value-0 or column-(−1); ``self_pad=True`` emits the
+    standard coarse-operator conventions (self-pad entries,
+    unit-diagonal pad rows) — the RAP epilogue; ``False`` leaves dead
+    columns −1 (the intermediate-product form)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(ac, av, bc, bv):
+        n = ac.shape[0]
+        live = (av != 0) & (ac >= 0)
+        acc = jnp.where(live, ac, 0)
+        g_c = bc[acc]                         # (n, Ka, Kb)
+        g_v = bv[acc]
+        keep = live[:, :, None] & (g_c >= 0) & (g_v != 0)
+        ec = jnp.where(keep, g_c, -1).reshape(n, Ka * Kb)
+        ev = jnp.where(keep, av[:, :, None] * g_v,
+                       0.0).reshape(n, Ka * Kb)
+        oc, (ov,), ol = dedup_rows(ec, [ev], Kout)
+        kmax = jnp.max(jnp.sum(ol.astype(jnp.int32), axis=1))
+        if self_pad:
+            rown = _rowwise(oc)
+            oc = jnp.where(ol, oc, rown)
+            empty = ~jnp.any(ol, axis=1)
+            first = jnp.arange(oc.shape[1]) == 0
+            ov = jnp.where(empty[:, None] & first, 1.0, ov)
+        return oc, ov, kmax
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=128)
+def ell_transpose_fn(nb: int, Kpx: int, ncb: int, Kr: int):
+    """jit: (P_cols (nb, Kpx) coarse-local, P_vals) →
+    (R_cols (ncb, Kr) i32 = fine-source ids, R_vals, maxdeg i32).
+
+    Transpose via ONE flat argsort of (col, row) keys + rank-in-run via
+    segmented scan; a single scatter builds the (ncb, Kr) table."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(pc, pv):
+        n = pc.shape[0]
+        rows = jnp.broadcast_to(
+            jnp.arange(n, dtype=jnp.int64)[:, None], pc.shape
+        ).reshape(-1)
+        cols = pc.reshape(-1).astype(jnp.int64)
+        vals = pv.reshape(-1)
+        live = (vals != 0) & (cols >= 0)
+        key = jnp.where(live, cols * n + rows,
+                        jnp.int64(ncb) * n + rows)
+        order = jnp.argsort(key)
+        sk = key[order]
+        sv = jnp.where(live, vals, 0.0)[order]
+        scol = (sk // n).astype(jnp.int32)
+        srow = (sk % n).astype(jnp.int32)
+        new = jnp.ones(sk.shape, dtype=bool).at[1:].set(
+            scol[1:] != scol[:-1])
+        rank = (seg_sum_scan(jnp.ones_like(sv), new) - 1.0
+                ).astype(jnp.int32)
+        ok = (scol < ncb) & (rank < Kr)
+        flat = jnp.where(ok, scol * Kr + rank, 0)
+        rv = jnp.zeros((ncb * Kr,), sv.dtype).at[flat].add(
+            jnp.where(ok, sv, 0.0))
+        rc = jnp.full((ncb * Kr,), -1, jnp.int32).at[flat].max(
+            jnp.where(ok, srow, -1))
+        maxdeg = jnp.max(jnp.where(scol < ncb, rank, -1)) + 1
+        return rc.reshape(ncb, Kr), rv.reshape(ncb, Kr), maxdeg
+
+    return jax.jit(run)
+
+
+# ------------------------------------------------- DIA shift algebra
+def compose_sum(a_offs: Sequence[int], b_offs: Sequence[int]):
+    """G = sorted {a+b} with, per g, the (a_idx, b_idx) pair list."""
+    pairs = {}
+    for ai, a in enumerate(a_offs):
+        for bi, b in enumerate(b_offs):
+            pairs.setdefault(int(a) + int(b), []).append((ai, bi))
+    G = tuple(sorted(pairs))
+    return G, [pairs[g] for g in G]
+
+
+def compose_diff(p_offs: Sequence[int], g_offs: Sequence[int]):
+    """Δ = sorted {g−o} with, per δ, the (p_idx, g_idx) pair list."""
+    pairs = {}
+    for pi, o in enumerate(p_offs):
+        for gi, g in enumerate(g_offs):
+            pairs.setdefault(int(g) - int(o), []).append((pi, gi))
+    D = tuple(sorted(pairs))
+    return D, [pairs[d] for d in D]
+
+
+def rap_candidate_offsets(a_offs: Sequence[int],
+                          p_offs: Sequence[int]) -> Tuple[int, ...]:
+    G, _ = compose_sum(a_offs, p_offs)
+    D, _ = compose_diff(p_offs, G)
+    return D
+
+
+@functools.lru_cache(maxsize=32)
+def dia_galerkin_fn(a_offs: Tuple[int, ...], p_offs: Tuple[int, ...],
+                    n: int, dtype_str: str):
+    """jit: (avals (nd, n), P_rows (np, n), cf) →
+    (Ac (nΔ, n), realized (nΔ,) bool, nc i32, kmax i32) — the embedded
+    fine-level Galerkin where every factor is a diagonal-offset matrix
+    and offsets compose statically (no gather/sort/scatter anywhere).
+
+    Candidate Δ is static from the offset lists; ``realized`` lets the
+    host prune all-zero diagonals before the solve pack."""
+    import jax
+    import jax.numpy as jnp
+
+    G, ap_pairs = compose_sum(a_offs, p_offs)
+    D, ac_pairs = compose_diff(p_offs, G)
+    dt = jnp.dtype(dtype_str)
+
+    def run(avals, P_rows, cf):
+        AP = []
+        for gi, g in enumerate(G):
+            acc = jnp.zeros(n, dtype=dt)
+            for (ai, pi) in ap_pairs[gi]:
+                acc = acc + avals[ai] * shift(P_rows[pi],
+                                              int(a_offs[ai]))
+            AP.append(acc)
+        Ac = []
+        for di, d in enumerate(D):
+            acc = jnp.zeros(n, dtype=dt)
+            for (pi, gi) in ac_pairs[di]:
+                acc = acc + shift(P_rows[pi] * AP[gi],
+                                  -int(p_offs[pi]))
+            Ac.append(acc)
+        Ac = jnp.stack(Ac)
+        realized = jnp.any(Ac != 0, axis=1)
+        nc = jnp.sum(cf.astype(jnp.int32))
+        kmax = jnp.max(jnp.sum((Ac != 0).astype(jnp.int32), axis=0))
+        return Ac, realized, nc, kmax
+
+    return jax.jit(run), D

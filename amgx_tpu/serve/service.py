@@ -271,6 +271,11 @@ class SolveService:
         with self._lat_lock:
             submitted, completed, rejected = \
                 self.submitted, self.completed, self.rejected
+        # device setup engine (amg/device_setup/): sessions sharing a
+        # sparsity pattern also share its compiled Galerkin setup
+        # executables — surface the plan-cache hit rate next to the
+        # session cache it multiplies
+        from ..amg.device_setup import engine_stats
         return {
             "submitted": submitted,
             "completed": completed,
@@ -281,4 +286,5 @@ class SolveService:
             "worker_task_failures": self._tm.failed_tasks,
             "latency_s": self.latency_percentiles(),
             "cache": self.cache.stats(),
+            "device_setup": engine_stats(),
         }

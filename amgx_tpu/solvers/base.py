@@ -247,6 +247,17 @@ class Solver:
                             else None))
                 except Exception:
                     pass    # a cost-model gap must never break setup
+            # device setup engine (amg/device_setup/): plan-cache
+            # hit/miss/fallback counts of the pattern-keyed Galerkin
+            # executables — None (and zero cost) when nothing ever
+            # instantiated the engine
+            try:
+                from ..amg.device_setup import engine_stats
+                st = engine_stats()
+                if st is not None:
+                    telemetry.event("device_setup_cache", **st)
+            except Exception:
+                pass        # observability must never break setup
             if self.telemetry_path:
                 telemetry.flush_jsonl(self.telemetry_path)
         return self

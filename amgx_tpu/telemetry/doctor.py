@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import json
 import sys
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from .export import aggregate_sessions
 from .forensics import COMPONENTS
@@ -303,6 +303,12 @@ def diagnose(paths: List[str]) -> dict:
     setup = _setup_profile.analyze(r for s in agg["sessions"]
                                    for r in s["records"])
 
+    # ---- device setup engine fallbacks (amg/device_setup/) ----------
+    setup_fallbacks = [dict(r["attrs"]) for s in agg["sessions"]
+                       for r in s["records"]
+                       if r["kind"] == "event"
+                       and r["name"] == "device_setup_fallback"]
+
     # ---- hints ------------------------------------------------------
     hints: List[str] = []
     if agg["dropped_records"]:
@@ -354,7 +360,7 @@ def diagnose(paths: List[str]) -> dict:
         hints.append(f"{int(divergences)} divergence event(s): a "
                      "residual went non-finite")
     hints.extend(_forensics_hints(fr))
-    hints.extend(_setup_hints(setup))
+    hints.extend(_setup_hints(setup, setup_fallbacks))
     jit, _ = csum("amgx_jit_compile_total")
     if jit:
         hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
@@ -404,6 +410,7 @@ def diagnose(paths: List[str]) -> dict:
                             plateau=plateau, divergences=int(divergences)),
         "forensics": fr,
         "setup": setup,
+        "setup_fallbacks": setup_fallbacks,
         "hints": hints,
     }
 
@@ -498,12 +505,28 @@ def _forensics_hints(fr: Optional[dict]) -> List[str]:
 #: setup components whose dominance reads "the algorithm runs host-side"
 _HOST_SETUP_COMPONENTS = ("strength", "selector", "interpolation", "rap")
 
+#: phases the device setup engine emits (amg/device_setup/, single
+#: source: setup_profile.DEVICE_SETUP_COMPONENTS): their presence means
+#: the Galerkin RAP already runs on device, so a dominant "rap" reads
+#: "a level FELL BACK", not "build the engine"
+from .setup_profile import \
+    DEVICE_SETUP_COMPONENTS as _DEVICE_SETUP_COMPONENTS
 
-def _setup_hints(setup: Optional[dict]) -> List[str]:
+#: fallback reasons that are by-design (tiny levels are host-faster) —
+#: reported in the table but not hinted as problems
+_BENIGN_FALLBACKS = ("small", "disabled")
+
+
+def _setup_hints(setup: Optional[dict],
+                 setup_fallbacks: Optional[List[dict]] = None
+                 ) -> List[str]:
     """Actionable setup-attribution hints (telemetry/setup_profile.py):
     compile-bound setups earn the persistent-cache/AOT advice,
     host-dominated classical components point at the device-side setup
-    work (ROADMAP item 1), chatty transfers point at batching."""
+    engine (amg/device_setup/) — or, when its ``device_rap``/``spgemm``
+    phases are present, at the specific levels that FELL BACK to the
+    host path (with the recorded reason); chatty transfers point at
+    batching."""
     if not setup:
         return []
     from .setup_profile import (COMPILE_HINT, DOMINANT_HINT,
@@ -528,6 +551,36 @@ def _setup_hints(setup: Optional[dict]) -> List[str]:
                 f"host↔device transfers are {tshare:.0%} of setup "
                 f"({_fmt_bytes(s.get('transfer_bytes'))}) — keep the "
                 "hierarchy on device / batch the uploads")
+    device_setup_active = any(
+        p.get("component") in _DEVICE_SETUP_COMPONENTS
+        for p in setup.get("phases", []))
+    # group fallbacks by (component, level, reason): a resetup-heavy or
+    # multi-session trace repeats the same event hundreds of times and
+    # must not flood the hints list
+    fb_groups: Dict[tuple, int] = {}
+    for fb in setup_fallbacks or []:
+        k = (fb.get("component", "rap"), fb.get("level"),
+             fb.get("reason", "?"))
+        fb_groups[k] = fb_groups.get(k, 0) + 1
+    n_fb_hints = 0
+    for (comp, lvl, reason), cnt in sorted(fb_groups.items(),
+                                           key=lambda kv: -kv[1]):
+        if reason.split(":")[0] in _BENIGN_FALLBACKS:
+            continue
+        if n_fb_hints >= 6:
+            hints.append(f"… and {len(fb_groups) - 6} more distinct "
+                         "device-setup fallback groups (see the "
+                         "fallback section)")
+            break
+        where = f" at level {lvl}" if lvl is not None else ""
+        times = f" ({cnt}×)" if cnt > 1 else ""
+        hints.append(
+            f"{comp}{where} fell back to the host path (reason: "
+            f"{reason}){times} → "
+            + ("raise device_setup_cache_mb or split the level"
+               if reason == "budget" else
+               "check the device_setup gates (amg/device_setup/)"))
+        n_fb_hints += 1
     for p in setup.get("phases", [])[:3]:
         if p.get("overlapped"):
             continue
@@ -536,10 +589,31 @@ def _setup_hints(setup: Optional[dict]) -> List[str]:
                 p.get("host_s", 0.0) > p.get("compile_s", 0.0):
             where = f" at level {p['level']}" \
                 if p.get("level") is not None else ""
+            if p["component"] == "rap" and device_setup_active:
+                # the engine IS running — a dominant host rap means
+                # specific levels declined it; only the non-benign
+                # groups were hinted above, so say so when the
+                # recorded fallbacks don't explain the dominance
+                if n_fb_hints:
+                    break
+                if fb_groups:       # all-benign ('small') fallbacks
+                    hints.append(
+                        f"rap{where} runs host-side and is "
+                        f"{p['share']:.0%} of setup — every recorded "
+                        "fallback is benign ('small'): lower "
+                        "device_setup_min_rows if these levels matter")
+                else:
+                    hints.append(
+                        f"rap{where} runs host-side and is "
+                        f"{p['share']:.0%} of setup despite the device "
+                        "setup engine — enable telemetry during setup "
+                        "to record the fallback reasons")
+                break
             hints.append(
                 f"{p['component']}{where} runs host-side and is "
                 f"{p['share']:.0%} of setup → device-side setup "
-                "kernels (SpGEMM/Galerkin RAP, ROADMAP item 1)")
+                "engine (device_setup=1, amg/device_setup/; "
+                "ROADMAP item 1)")
             break
     uploads = int(s.get("uploads") or 0)
     if uploads > UPLOAD_DRAIN_HINT:
@@ -663,6 +737,23 @@ def render(d: dict) -> str:
     setup = d.get("setup")
     if setup:
         L.extend(_render_setup(setup))
+    fbs = d.get("setup_fallbacks")
+    if fbs:
+        L.append("")
+        L.append("device setup fallbacks")
+        L.append("-" * 40)
+        groups: dict = {}
+        for fb in fbs:
+            k = (fb.get("level"), fb.get("component", "rap"),
+                 fb.get("reason", "?"))
+            groups[k] = groups.get(k, 0) + 1
+        for (lvl, comp, reason), cnt in sorted(
+                groups.items(), key=lambda kv: (str(kv[0][0]),
+                                                kv[0][1])):
+            where = f"level {lvl}" if lvl is not None else "toplevel"
+            times = f"  ({cnt}×)" if cnt > 1 else ""
+            L.append(f"  {where:<10} {comp:<9} reason: "
+                     f"{reason}{times}")
 
     conv = d["convergence"]
     if conv:

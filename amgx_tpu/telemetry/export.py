@@ -137,6 +137,16 @@ def validate_record(rec: dict):
         if rec["name"] == "setup_profile":
             need(isinstance(rec["attrs"].get("wall_s"), (int, float)),
                  "setup_profile summary missing wall_s")
+        if rec["name"] == "device_setup_fallback":
+            # fallback events are the doctor's per-level "why did rap
+            # run host-side" input (amg/device_setup/)
+            a = rec["attrs"]
+            need(isinstance(a.get("reason"), str) and a["reason"],
+                 "device_setup_fallback event missing reason")
+            need(isinstance(a.get("component"), str) and a["component"],
+                 "device_setup_fallback event missing component")
+            need(a.get("level") is None or isinstance(a["level"], int),
+                 "device_setup_fallback event has non-integer level")
     else:   # counter / gauge / hist
         need(isinstance(rec.get("labels"), dict), "metric missing labels")
         v = rec.get("value")

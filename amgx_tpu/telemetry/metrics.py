@@ -119,6 +119,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_setup_transfers_total":
         ("counter", "blocking transfer calls instrumented during setup "
                     "{kind=upload|download}"),
+    # ---- device setup engine (amg/device_setup/ + ops/spgemm.py) ----
+    "amgx_spgemm_total":
+        ("counter", "device SpGEMM numeric passes by operation "
+                    "{op=rap|agg|spgemm}"),
+    "amgx_device_rap_total":
+        ("counter", "Galerkin RAP products by executing path "
+                    "{path=device|host}"),
+    "amgx_device_setup_fallback_total":
+        ("counter", "device setup gates that fell back to the host "
+                    "path {reason}"),
+    "amgx_spgemm_plan_cache":
+        ("gauge", "setup plans held in the pattern-keyed plan cache"),
+    "amgx_spgemm_plan_bytes":
+        ("gauge", "schedule bytes held in the pattern-keyed plan "
+                  "cache"),
     "amgx_setup_seconds":
         ("histogram", "solver setup wall seconds"),
     "amgx_resetup_seconds":

@@ -53,6 +53,12 @@ from . import metrics, recorder
 COMPONENTS = ("strength", "selector", "interpolation", "rap", "upload",
               "smoother_setup", "coarse_solver", "resetup_plan")
 
+#: phases of the device setup engine (amg/device_setup/): ``spgemm`` is
+#: the host symbolic plan build (cache-miss only), ``device_rap`` the
+#: jitted numeric Galerkin pass — both nest inside the level's ``rap``
+#: phase, so a dominant host-side rap reads "fell back", not "missing"
+DEVICE_SETUP_COMPONENTS = ("spgemm", "device_rap")
+
 #: compile share of setup past which the doctor recommends the
 #: persistent compilation cache / AOT lowering
 COMPILE_HINT = 0.4

@@ -206,6 +206,21 @@ def _tel_case_summary(tel):
     if tel.events("setup_profile") or tel.events("setup_phase"):
         from amgx_tpu.telemetry import setup_profile as _sp
         sprof = _sp.summarize(_sp.analyze(tel.records))
+    # device setup engine (amg/device_setup/): RAP path split +
+    # plan-cache state + per-level fallback reasons — the numbers the
+    # ISSUE-7 acceptance reads ("host-share of rap below 25%")
+    dev_rap = tel.counter_totals("amgx_device_rap_total", label="path")
+    dsetup = None
+    if dev_rap:
+        dsetup = {
+            "rap_by_path": {str(k): int(v)
+                            for k, v in sorted(dev_rap.items())},
+            "fallbacks": [dict(e["attrs"]) for e in
+                          tel.events("device_setup_fallback")],
+        }
+        caches = tel.events("device_setup_cache")
+        if caches:
+            dsetup["cache"] = dict(caches[-1]["attrs"])
     return {
         "packs": {str(k): int(v) for k, v in sorted(
             tel.counter_totals("amgx_spmv_dispatch_total",
@@ -218,6 +233,7 @@ def _tel_case_summary(tel):
         **({"halo": halo} if halo else {}),
         **({"forensics": fore} if fore else {}),
         **({"setup_profile": sprof} if sprof else {}),
+        **({"device_setup": dsetup} if dsetup else {}),
     }
 
 

@@ -1,11 +1,79 @@
 #!/usr/bin/env python
 """Micro-bench of the XLA primitives the device classical coarse path
-needs: gather, per-row sort, scatter-add, top_k — at level-1-like sizes."""
+needs: gather, per-row sort, scatter-add, top_k — at level-1-like
+sizes.
+
+``spgemm`` mode (``prim_bench.py spgemm [n_side]``): the device setup
+engine's fused Galerkin pass (ops/spgemm.py) on a Poisson 7-point
+operator with a 2×2×2 piecewise-constant P — host-symbolic seconds,
+device-numeric GB/s and GFLOP/s, and the fraction of the v5e HBM
+roofline (telemetry/costmodel.py) the contraction achieves."""
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+
+def _bench_spgemm(n_side: int = 64):
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import scipy.sparse as sp
+
+    from amgx_tpu.io import poisson7pt
+    from amgx_tpu.ops import spgemm
+    from amgx_tpu.telemetry import costmodel
+
+    A = sp.csr_matrix(poisson7pt(n_side, n_side, n_side))
+    A.sort_indices()
+    n = A.shape[0]
+    # 2×2×2 piecewise-constant prolongation — the aggregation-shaped P
+    # (bounded row nnz = 1); representative of the RAP's access pattern
+    # without needing a full interpolation pass.  Ceil-divided coarse
+    # dims so odd n_side works (the boundary cell aggregates alone)
+    ns2 = -(-n_side // 2)
+    ix = np.arange(n)
+    x, y, z = ix % n_side, (ix // n_side) % n_side, ix // n_side ** 2
+    agg = (x // 2) + ns2 * (y // 2) + ns2 * ns2 * (z // 2)
+    P = sp.csr_matrix((np.ones(n), (ix, agg)), shape=(n, ns2 ** 3))
+    P.sort_indices()
+
+    t0 = time.perf_counter()
+    plan = spgemm.build_galerkin_plan(A, P)
+    t_sym = time.perf_counter() - t0
+    pairs = len(plan.ap[0]) + len(plan.ac[0])
+    flops = 2.0 * pairs
+    isz = 4 if pairs < 2 ** 31 else 8
+    # bytes: schedule reads (3 index streams per contraction) + value
+    # gathers + segment-sum write, per pass
+    nbytes = pairs * (3 * isz + 2 * 4) + (plan.nnz_AP + plan.nnz_Ac) * 4
+
+    dt = np.float32 if jax.default_backend() == "tpu" else np.float64
+    vA = jnp.asarray(A.data, dt)
+    vP = jnp.asarray(P.data, dt)
+    out = spgemm.galerkin_numeric(plan, vA, vP)
+    jax.block_until_ready(out)          # warm/compile + plan upload
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = spgemm.galerkin_numeric(plan, vA, vP)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    gbs = nbytes / best / 1e9
+    print(f"spgemm galerkin {n_side}^3: A nnz {A.nnz}, P nnz {P.nnz}, "
+          f"Ac nnz {plan.nnz_Ac}, pairs {pairs}")
+    print(f"  symbolic (host, once/pattern): {t_sym:.3f}s")
+    print(f"  numeric  (device, per resetup): {best * 1e3:.2f}ms = "
+          f"{flops / best / 1e9:.2f} GFLOP/s, {gbs:.1f} GB/s "
+          f"({costmodel.roofline_fraction(gbs):.2f}x of the "
+          f"{costmodel.HBM_PEAK_GBS:.0f} GB/s v5e roofline)")
+
+
+if len(sys.argv) > 1 and sys.argv[1] == "spgemm":
+    _bench_spgemm(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
+    sys.exit(0)
 
 n = 572_000
 K = 42

@@ -9,7 +9,14 @@ the same file reproduces it, and the trace feeds ``--diff`` A/B
 comparisons across rounds.
 
 Usage: scripts/profile_cla.py [n_side] [--trace out.jsonl]
+       [--no-device-setup]
        (default n_side 128; default trace ./profile_cla_<n>.jsonl)
+
+``--no-device-setup`` forces the host scipy Galerkin path
+(device_setup=0) — run once with and once without, then
+``python -m amgx_tpu.telemetry.doctor before.jsonl --diff after.jsonl``
+shows the rap/interpolation host-share drop the device setup engine
+buys (README "Device-side setup" walkthrough).
 """
 import os
 import sys
@@ -26,6 +33,10 @@ from amgx_tpu.telemetry import doctor
 
 argv = list(sys.argv[1:])
 trace = None
+device_setup_knob = ", device_setup=1, device_setup_min_rows=0"
+if "--no-device-setup" in argv:
+    argv.remove("--no-device-setup")
+    device_setup_knob = ", device_setup=0"
 if "--trace" in argv:
     i = argv.index("--trace")
     try:
@@ -51,7 +62,8 @@ CFG_CLA = (
     "amg:max_levels=16, amg:smoother(sm)=JACOBI_L1, "
     "sm:max_iters=1, amg:presweeps=2, amg:postsweeps=2, "
     "amg:min_coarse_rows=32, amg:coarse_solver=DENSE_LU_SOLVER, "
-    f"setup_profile=1, out:telemetry=1, out:telemetry_path={trace}")
+    f"setup_profile=1{device_setup_knob}, "
+    f"out:telemetry=1, out:telemetry_path={trace}")
 
 A = poisson7pt(n_side, n_side, n_side)
 m = amgx.Matrix(A)

@@ -271,15 +271,102 @@ def main():
             fail(f"setup attribution split is missing {word!r}")
     telemetry.setup_profile.disable()
 
+    # 11. device setup engine (device_setup=1): the trace carries the
+    # schema-valid device_rap/spgemm setup phases, the RAP path counter
+    # splits device vs host, and a forced fallback emits a schema-valid
+    # device_setup_fallback event the doctor surfaces with its reason
+    telemetry.reset()
+    telemetry.disable()
+    telemetry.setup_profile.disable()
+    from amgx_tpu.amg.device_setup import reset_engine
+    reset_engine()
+    path_d = path + ".device_setup"
+    if os.path.exists(path_d):
+        os.unlink(path_d)
+    cfg_d = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+        "amg:selector=PMIS, amg:interpolator=D1, amg:max_iters=1, "
+        "amg:max_levels=10, amg:smoother(sm)=JACOBI_L1, "
+        "sm:max_iters=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, setup_profile=1, "
+        "device_setup=1, device_setup_min_rows=0, "
+        f"out:telemetry=1, out:telemetry_path={path_d}")
+    slv_d = amgx.create_solver(cfg_d)
+    slv_d.setup(amgx.Matrix(A))
+    slv_d.solve(np.ones(A.shape[0]))
+    with open(path_d) as f:
+        lines_d = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_d)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"device-setup trace: {e}")
+    recs_d = [json.loads(l) for l in lines_d if l.strip()]
+    comps_d = {r["attrs"]["component"] for r in recs_d
+               if r["kind"] == "event" and r["name"] == "setup_phase"}
+    for comp in ("device_rap", "spgemm"):
+        if comp not in comps_d:
+            fail(f"device-setup trace is missing the {comp!r} phase "
+                 f"(saw: {sorted(comps_d)})")
+    rap_paths = {lbl for r in recs_d if r["kind"] == "counter"
+                 and r["name"] == "amgx_device_rap_total"
+                 for lbl in [r["labels"].get("path")]}
+    if "device" not in rap_paths:
+        fail(f"no device-path RAP counted (paths: {sorted(rap_paths)})")
+    # forced fallback: a min-rows gate above the fine grid keeps every
+    # level on host and must leave an auditable reason
+    telemetry.reset()
+    telemetry.disable()
+    telemetry.setup_profile.disable()
+    path_d2 = path_d + ".fallback"
+    if os.path.exists(path_d2):
+        os.unlink(path_d2)
+    cfg_d2 = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+        "amg:selector=PMIS, amg:interpolator=D1, amg:max_iters=1, "
+        "amg:max_levels=10, amg:smoother(sm)=JACOBI_L1, "
+        "sm:max_iters=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, setup_profile=1, "
+        "device_setup=1, device_setup_min_rows=100000000, "
+        f"out:telemetry=1, out:telemetry_path={path_d2}")
+    slv_d2 = amgx.create_solver(cfg_d2)
+    slv_d2.setup(amgx.Matrix(A))
+    slv_d2.solve(np.ones(A.shape[0]))
+    with open(path_d2) as f:
+        lines_d2 = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_d2)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"device-setup fallback trace: {e}")
+    recs_d2 = [json.loads(l) for l in lines_d2 if l.strip()]
+    fb = [r["attrs"] for r in recs_d2 if r["kind"] == "event"
+          and r["name"] == "device_setup_fallback"]
+    if not fb or not all(a.get("reason") == "small" for a in fb):
+        fail(f"expected 'small' fallback events, saw: {fb[:3]}")
+    diag_d2 = doctor.diagnose([path_d2])
+    if not diag_d2.get("setup_fallbacks"):
+        fail("doctor diagnosis is missing setup_fallbacks")
+    if "device setup fallbacks" not in doctor.render(diag_d2):
+        fail("doctor report is missing the device setup fallbacks "
+             "section")
+    telemetry.setup_profile.disable()
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
-          f"setup-profile OK, coverage {cov:.0%})")
+          f"setup-profile OK, coverage {cov:.0%}, device-setup OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
         os.unlink(path_s)
+        os.unlink(path_d)
+        os.unlink(path_d2)
 
 
 if __name__ == "__main__":

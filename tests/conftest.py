@@ -48,6 +48,10 @@ def pytest_configure(config):
         "markers", "setup_profile: setup-profiler fast tests "
                    "(tier-1; pytest -m setup_profile selects just "
                    "these)")
+    config.addinivalue_line(
+        "markers", "device_setup: device setup engine fast tests "
+                   "(tier-1; pytest -m device_setup selects just "
+                   "these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

@@ -1,0 +1,392 @@
+"""Device-side AMG setup engine (amg/device_setup/ + ops/spgemm.py).
+
+A/B equivalence of the device Galerkin RAP/SpGEMM against the host
+scipy triple products on scalar, block (b=3,4), anisotropic and
+nonsymmetric patterns; the symbolic-pattern (cancellation-slot)
+contract; pattern-keyed plan reuse with ZERO jit retraces on a
+values-only change (the ``jax.monitoring`` retrace counter);
+fallback-reason bookkeeping; and the unified ELL SpGEMM primitives."""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.amg.aggregation.galerkin import galerkin_coarse
+from amgx_tpu.amg.device_setup import (DeviceSetupEngine, engine,
+                                       reset_engine)
+from amgx_tpu.ops import spgemm
+
+pytestmark = [pytest.mark.device_setup]
+
+#: relative equivalence bound of the A/B suite (the device pass runs in
+#: f64 off-TPU, so the real gap is reassociation-level ~1e-14)
+RTOL = 1e-6
+
+
+def poisson2d(n):
+    I = sp.identity(n)
+    T = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n))
+    return sp.csr_matrix(sp.kron(I, T) + sp.kron(T, I))
+
+
+def anisotropic2d(n, eps=0.01):
+    """eps-anisotropic 5-point stencil (strong x, weak y coupling)."""
+    I = sp.identity(n)
+    Tx = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n))
+    Ty = sp.diags([-eps, 2 * eps, -eps], [-1, 0, 1], shape=(n, n))
+    return sp.csr_matrix(sp.kron(I, Tx) + sp.kron(Ty, I))
+
+
+def convection2d(n, beta=3.0):
+    """Nonsymmetric upwinded convection-diffusion stencil."""
+    I = sp.identity(n)
+    T = sp.diags([-1.0, 2.0, -1.0], [-1, 0, 1], shape=(n, n))
+    U = sp.diags([-beta, beta, 0.0], [-1, 0, 1], shape=(n, n))
+    return sp.csr_matrix(sp.kron(I, T) + sp.kron(T, I) + sp.kron(I, U))
+
+
+def _interp_like(A, rng, nc_frac=0.3):
+    """A bounded-row-nnz rectangular P with an identity-ish block —
+    interpolation-shaped without running a selector."""
+    n = A.shape[0]
+    nc = max(int(n * nc_frac), 2)
+    rows = np.repeat(np.arange(n), 2)
+    cols = rng.integers(0, nc, size=2 * n)
+    vals = rng.standard_normal(2 * n)
+    P = sp.csr_matrix((vals, (rows, cols)), shape=(n, nc))
+    P = P + sp.csr_matrix(
+        (np.ones(nc), (np.arange(nc), np.arange(nc))), shape=(n, nc))
+    P = sp.csr_matrix(P)
+    P.sort_indices()
+    return P
+
+
+def _rel_err(X, Y):
+    X = sp.csr_matrix(X)
+    Y = sp.csr_matrix(Y)
+    denom = max(abs(X).max(), 1e-30)
+    return abs(X - Y).max() / denom
+
+
+CLA = (
+    "config_version=2, solver(out)=PCG, out:max_iters=60, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=CLASSICAL, "
+    "amg:selector=PMIS, amg:max_iters=1, amg:max_levels=6, "
+    "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def _coarse_operators(A, extra):
+    """Host CSR of every coarse operator a classical setup built."""
+    slv = amgx.create_solver(amgx.AMGConfig(CLA + extra))
+    slv.setup(amgx.Matrix(A))
+    hier = slv.preconditioner.hierarchy
+    mats = [lvl.A for lvl in hier.levels[1:]] + [hier.coarsest]
+    return [sp.csr_matrix(m.host) for m in mats], slv
+
+
+# ----------------------------------------------------- A/B equivalence
+@pytest.mark.parametrize("make_A,interp", [
+    (lambda: poisson2d(24), "D1"),
+    (lambda: poisson2d(24), "D2"),
+    (lambda: anisotropic2d(24), "D1"),
+    (lambda: convection2d(24), "D2"),
+], ids=["scalar-d1", "scalar-d2", "aniso-d1", "nonsym-d2"])
+def test_hierarchy_rap_matches_host(make_A, interp):
+    """Per-level A/B: for every (A, P) pair a host-path classical setup
+    produced — symmetric, anisotropic and nonsymmetric operators, D1
+    and D2 — the device RAP reproduces the stored scipy Galerkin
+    product to ≤1e-6 relative.  (Whole-hierarchy comparison would be
+    chaotic: reassociation-level value differences can legally flip a
+    downstream PMIS tie-break, which is a decision change, not an
+    arithmetic error.)"""
+    A = make_A()
+    extra = f", amg:interpolator={interp}"
+    host, slv = _coarse_operators(A, extra + ", device_setup=0")
+    hier = slv.preconditioner.hierarchy
+    eng = DeviceSetupEngine()
+    cur = sp.csr_matrix(hier.levels[0].A.scalar_csr())
+    checked = 0
+    for i, (kind, data) in enumerate(hier._structure):
+        assert kind == "classical"
+        P = sp.csr_matrix(data[0])
+        Ac = eng.galerkin_csr(cur, P, dtype=np.float64, level=i,
+                              min_rows=0)
+        assert Ac is not None
+        assert _rel_err(Ac, host[i]) <= RTOL
+        cur = host[i]
+        checked += 1
+    assert checked >= 1
+
+
+def test_galerkin_plan_matches_scipy_direct(rng):
+    """Plan-level A/B: the fused R·(A·P) numeric pass reproduces the
+    scipy triple product on a nonsymmetric operator and a random
+    bounded-row P."""
+    A = convection2d(20)
+    A.sort_indices()
+    P = _interp_like(A, rng)
+    plan = spgemm.build_galerkin_plan(A, P)
+    vAc = np.asarray(spgemm.galerkin_numeric(plan, A.data, P.data))
+    Ac = sp.csr_matrix((vAc[:plan.nnz_Ac], plan.Ac_indices,
+                        plan.Ac_indptr), shape=plan.Ac_shape)
+    ref = sp.csr_matrix(P.T @ A @ P)
+    assert _rel_err(Ac, ref) <= RTOL
+
+
+def test_spgemm_plan_matches_scipy(rng):
+    A = sp.random(150, 120, 0.06, random_state=np.random.RandomState(3),
+                  format="csr")
+    B = sp.random(120, 90, 0.08, random_state=np.random.RandomState(4),
+                  format="csr")
+    A.sort_indices()
+    B.sort_indices()
+    plan = spgemm.build_spgemm_plan(A, B)
+    vC = np.asarray(spgemm.spgemm_numeric(plan, A.data, B.data))
+    C = sp.csr_matrix((vC[:plan.nnz_C], plan.C_indices, plan.C_indptr),
+                      shape=plan.C_shape)
+    assert _rel_err(C, sp.csr_matrix(A @ B)) <= RTOL
+
+
+@pytest.mark.parametrize("b", [3, 4])
+def test_aggregation_block_galerkin_matches_host(b, rng):
+    """Block (b=3,4) aggregation Galerkin: the device segment-sum path
+    equals the host LOW_DEG-semantics generator blockwise."""
+    n = 40
+    S = sp.random(n, n, 0.15, random_state=np.random.RandomState(b),
+                  format="csr") + sp.identity(n)
+    Ab = sp.kron(sp.csr_matrix(S),
+                 np.arange(1, b * b + 1).reshape(b, b) / b
+                 ).tobsr(blocksize=(b, b))
+    agg = rng.integers(0, 9, size=n)
+    eng = DeviceSetupEngine()
+    out = eng.galerkin_agg(Ab, agg, b, dtype=np.float64, min_rows=0)
+    assert out is not None
+    ref = galerkin_coarse(Ab, agg, b)
+    assert _rel_err(sp.csr_matrix(out), sp.csr_matrix(ref)) <= RTOL
+
+
+def test_aggregation_scalar_galerkin_matches_host(rng):
+    A = anisotropic2d(16)
+    agg = rng.integers(0, 30, size=A.shape[0])
+    eng = DeviceSetupEngine()
+    out = eng.galerkin_agg(A, agg, 1, dtype=np.float64, min_rows=0)
+    ref = galerkin_coarse(A, agg, 1)
+    assert _rel_err(out, ref) <= RTOL
+    assert (out != ref).nnz == 0 or _rel_err(out, ref) <= RTOL
+
+
+# --------------------------------------------------- symbolic pattern
+def test_keep_pattern_retains_cancellation_slots():
+    """The frozen-structure contract (ex ``_symbolic_pad_galerkin``):
+    structural slots whose values cancel exactly stay as explicit
+    zeros, so a later value-only refresh can light them up."""
+    # the two row contributions into Ac's single slot cancel exactly:
+    # Σ P[i,0]·A[i,j]·P[j,0] = 1+1−1−1 = 0
+    A = sp.csr_matrix(np.array([[1.0, 1.0], [-1.0, -1.0]]))
+    P = sp.csr_matrix(np.array([[1.0], [1.0]]))
+    patt = spgemm.galerkin_pattern(A, P)
+    ref = sp.csr_matrix(P.T @ A @ P)          # scipy prunes the zero
+    assert patt.nnz > ref.nnz
+    eng = DeviceSetupEngine()
+    kept = eng.galerkin_csr(A, P, dtype=np.float64, keep_pattern=True,
+                            min_rows=0)
+    pruned = eng.galerkin_csr(A, P, dtype=np.float64,
+                              keep_pattern=False, min_rows=0)
+    assert kept.nnz == patt.nnz               # slot exists, value 0
+    assert pruned.nnz == ref.nnz              # scipy parity
+    assert _rel_err(kept, ref) <= RTOL
+
+
+def test_fill_pattern_round_trip():
+    A = poisson2d(8)
+    P = _interp_like(A, np.random.default_rng(7))
+    patt = spgemm.galerkin_pattern(A, P)
+    num = sp.csr_matrix(P.T @ A @ P)
+    filled = spgemm.fill_pattern(patt, num)
+    assert filled.nnz == patt.nnz
+    assert _rel_err(filled, num) <= RTOL
+
+
+# ------------------------------------------------------ reuse contract
+def test_plan_cache_hit_and_zero_retraces(rng):
+    """Same pattern + new values → plan-cache hit and ZERO jit
+    retraces/recompiles (the ``jax.monitoring`` counter): the setup
+    executable is reused as a pure numeric pass."""
+    A = poisson2d(16)
+    A.sort_indices()
+    P = _interp_like(A, rng)
+    eng = DeviceSetupEngine()
+    Ac1 = eng.galerkin_csr(A, P, dtype=np.float64, min_rows=0)
+    assert Ac1 is not None and eng.stats()["misses"] == 1
+    A2 = A.copy()
+    A2.data = A2.data * 1.7 + 0.01
+    with telemetry.capture() as cap:
+        Ac2 = eng.galerkin_csr(A2, P, dtype=np.float64, min_rows=0)
+    assert Ac2 is not None
+    assert eng.stats()["hits"] == 1
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    ref = sp.csr_matrix(P.T @ A2 @ P)
+    assert _rel_err(Ac2, ref) <= RTOL
+
+
+def test_resetup_values_only_zero_recompiles():
+    """``Solver.resetup`` after ``replace_coefficients`` (same
+    structure, new values) performs ZERO retraces once warm — the
+    ISSUE-7 acceptance contract for resetup-heavy serving."""
+    reset_engine()
+    A = poisson2d(20)
+    m = amgx.Matrix(A)
+    cfg = amgx.AMGConfig(
+        CLA + ", amg:interpolator=D1, amg:structure_reuse_levels=-1, "
+        "device_setup=1, device_setup_min_rows=0")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    x0 = np.asarray(slv.solve(b).x)
+
+    def refreshed(scale):
+        m2 = amgx.Matrix(A)
+        m2.replace_coefficients(A.data * scale)
+        return m2
+
+    slv.resetup(refreshed(2.0))      # warm: refresh fns trace once
+    slv.solve(b)
+    with telemetry.capture() as cap:
+        slv.resetup(refreshed(3.0))
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    res = slv.solve(b)
+    x = np.asarray(res.x)
+    rr = np.linalg.norm(b - 3.0 * (A @ x)) / np.linalg.norm(b)
+    assert rr < 1e-6
+    np.testing.assert_allclose(x, x0 / 3.0, rtol=1e-5, atol=1e-10)
+
+
+def test_plan_cache_lru_budget(rng):
+    """The plan cache evicts least-recently-used plans past the byte
+    budget instead of growing without bound."""
+    eng = DeviceSetupEngine(budget_bytes=1)     # everything over budget
+    A = poisson2d(10)
+    A.sort_indices()
+    P = _interp_like(A, rng)
+    # a single over-budget plan is not cached: it falls back
+    assert eng.galerkin_csr(A, P, dtype=np.float64, min_rows=0) is None
+    st = eng.stats()
+    assert st["fallbacks"] == 1 and st["plans"] == 0
+    eng2 = DeviceSetupEngine(budget_bytes=64 << 20)
+    for k in range(3):
+        Pk = _interp_like(A, np.random.default_rng(k))
+        assert eng2.galerkin_csr(A, Pk, dtype=np.float64,
+                                 min_rows=0) is not None
+    assert eng2.stats()["plans"] == 3
+    assert eng2.stats()["plan_bytes"] <= 64 << 20
+
+
+# --------------------------------------------------------- fallbacks
+def test_fallback_reason_recorded():
+    A = poisson2d(8)
+    P = _interp_like(A, np.random.default_rng(0))
+    eng = DeviceSetupEngine()
+    with telemetry.capture() as cap:
+        out = eng.galerkin_csr(A, P, dtype=np.float64, level=2,
+                               min_rows=10 ** 9)
+    assert out is None
+    evs = cap.events("device_setup_fallback")
+    assert len(evs) == 1
+    assert evs[0]["attrs"]["reason"] == "small"
+    assert evs[0]["attrs"]["level"] == 2
+    assert cap.counter_total("amgx_device_setup_fallback_total") == 1
+
+
+def test_disabled_knob_skips_engine_entirely():
+    """device_setup=0: the hierarchy never consults the engine — no
+    fallback events, bit-identical host path."""
+    reset_engine()
+    A = poisson2d(16)
+    with telemetry.capture() as cap:
+        _coarse_operators(A, ", amg:interpolator=D1, device_setup=0")
+    assert cap.events("device_setup_fallback") == []
+    assert cap.counter_total("amgx_device_rap_total") == 0
+
+
+# --------------------------------------------- unified ELL primitives
+def _ell_of(csr, width, n_rows=None):
+    """Dense (n, width) ELL (cols -1-padded) of a scipy csr."""
+    csr = sp.csr_matrix(csr)
+    n = n_rows or csr.shape[0]
+    cols = np.full((n, width), -1, np.int32)
+    vals = np.zeros((n, width), csr.dtype)
+    for i in range(csr.shape[0]):
+        sl = slice(csr.indptr[i], csr.indptr[i + 1])
+        k = sl.stop - sl.start
+        cols[i, :k] = csr.indices[sl]
+        vals[i, :k] = csr.data[sl]
+    return cols, vals
+
+
+def _scipy_of_ell(cols, vals, n_cols):
+    cols = np.asarray(cols)
+    vals = np.asarray(vals)
+    n, K = cols.shape
+    rows = np.repeat(np.arange(n), K).reshape(n, K)
+    live = (vals != 0) & (cols >= 0)
+    return sp.csr_matrix(
+        (vals[live], (rows[live], cols[live])), shape=(n, n_cols))
+
+
+def test_ell_spgemm_matches_scipy():
+    """The single unified ELL·ELL product that now backs both the AP
+    and RAP stages of the compact device pipeline."""
+    import jax.numpy as jnp
+    rs = np.random.RandomState(11)
+    A = sp.random(48, 48, 0.15, random_state=rs, format="csr") \
+        + sp.identity(48)
+    B = sp.random(48, 48, 0.12, random_state=rs, format="csr") \
+        + sp.identity(48)
+    A = sp.csr_matrix(A)
+    B = sp.csr_matrix(B)
+    A.sort_indices()
+    B.sort_indices()
+    Ka = int(np.diff(A.indptr).max())
+    Kb = int(np.diff(B.indptr).max())
+    ac, av = _ell_of(A, Ka)
+    bc, bv = _ell_of(B, Kb)
+    Kout = 64
+    oc, ov, kmax = spgemm.ell_spgemm_fn(48, Ka, Kb, Kout)(
+        jnp.asarray(ac), jnp.asarray(av), jnp.asarray(bc),
+        jnp.asarray(bv))
+    got = _scipy_of_ell(oc, ov, 48)
+    ref = sp.csr_matrix(A @ B)
+    ref.eliminate_zeros()
+    assert int(kmax) == int(np.diff(ref.indptr).max())
+    assert _rel_err(got, ref) <= RTOL
+    # self_pad epilogue: dead entries become self-loops with value 0,
+    # all-dead rows a unit diagonal — the coarse-operator conventions
+    oc2, ov2, _ = spgemm.ell_spgemm_fn(48, Ka, Kb, Kout,
+                                       self_pad=True)(
+        jnp.asarray(ac), jnp.asarray(av), jnp.asarray(bc),
+        jnp.asarray(bv))
+    assert int(jnp.min(oc2)) >= 0
+    assert _rel_err(_scipy_of_ell(oc2, ov2, 48), ref) <= RTOL
+
+
+def test_ell_transpose_matches_scipy():
+    import jax.numpy as jnp
+    rs = np.random.RandomState(5)
+    P = sp.random(40, 16, 0.2, random_state=rs, format="csr")
+    P = sp.csr_matrix(P)
+    P.sort_indices()
+    Kp = max(int(np.diff(P.indptr).max()), 1)
+    pc, pv = _ell_of(P, Kp)
+    rc, rv, maxdeg = spgemm.ell_transpose_fn(40, Kp, 16, 40)(
+        jnp.asarray(pc), jnp.asarray(pv))
+    R = _scipy_of_ell(rc, rv, 40)
+    ref = sp.csr_matrix(P.T)
+    ref.eliminate_zeros()
+    assert _rel_err(R, ref) <= RTOL
+    assert int(maxdeg) == int(np.diff(ref.indptr).max())
