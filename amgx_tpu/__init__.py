@@ -29,7 +29,10 @@ _jax.config.update("jax_enable_x64", True)
 # (documented in README; JAX creates the directory lazily at the first
 # persisted compile); the guard below never clobbers a cache dir the
 # host application configured before importing amgx_tpu.  Opt out with
-# AMGX_TPU_COMPILE_CACHE=0.
+# AMGX_TPU_COMPILE_CACHE=0.  The `compile_cache_dir` config knob (and
+# `aot_store_dir` — the explicit AOT executable store, serve/aot.py)
+# overrides this default per solver/service/Resources; see the README
+# "Zero cold-start" section.
 _cache_dir = _os.environ.get("AMGX_TPU_COMPILE_CACHE",
                              _os.path.expanduser("~/.cache/amgx_tpu_xla"))
 if _cache_dir not in ("0", "") and \
@@ -38,6 +41,11 @@ if _cache_dir not in ("0", "") and \
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     _jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # hit/miss accounting (utils/jaxcompat.py) rides along whenever the
+    # cache is active — compile_cache_stats() and the runstate file
+    # must count env-configured processes too, not just telemetry runs
+    from .utils.jaxcompat import install_compile_counter as _icc
+    _icc()
 
 __version__ = "0.1.0"
 #: reference parity target (ReleaseVersion.txt:1)

@@ -69,6 +69,30 @@ class ResourcesHandle:
         self.cfg = cfg
         self.comm = comm
         self.devices = devices or [device_num]
+        # zero cold-start knobs ride Resources creation — the first
+        # object every C driver builds — so the persistent compile
+        # cache and the AOT executable store are wired before any
+        # solver or service compiles (solver construction re-applies
+        # the same knobs idempotently for pure-python drivers)
+        try:
+            cache_dir = str(cfg.cfg.get("compile_cache_dir"))
+            aot_dir = str(cfg.cfg.get("aot_store_dir"))
+            if cache_dir:
+                from .utils.jaxcompat import enable_compilation_cache
+                enable_compilation_cache(cache_dir)
+            if aot_dir:
+                from .serve import aot as _aot
+                _aot.configure(aot_dir)
+            if cache_dir or aot_dir:
+                from .telemetry import runstate
+                runstate.configure_default(aot_dir or cache_dir)
+        except Exception as e:
+            # warm-start wiring must never fail create, but silently
+            # losing it would leave the operator cold-starting with no
+            # signal (the pure-python Solver path raises the same error)
+            from .utils.logging import error_output
+            error_output("AMGX warning: warm-start wiring failed "
+                         f"(compile cache / AOT store disabled): {e!r}\n")
 
 
 class MatrixHandle:
@@ -955,6 +979,23 @@ def AMGX_serve_wait(srv: ServiceHandle, ticket: int,
     if sol is not None:
         sol.data = np.asarray(res.x)
     return res.status, res.iterations
+
+
+@_catches(1)
+def AMGX_serve_warmup(srv: ServiceHandle, mtxs):
+    """Prefetch executables for the given uploaded matrices' patterns
+    off the request path (:meth:`SolveService.warmup`): session setup +
+    the power-of-two batch-bucket ladder, persisted through the
+    compile-cache/AOT knobs so the NEXT process starts warm.  ``mtxs``
+    is one :class:`MatrixHandle` or a sequence; returns the warmup
+    summary dict."""
+    handles = mtxs if isinstance(mtxs, (list, tuple)) else [mtxs]
+    mats = []
+    for h in handles:
+        if h.matrix is None:
+            raise BadParametersError("warmup matrix not uploaded")
+        mats.append(h.matrix)
+    return srv.service.warmup(mats)
 
 
 @_catches(1)

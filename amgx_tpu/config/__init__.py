@@ -251,6 +251,18 @@ class AMGConfig:
         for (scope, name), (value, new_scope) in sorted(self._params.items()):
             yield scope, name, value, new_scope
 
+    def stable_hash(self) -> str:
+        """Stable digest of every (scope, name) → value entry — two
+        configs that resolve identically hash equal regardless of the
+        source text's entry order.  Keys serving sessions
+        (serve/session.py) and the AOT executable store
+        (serve/aot.py)."""
+        import hashlib
+        items = sorted((scope, name, str(v), str(ns))
+                       for (scope, name), (v, ns) in self._params.items())
+        return hashlib.blake2b(repr(items).encode(),
+                               digest_size=12).hexdigest()
+
     def clone(self) -> "AMGConfig":
         cfg = AMGConfig()
         cfg._params = dict(self._params)

@@ -336,6 +336,20 @@ def register_default_parameters():
       "setup-cache byte budget bounding resident hierarchies")
     R("serve_deadline_ms", float, 0.0,
       "default per-request deadline in ms; 0 disables deadlines")
+    # zero cold-start (utils/jaxcompat.py + serve/aot.py): persistent
+    # XLA compile cache + AOT executable store, so a fresh process
+    # serves its first request without paying compilation.  Both knobs
+    # are directories; empty keeps the import-time env defaults
+    # (AMGX_TPU_COMPILE_CACHE / AMGX_TPU_AOT_STORE)
+    R("compile_cache_dir", str, "",
+      "persistent XLA compilation cache directory (disk-backs every "
+      "jit; an explicit value overrides the env default)")
+    R("aot_store_dir", str, "",
+      "AOT executable store directory: solve bodies, multi-RHS batch "
+      "buckets and spgemm setup plans are serialized/loaded here")
+    R("serve_warmup_max_batch", int, 0,
+      "warmup() prefetches batch buckets 1,2,4,.. up to this width "
+      "(0: up to serve_max_batch)")
 
 
 register_default_parameters()

@@ -284,6 +284,15 @@ def _galerkin_numeric_fn(nAP_b: int, nAc_b: int):
     return go
 
 
+def _aot_call(tag: str, jitted, args: tuple):
+    """Route one bucketed numeric executable through the AOT store
+    (serve/aot.py) when the warm-start layer is configured — a fresh
+    process then runs the setup plan without tracing OR compiling —
+    else call the jitted function directly."""
+    from ..serve import aot
+    return aot.aot_call(tag, jitted, args)
+
+
 def galerkin_numeric(plan: GalerkinPlan, vA, vP):
     """Device numeric pass: (A values, P values) → Ac values
     (device array of bucketed length; slots past ``plan.nnz_Ac`` are
@@ -295,9 +304,15 @@ def galerkin_numeric(plan: GalerkinPlan, vA, vP):
     vP = jnp.asarray(vP)
     vA_ext = _pad_vals_fn(plan.nnz_A, nA_b)(vA)
     vP_ext = _pad_vals_fn(plan.nnz_P, nP_b)(vP)
-    return _galerkin_numeric_fn(nAP_b, nAc_b)(
-        vA_ext, vP_ext, d["perm"], d["tA"], d["tP"], d["to1"],
-        d["tR"], d["tAP"], d["to2"])
+    # the OUTPUT buckets ride in the tag: nAP_b/nAc_b are segment_sum
+    # closure constants that appear in no argument shape, so the aval
+    # signature alone cannot distinguish two plans that differ only in
+    # output size — an aval-only key would reuse the wrong executable
+    return _aot_call(
+        f"spgemm_rap:{nAP_b}x{nAc_b}",
+        _galerkin_numeric_fn(nAP_b, nAc_b),
+        (vA_ext, vP_ext, d["perm"], d["tA"], d["tP"], d["to1"],
+         d["tR"], d["tAP"], d["to2"]))
 
 
 # --------------------------------------------------------- plain SpGEMM
@@ -367,8 +382,10 @@ def spgemm_numeric(plan: SpGEMMPlan, vA, vB):
     d = plan.device_arrays()
     vA_ext = _pad_vals_fn(plan.nnz_A, nA_b)(jnp.asarray(vA))
     vB_ext = _pad_vals_fn(plan.nnz_B, nB_b)(jnp.asarray(vB))
-    return _spgemm_numeric_fn(nC_b)(vA_ext, vB_ext, d["tA"], d["tB"],
-                                    d["to"])
+    # nC_b in the tag: a closure constant invisible to the aval key
+    # (see galerkin_numeric)
+    return _aot_call(f"spgemm:{nC_b}", _spgemm_numeric_fn(nC_b),
+                     (vA_ext, vB_ext, d["tA"], d["tB"], d["to"]))
 
 
 # ------------------------------------------------------- ELL primitives

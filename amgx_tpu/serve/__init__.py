@@ -18,7 +18,15 @@ inference server uses:
   split back out;
 * :mod:`.service` — :class:`SolveService`: bounded-queue admission
   (full ⇒ :data:`~amgx_tpu.errors.RC.REJECTED`), a batching dispatcher,
-  ``ThreadManager`` workers, per-request deadlines, graceful drain.
+  ``ThreadManager`` workers, per-request deadlines, graceful drain, and
+  :meth:`SolveService.warmup` — the bucket-ladder prefetch that makes a
+  fresh process request-ready off the request path;
+* :mod:`.aot` — :class:`AOTStore`: serialized XLA executables shared
+  across processes (the zero cold-start layer; keys and fallback rules
+  in its module doc);
+* :mod:`.loadgen` — open-loop Poisson load generator recording
+  p50/p95/p99 and rejection rate (the SLO harness behind
+  ``scripts/serve_load.py``).
 
 Metric names live under the versioned ``METRICS`` registry
 (``amgx_serve_*``); ``python -m amgx_tpu.telemetry.doctor`` summarises
@@ -28,6 +36,8 @@ reach the service through the ``AMGX_serve_*`` entry points in
 """
 from __future__ import annotations
 
+from . import aot
+from .aot import AOTStore
 from .batch import PendingSolve, SolveRequest, split_batches
 from .cache import SetupCache
 from .service import SolveService
@@ -36,5 +46,5 @@ from .session import SessionKey, SolverSession, config_hash, session_key
 __all__ = [
     "SolveService", "SetupCache", "SolverSession", "SessionKey",
     "SolveRequest", "PendingSolve", "split_batches", "config_hash",
-    "session_key",
+    "session_key", "aot", "AOTStore",
 ]

@@ -1,0 +1,372 @@
+"""AOT executable store: serialized XLA executables across processes.
+
+The persistent compilation cache (``compile_cache_dir``,
+``utils.jaxcompat.enable_compilation_cache``) removes the XLA *compile*
+from a warm process but still pays python tracing and lowering per
+executable.  This store removes those too for the hot, shape-stable
+executables — the bucketed solve bodies, the ``solve_multi`` batch
+buckets and the ``ops/spgemm.py`` setup-plan numeric passes — by
+``jit(...).lower(...).compile()``-ing them once, serializing the result
+(``jax.experimental.serialize_executable``) and loading the bytes in
+every later process.  The reference analog is AmgX shipping precompiled
+kernels: its setup never pays a JIT; a warmed store is how a TPU process
+gets the same property.
+
+Key anatomy (:func:`aot_key`): ``tag`` (which executable family:
+``solve`` / ``solve_multi`` / ``spgemm_rap:<buckets>`` /
+``spgemm:<bucket>`` — the spgemm tags carry their OUTPUT buckets, which
+are closure constants invisible to the aval signature), the config
+hash (solver stacks trace differently), the argument AVAL SIGNATURE —
+shapes/dtypes/pytree structure of every argument, which subsumes the
+pack kind, the size-bucket ladder position, the batch bucket and the
+dtype, because every device value rides as a jit argument in this
+codebase — and the backend fingerprint (platform + device kind + device
+count; the mesh identity).  jax/jaxlib versions are checked from the
+entry's meta at load instead of being mixed into the key, so an upgrade
+surfaces as a ``compile_cache_fallback`` event (reason ``version``)
+plus a normal compile, never as a crash or a silent miss.  A corrupt
+entry (truncated file, unpicklable payload) falls back the same way
+(reason ``corrupt``) and the entry is deleted.
+
+Store layout: one ``<key>.aotx`` pickle per executable —
+``{"blob": serialized, "meta": {...}}`` — written atomically
+(tmp + rename) so concurrent processes warming the same directory never
+observe half an entry.  ``amgx_aot_store_{bytes,entries}`` gauges track
+the footprint; loads/saves count into
+``amgx_compile_cache_{hits,misses}_total{layer="aot"}`` next to the
+XLA-cache layer.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .. import telemetry
+from ..utils import fsio, jaxcompat
+
+#: environment default for the store root (the config knob
+#: ``aot_store_dir`` overrides; empty/0 disables)
+ENV_STORE = "AMGX_TPU_AOT_STORE"
+
+_SUFFIX = ".aotx"
+
+
+def aot_key(tag: str, cfg_hash: str, args) -> str:
+    """Content key of one executable: tag + config hash + aval
+    signature + backend fingerprint, digested (the raw signature can be
+    kilobytes for a deep hierarchy's binding pytree)."""
+    raw = "|".join((tag, cfg_hash, jaxcompat.aval_signature(args),
+                    jaxcompat.backend_fingerprint()))
+    return f"{tag}-{hashlib.blake2b(raw.encode(), digest_size=16).hexdigest()}"
+
+
+def _fallback(reason: str, key: str = ""):
+    """Record one store fallback; the caller then compiles normally."""
+    if telemetry.is_enabled():
+        telemetry.event("compile_cache_fallback", reason=reason,
+                        key=key, layer="aot")
+        telemetry.counter_inc("amgx_compile_cache_fallbacks_total",
+                              reason=reason)
+
+
+class AOTStore:
+    """One directory of serialized executables + an in-memory cache of
+    the loaded callables (repeat lookups — every resetup re-runs the
+    spgemm numeric pass — must not re-unpickle)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._mem: dict = {}
+        self.loads = 0
+        self.saves = 0
+        self.misses = 0
+        self.fallbacks = 0
+        #: (key, reason) of the newest fallback — first stop when
+        #: debugging "why did this process compile anyway"
+        self.last_fallback = None
+        #: incremental footprint (seeded by one scan at first use):
+        #: save() must not rescan the whole directory per entry — a
+        #: bucket-ladder warmup would turn that into O(N²) stats on a
+        #: possibly-networked cache filesystem
+        self._disk = None
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key + _SUFFIX)
+
+    # ------------------------------------------------------------ lookup
+    def load(self, key: str) -> Optional[Callable]:
+        """The executable for ``key``, or None (miss / fallback).  A
+        version-mismatched or corrupt entry emits a
+        ``compile_cache_fallback`` event and returns None — the caller
+        compiles normally."""
+        with self._lock:
+            fn = self._mem.get(key)
+        if fn is not None:
+            # in-memory repeat — the normal warm in-process path, the
+            # moral twin of a jit cache hit: NOT counted as cache
+            # traffic (it would drown the cold/warm signal the doctor's
+            # hit-rate hint reads)
+            return fn
+        path = self._path(key)
+        if not os.path.exists(path):
+            with self._lock:
+                self.misses += 1
+            self._count("miss")
+            return None
+        try:
+            with open(path, "rb") as f:
+                entry = pickle.loads(f.read())
+            meta = entry["meta"]
+            blob = entry["blob"]
+        except Exception as e:      # truncated / unpicklable entry
+            with self._lock:
+                self.fallbacks += 1
+                self.last_fallback = (key, f"corrupt:{type(e).__name__}: {e}")
+            _fallback(f"corrupt:{type(e).__name__}", key)
+            try:
+                sz = os.stat(path).st_size
+                os.unlink(path)     # never trip on this entry again
+                with self._lock:
+                    if self._disk is not None:
+                        self._disk["entries"] -= 1
+                        self._disk["bytes"] -= sz
+            except OSError:
+                pass
+            return None
+        cur = jaxcompat.runtime_versions()
+        if (meta.get("jax"), meta.get("jaxlib")) != \
+                (cur["jax"], cur["jaxlib"]):
+            with self._lock:
+                self.fallbacks += 1
+                self.last_fallback = (key, "version")
+            _fallback("version", key)
+            return None
+        try:
+            fn = jaxcompat.deserialize_compiled(blob)
+        except Exception as e:
+            # a PROCESS-LOCAL refusal, not corruption — e.g. XLA CPU
+            # declines to re-deserialize when the process already
+            # JIT-compiled colliding fusion symbols ("Symbols not
+            # found").  A fresh process loads the same entry fine, so
+            # the file is KEPT; this process just compiles normally
+            with self._lock:
+                self.fallbacks += 1
+                self.last_fallback = (key,
+                                      f"deserialize:{type(e).__name__}: {e}")
+            _fallback(f"deserialize:{type(e).__name__}", key)
+            return None
+        with self._lock:
+            self._mem[key] = fn
+            self.loads += 1
+        self._count("hit")
+        return fn
+
+    def remember(self, key: str, compiled):
+        """Mem-only registration: an executable that could not be
+        PERSISTED (serialize failure, full/read-only store filesystem,
+        cache-served compile) must still be reused in-process — without
+        this, every later lookup would miss and re-run a full uncached
+        compile per call."""
+        with self._lock:
+            self._mem[key] = compiled
+
+    def save(self, key: str, compiled, meta: Optional[dict] = None
+             ) -> bool:
+        """Serialize ``compiled`` under ``key`` (atomic tmp + rename;
+        also populates the in-memory cache so the saving process reuses
+        the very executable it just compiled)."""
+        entry = {"blob": jaxcompat.serialize_compiled(compiled),
+                 "meta": dict(meta or (), created=time.time(),
+                              key=key,
+                              backend=jaxcompat.backend_fingerprint(),
+                              **jaxcompat.runtime_versions())}
+        data = pickle.dumps(entry, protocol=pickle.HIGHEST_PROTOCOL)
+        self.disk_stats()       # seed the incremental accounting once
+        path = self._path(key)
+        try:
+            old_bytes = os.stat(path).st_size
+            existed = True
+        except OSError:
+            old_bytes, existed = 0, False
+        try:
+            fsio.atomic_write(path, data)
+        except OSError:
+            return False
+        self._account_save(key, len(data), old_bytes, existed)
+        with self._lock:
+            self._mem[key] = compiled
+            self.saves += 1
+        self._gauges()
+        return True
+
+    # ------------------------------------------------------------- stats
+    def _count(self, result: str):
+        if telemetry.is_enabled():
+            telemetry.counter_inc(
+                "amgx_compile_cache_hits_total" if result == "hit"
+                else "amgx_compile_cache_misses_total", layer="aot")
+
+    def disk_stats(self, refresh: bool = False) -> dict:
+        """Entries/bytes of the store directory.  One real scan, then
+        incrementally maintained by save(); ``refresh=True`` forces a
+        rescan (external writers)."""
+        with self._lock:
+            if self._disk is not None and not refresh:
+                return dict(self._disk)
+        entries = 0
+        size = 0
+        try:
+            with os.scandir(self.root) as it:
+                for e in it:
+                    if e.name.endswith(_SUFFIX):
+                        entries += 1
+                        size += e.stat().st_size
+        except OSError:
+            pass
+        with self._lock:
+            self._disk = {"entries": entries, "bytes": size}
+            return dict(self._disk)
+
+    def _account_save(self, key: str, nbytes: int, old_bytes: int,
+                      existed: bool):
+        with self._lock:
+            if self._disk is None:
+                return          # next disk_stats() scans for real
+            if not existed:
+                self._disk["entries"] += 1
+            self._disk["bytes"] += nbytes - old_bytes
+
+    def _gauges(self):
+        if telemetry.is_enabled():
+            d = self.disk_stats()
+            telemetry.gauge_set("amgx_aot_store_bytes", d["bytes"])
+            telemetry.gauge_set("amgx_aot_store_entries", d["entries"])
+
+    def stats(self) -> dict:
+        with self._lock:
+            st = {"root": self.root, "loads": int(self.loads),
+                  "saves": int(self.saves), "misses": int(self.misses),
+                  "fallbacks": int(self.fallbacks),
+                  "resident": len(self._mem)}
+        st.update(self.disk_stats())
+        return st
+
+
+# ------------------------------------------------------- process store
+_STORE: Optional[AOTStore] = None
+_STORE_LOCK = threading.Lock()
+_env_checked = False
+
+
+def configure(root: Optional[str]) -> Optional[AOTStore]:
+    """Point the process-wide store at ``root`` (the ``aot_store_dir``
+    config knob).  Empty/None leaves the current store; a differing root
+    replaces it (in-memory executables are per-store)."""
+    global _STORE
+    if not root:
+        return _STORE
+    with _STORE_LOCK:
+        if _STORE is None or _STORE.root != os.path.abspath(root):
+            _STORE = AOTStore(root)
+        return _STORE
+
+
+def get_store() -> Optional[AOTStore]:
+    """The process-wide store, or None when nothing configured it (the
+    ``AMGX_TPU_AOT_STORE`` env var seeds it for child processes —
+    bench's warm-start probe, the cross-process tier-1 test)."""
+    global _env_checked
+    if _STORE is None and not _env_checked:
+        _env_checked = True
+        root = os.environ.get(ENV_STORE, "")
+        if root not in ("", "0"):
+            return configure(root)
+    return _STORE
+
+
+def reset_store():
+    """Forget the process store (test isolation; files stay on disk)."""
+    global _STORE, _env_checked
+    with _STORE_LOCK:
+        _STORE = None
+        _env_checked = False
+
+
+def store_stats() -> Optional[dict]:
+    """Stats of the live store, or None (import- and cost-free when the
+    warm-start layer is unused)."""
+    return _STORE.stats() if _STORE is not None else None
+
+
+# --------------------------------------------------------- compilation
+def aot_compile(tag: str, fn: Callable, args: tuple, *,
+                cfg_hash: str = "", meta: Optional[dict] = None,
+                store: Optional[AOTStore] = None) -> Callable:
+    """The executable for ``fn(*args)``: loaded from the store when a
+    compatible entry exists, else ``jit(fn).lower(*args).compile()``-d
+    and saved.  With no store configured (or on any store error) this
+    degrades to plain ``jax.jit(fn)`` — the persistent compilation
+    cache still removes the XLA compile there.
+
+    ``fn`` may already be a jitted callable (it is lowered as-is).  The
+    returned callable requires the argument shapes/dtypes it was keyed
+    on — exactly what the bucketed callers guarantee."""
+    import jax
+    store = store if store is not None else get_store()
+    jit_fn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    if store is None:
+        return jit_fn
+    try:
+        key = aot_key(tag, cfg_hash, args)
+    except Exception as e:          # exotic arg pytree — never fatal
+        _fallback(f"key:{type(e).__name__}")
+        return jit_fn
+    hit = store.load(key)
+    if hit is not None:
+        return hit
+    # a GENUINE compile (persistent XLA cache scoped off): a
+    # cache-loaded executable serializes into a permanently broken
+    # blob on XLA CPU — see jaxcompat.compile_uncached.  A compile
+    # failure propagates: it is a real error, not a cache condition.
+    hits0 = jaxcompat.thread_cache_hits()
+    compiled = jaxcompat.compile_uncached(jit_fn, args)
+    if jaxcompat.thread_cache_hits() > hits0:
+        # a concurrent jit on another thread flipped jax's global
+        # cache verdict back on mid-compile and OUR compile was served
+        # from the cache — its serialization would be permanently
+        # broken, so keep it process-local and leave the store slot
+        # empty for a later genuine compile
+        _fallback("xla-cache-hit", key)
+        store.remember(key, compiled)
+        return compiled
+    try:
+        if not store.save(key, compiled,
+                          dict(meta or (), tag=tag, cfg=cfg_hash)):
+            # write failure (full / read-only store filesystem): keep
+            # the executable in-process so later calls don't re-run an
+            # uncached compile each time
+            _fallback("save-failed", key)
+            store.remember(key, compiled)
+    except Exception as e:          # an unserializable executable
+        # (host callbacks, exotic custom calls): this process still
+        # uses the compiled result, later processes compile afresh
+        _fallback(f"serialize:{type(e).__name__}", key)
+        store.remember(key, compiled)
+    return compiled
+
+
+def aot_call(tag: str, jitted: Callable, args: tuple, *,
+             cfg_hash: str = "") -> Any:
+    """Call helper for hot bucketed executables (the spgemm numeric
+    passes): routes through :func:`aot_compile` when a store is
+    configured, else straight through ``jitted``.  The store's
+    in-memory cache makes the per-call overhead one key digest."""
+    if get_store() is None:
+        return jitted(*args)
+    return aot_compile(tag, jitted, args, cfg_hash=cfg_hash)(*args)

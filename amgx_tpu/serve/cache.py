@@ -25,6 +25,25 @@ from ..core.matrix import Matrix
 from .session import SessionKey, SolverSession, session_key
 
 
+#: process totals across every SetupCache instance — what the runstate
+#: file (telemetry/runstate.py) folds so cache efficacy survives
+#: restarts (per-instance counters die with their service).  Guarded by
+#: a module lock: instances increment under their OWN locks, so two
+#: services' read-modify-writes would otherwise race.
+_TOTALS = {"hits": 0, "misses": 0, "evictions": 0}
+_TOTALS_LOCK = threading.Lock()
+
+
+def _totals_inc(key: str):
+    with _TOTALS_LOCK:
+        _TOTALS[key] += 1
+
+
+def cache_totals() -> dict:
+    with _TOTALS_LOCK:
+        return dict(_TOTALS)
+
+
 class SetupCache:
     def __init__(self, max_bytes: int = 1 << 30):
         self.max_bytes = int(max_bytes)
@@ -49,9 +68,11 @@ class SetupCache:
             if s is not None:
                 self._sessions.move_to_end(key)
                 self.hits += 1
+                _totals_inc("hits")
                 telemetry.counter_inc("amgx_serve_cache_hits_total")
                 return s, False
             self.misses += 1
+            _totals_inc("misses")
             telemetry.counter_inc("amgx_serve_cache_misses_total")
             s = SolverSession(key, cfg)
             self._sessions[key] = s
@@ -80,6 +101,7 @@ class SetupCache:
                 del self._sessions[key]
                 total -= victim.bytes
                 self.evictions += 1
+                _totals_inc("evictions")
                 telemetry.counter_inc("amgx_serve_cache_evictions_total")
             telemetry.gauge_set("amgx_serve_cache_bytes", total)
             return total

@@ -1,0 +1,119 @@
+"""Open-loop load generator: the serving layer's SLO harness.
+
+Closed-loop benchmarks (fire N, wait N) hide overload: a slow server
+slows the generator down with it, so the measured latency flatters.
+This generator is **open-loop** — arrivals follow a Poisson process at
+the offered rate regardless of completions (the standard SLO
+methodology), so queueing delay, deadline sheds and admission
+rejections show up exactly as a production client would see them.
+
+Traffic shape: each arrival picks one of the given operator patterns
+(uniformly) and, with ``multi_rhs_frac`` probability, carries a burst
+of 2..``max_rhs`` same-operator right-hand sides submitted
+back-to-back — the shape the micro-batcher
+(:func:`~amgx_tpu.serve.batch.split_batches`) exists to exploit.
+
+Reported numbers: offered/accepted/rejected/completed counts, the
+rejection rate, p50/p95/p99 of completed-request latency
+(submit → result, measured by the service), achieved throughput, and
+the generator's own schedule slip (a slipping generator means the
+HARNESS saturated, not the server — the numbers are then a lower bound
+on the offered load).  ``scripts/serve_load.py`` is the CLI;
+``bench.py`` embeds a short run in its serving block.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+from ..errors import RC
+from .service import SolveService
+
+
+def run_load(service: SolveService, patterns: Sequence, *,
+             rps: float = 20.0, duration_s: float = 2.0,
+             multi_rhs_frac: float = 0.25, max_rhs: int = 4,
+             seed: int = 0, wait_timeout_s: float = 300.0) -> dict:
+    """Drive ``service`` with open-loop Poisson arrivals over
+    ``patterns`` (prepared :class:`~amgx_tpu.core.matrix.Matrix`
+    handles) and return the SLO summary dict.
+
+    The caller should warm the service first (``service.warmup``) when
+    steady-state numbers are wanted — a cold run measures compilation,
+    which is a different (and separately benchmarked) story."""
+    rng = np.random.default_rng(seed)
+    patterns = list(patterns)
+    if not patterns:
+        raise ValueError("run_load needs at least one pattern")
+    sizes = [int(m.shape[0]) for m in patterns]
+    # pre-generate the arrival schedule and payloads: the generator
+    # loop must be all sleep+submit, or IT becomes the bottleneck
+    arrivals: List[float] = []
+    t = 0.0
+    while t < duration_s:
+        t += float(rng.exponential(1.0 / max(rps, 1e-9)))
+        if t < duration_s:
+            arrivals.append(t)
+    plan = []
+    for _ in arrivals:
+        pi = int(rng.integers(len(patterns)))
+        k = int(rng.integers(2, max_rhs + 1)) \
+            if max_rhs >= 2 and rng.random() < multi_rhs_frac else 1
+        plan.append((pi, rng.standard_normal((k, sizes[pi]))))
+
+    service.reset_latency_stats()
+    pend = []
+    max_slip = 0.0
+    t0 = time.monotonic()
+    for t_arr, (pi, B) in zip(arrivals, plan):
+        now = time.monotonic() - t0
+        if now < t_arr:
+            time.sleep(t_arr - now)
+        else:
+            max_slip = max(max_slip, now - t_arr)
+        m = patterns[pi]
+        for row in B:           # a burst: same operator, k RHS
+            pend.append(service.submit(m, row))
+    gen_wall = time.monotonic() - t0
+
+    rejected = completed = failed = 0
+    for p in pend:
+        if p.rc == RC.REJECTED:
+            rejected += 1
+            continue
+        res = p.wait(wait_timeout_s)
+        if p.rc == RC.REJECTED:     # deadline shed after admission
+            rejected += 1
+        elif p.rc == RC.OK and res is not None:
+            completed += 1
+        else:
+            failed += 1
+    wall = time.monotonic() - t0
+    lat = service.latency_percentiles()
+    offered = len(pend)
+
+    def ms(v):
+        return round(v * 1e3, 2) if isinstance(v, (int, float)) else None
+
+    return {
+        "offered": offered,
+        "offered_rps": round(offered / duration_s, 1),
+        "duration_s": round(duration_s, 3),
+        "patterns": len(patterns),
+        "multi_rhs_frac": multi_rhs_frac,
+        "completed": completed,
+        "rejected": rejected,
+        "failed": failed,
+        "rejection_rate": round(rejected / offered, 4) if offered else 0.0,
+        "achieved_rps": round(completed / wall, 1) if wall else None,
+        "p50_ms": ms(lat["p50"]),
+        "p95_ms": ms(lat["p95"]),
+        "p99_ms": ms(lat["p99"]),
+        "gen_wall_s": round(gen_wall, 3),
+        "wall_s": round(wall, 3),
+        #: worst lag of the generator behind its schedule — nonzero
+        #: means the harness couldn't offer the full rate
+        "max_slip_s": round(max_slip, 4),
+    }

@@ -186,6 +186,60 @@ class SolveService:
                             p.rc if p.rc != RC.OK else RC.UNKNOWN)
         return res
 
+    # -------------------------------------------------------------- warmup
+    def warmup(self, patterns, max_batch: Optional[int] = None) -> dict:
+        """Prefetch the executables a request wave would otherwise pay
+        for, OFF the request path: for each operator pattern, prepare
+        its session (full setup — hierarchy, packs, setup-plan
+        executables) and compile the solve bodies for the power-of-two
+        batch-bucket ladder (1, 2, 4, … ``serve_warmup_max_batch`` or
+        ``serve_max_batch``).  With ``compile_cache_dir`` /
+        ``aot_store_dir`` configured this both *loads* whatever a
+        previous process persisted and *persists* whatever it still had
+        to compile — the first warmed process pays the compiles once,
+        every later process starts in milliseconds.
+
+        ``patterns``: one :class:`~amgx_tpu.core.matrix.Matrix` or an
+        iterable of them (one per distinct sparsity pattern the service
+        expects).  Returns a summary dict; also emitted as a
+        ``serve_warmup`` telemetry event."""
+        import numpy as np
+        if isinstance(patterns, Matrix):
+            patterns = [patterns]
+        mb = int(max_batch) if max_batch else \
+            (int(self.cfg.get("serve_warmup_max_batch"))
+             or self.max_batch)
+        # ladder reaches the next power of two ≥ max_batch: a full
+        # batch of a non-power-of-two max_batch pads UP to that bucket
+        # (solve_multi pad_to_bucket), which must be warmed too
+        ladder = [1]
+        while ladder[-1] < max(1, mb):
+            ladder.append(ladder[-1] * 2)
+        t0 = time.monotonic()
+        details = []
+        for m in patterns:
+            sess, _created = self.cache.get_or_create(self.cfg, m)
+            with sess.lock:
+                kind = sess.prepare(m)
+                n = int(m.shape[0])
+                for w in ladder:
+                    # zero RHS converge at iteration 0 — the while_loop
+                    # body still traces/compiles for this bucket width
+                    # (w == 1 compiles the single-RHS solve body)
+                    sess.solver.solve_multi(np.zeros((w, n)))
+            self.cache.account(sess)
+            details.append({"pattern": sess.key.pattern,
+                            "prepare": kind})
+        wall = time.monotonic() - t0
+        from . import aot
+        summary = {"patterns": len(details), "buckets": ladder,
+                   "seconds": round(wall, 4), "details": details,
+                   "aot": aot.store_stats()}
+        telemetry.event("serve_warmup", patterns=len(details),
+                        buckets=len(ladder), seconds=wall)
+        telemetry.hist_observe("amgx_serve_warmup_seconds", wall)
+        return summary
+
     # ------------------------------------------------------------- dispatch
     def _dispatch_loop(self):
         while True:
@@ -276,6 +330,7 @@ class SolveService:
         # executables — surface the plan-cache hit rate next to the
         # session cache it multiplies
         from ..amg.device_setup import engine_stats
+        from . import aot
         return {
             "submitted": submitted,
             "completed": completed,
@@ -287,4 +342,7 @@ class SolveService:
             "latency_s": self.latency_percentiles(),
             "cache": self.cache.stats(),
             "device_setup": engine_stats(),
+            # warm-start layer: AOT executable store traffic (None when
+            # unconfigured) — the cold-start twin of the session cache
+            "aot": aot.store_stats(),
         }

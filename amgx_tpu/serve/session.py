@@ -21,7 +21,6 @@ worker pool.
 from __future__ import annotations
 
 import dataclasses
-import hashlib
 import threading
 import time
 from typing import List, Optional
@@ -31,13 +30,11 @@ from ..core.matrix import Matrix
 
 
 def config_hash(cfg: AMGConfig) -> str:
-    """Stable digest of every (scope, name) → value entry — two configs
-    that resolve identically share sessions regardless of the source
-    text's entry order."""
-    items = sorted((scope, name, str(v), str(ns))
-                   for (scope, name), (v, ns) in cfg._params.items())
-    return hashlib.blake2b(repr(items).encode(),
-                           digest_size=12).hexdigest()
+    """Stable digest of the resolved config — two configs that resolve
+    identically share sessions (and AOT executables) regardless of the
+    source text's entry order.  Canonical implementation:
+    :meth:`AMGConfig.stable_hash`."""
+    return cfg.stable_hash()
 
 
 @dataclasses.dataclass(frozen=True)

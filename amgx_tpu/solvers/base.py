@@ -188,6 +188,24 @@ class Solver:
         # telemetry recorder — phase records live in the same ring)
         if int(g("setup_profile")):
             telemetry.setup_profile.enable()
+        # zero cold-start (utils/jaxcompat.py + serve/aot.py): an
+        # explicit compile_cache_dir disk-backs every jit in the stack;
+        # aot_store_dir additionally serializes the hot executables so
+        # a fresh process skips tracing too.  Idempotent and knob-gated
+        # — solvers built without the knobs keep the import-time env
+        # defaults untouched
+        cache_dir = str(g("compile_cache_dir"))
+        if cache_dir:
+            from ..utils.jaxcompat import enable_compilation_cache
+            enable_compilation_cache(cache_dir)
+        aot_dir = str(g("aot_store_dir"))
+        if aot_dir:
+            from ..serve import aot as _aot
+            _aot.configure(aot_dir)
+        if cache_dir or aot_dir:
+            # cumulative cache-efficacy counters survive restarts in a
+            # state file next to the warm-start artifacts
+            telemetry.runstate.configure_default(aot_dir or cache_dir)
         # an EXPLICIT verbosity_level drives the level-gated output
         # stream; the registry default must not clobber a verbosity the
         # host application set programmatically
@@ -587,10 +605,20 @@ class Solver:
                 # tolerances compare against REAL norms (complex modes)
                 rdt = np.zeros((), dtype).real.dtype
                 with ctx:
-                    x, stats, history = self._solve_fn(
-                        self._bindings.collect(), b, x0,
-                        jnp.asarray(self.tolerance, rdt),
-                        jnp.asarray(self.max_iters, jnp.int32))
+                    # the scalar operands are created INSIDE the pin
+                    # context — built outside they would land on the
+                    # default device and ship per solve
+                    call_args = (self._bindings.collect(), b, x0,
+                                 jnp.asarray(self.tolerance, rdt),
+                                 jnp.asarray(self.max_iters, jnp.int32))
+                    fn = self._solve_fn
+                    if pin is None and not dist:
+                        # warm-start layer: load/compile-and-save the
+                        # AOT executable for these shapes (no-op
+                        # without a configured store); pinned/sharded
+                        # packs keep jit
+                        fn = self._maybe_aot("solve", fn, call_args)
+                    x, stats, history = fn(*call_args)
                 # ONE small host fetch for (iters, norms) — per-transfer
                 # cost dominates on remote-attached TPUs
                 stats = np.asarray(stats)
@@ -645,6 +673,54 @@ class Solver:
         return SolveResult(x=x, iterations=iters, status=status,
                            residual_norm=nrm, residual_history=history_np,
                            setup_time=self.setup_time, solve_time=solve_time)
+
+    def _maybe_aot(self, tag: str, jit_fn: Callable, args: tuple
+                   ) -> Callable:
+        """The AOT-store executable for ``jit_fn(*args)`` when the
+        warm-start layer is configured and this solve path serializes
+        cleanly; else ``jit_fn`` unchanged.  Serialization gates:
+        forensics inserts ``jax.debug.callback``s (host callbacks do
+        not survive serialization across processes), so instrumented
+        solves keep the plain jit path — the persistent compilation
+        cache still covers their XLA compile."""
+        if self.forensics:
+            return jit_fn
+        try:
+            from ..serve import aot
+            if aot.get_store() is None:
+                return jit_fn
+            # per-solve memo, living ON the bindings object: the full
+            # key digests the whole bindings pytree (kilobytes for a
+            # deep hierarchy) — too costly per warmed millisecond-class
+            # solve.  Binding avals are fixed for a bindings object's
+            # lifetime (a structural rebuild replaces it), so (tag, RHS
+            # shape/dtype) identifies the executable within it.
+            memo = getattr(self._bindings, "_aot_memo", None)
+            if memo is None:
+                memo = self._bindings._aot_memo = {}
+            rhs = args[1]
+            mk = (tag, getattr(rhs, "shape", None),
+                  str(getattr(rhs, "dtype", "")))
+            hit = memo.get(mk)
+            if hit is not None:
+                return hit
+            if not hasattr(self, "_aot_cfg_hash"):
+                self._aot_cfg_hash = self.cfg.stable_hash()
+            from ..core.matrix import pack_kind
+            meta = {"solver": self.config_name, "scope": self.scope,
+                    "pack": pack_kind(self.Ad) if self.Ad is not None
+                    else None,
+                    "n_rows": int(self.Ad.n_rows)
+                    if self.Ad is not None else None,
+                    "dtype": str(self.Ad.dtype)
+                    if self.Ad is not None else None}
+            fn = aot.aot_compile(
+                f"{tag}:{self.config_name}:{self.scope}", jit_fn, args,
+                cfg_hash=self._aot_cfg_hash, meta=meta)
+            memo[mk] = fn
+            return fn
+        except Exception:   # the warm-start layer must never break solve
+            return jit_fn
 
     def _packed_solve_fn(self) -> Callable:
         """The solve body with (iters, nrm, nrm_ini) packed into one f64
@@ -769,10 +845,14 @@ class Solver:
                             scope=self.scope, batch=k), \
                 cpu_profiler(f"solve_multi:{self.config_name}"):
             rdt = np.zeros((), dtype).real.dtype
-            X, stats, history = fn(
-                bindings.collect(), Bd, X0d,
-                jnp.asarray(self.tolerance, rdt),
-                jnp.asarray(self.max_iters, jnp.int32))
+            call_args = (bindings.collect(), Bd, X0d,
+                         jnp.asarray(self.tolerance, rdt),
+                         jnp.asarray(self.max_iters, jnp.int32))
+            # warm-start layer: each batch bucket (Bd's leading dim) is
+            # its own AOT executable — the serving micro-batcher's
+            # power-of-two padding keeps that set log2(max_batch)-sized
+            X, stats, history = self._maybe_aot(
+                "solve_multi", fn, call_args)(*call_args)
             stats = np.asarray(stats)      # ONE host fetch: (k, 1+2m)
         solve_time = time.perf_counter() - t0
         Xh = None

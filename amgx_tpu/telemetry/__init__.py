@@ -35,7 +35,7 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 from __future__ import annotations
 
 from . import (costmodel, export, forensics, metrics, recorder,
-               setup_profile, tracefile)
+               runstate, setup_profile, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -56,7 +56,7 @@ __all__ = [
     "validate_record", "validate_jsonl",
     "read_sessions", "aggregate_sessions",
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
-    "costmodel", "forensics", "setup_profile",
+    "costmodel", "forensics", "setup_profile", "runstate",
     "reset",
 ]
 

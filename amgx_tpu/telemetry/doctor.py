@@ -309,6 +309,34 @@ def diagnose(paths: List[str]) -> dict:
                        if r["kind"] == "event"
                        and r["name"] == "device_setup_fallback"]
 
+    # ---- warm-start layer (compile cache + AOT store) ---------------
+    cc_hits, cc_hits_by = csum("amgx_compile_cache_hits_total")
+    cc_miss, cc_miss_by = csum("amgx_compile_cache_misses_total")
+    cc_fb, cc_fb_by = csum("amgx_compile_cache_fallbacks_total")
+    compile_cache = None
+    if cc_hits or cc_miss or cc_fb:
+        lookups = cc_hits + cc_miss
+        # the compile-share hint reasons about XLA compiles, so its
+        # rate must be the XLA layer's own — a warm AOT store next to
+        # a cold XLA cache would otherwise read as "loads dominate"
+        xla_hits, _ = csum("amgx_compile_cache_hits_total", layer="xla")
+        xla_miss, _ = csum("amgx_compile_cache_misses_total",
+                           layer="xla")
+        xla_lk = xla_hits + xla_miss
+        compile_cache = {
+            "hits": int(cc_hits), "misses": int(cc_miss),
+            "fallbacks": int(cc_fb),
+            "hit_rate": round(cc_hits / lookups, 4) if lookups else None,
+            "xla_hit_rate": (round(xla_hits / xla_lk, 4)
+                             if xla_lk else None),
+            "hits_by_layer": {k: int(v)
+                              for k, v in sorted(cc_hits_by.items())},
+            "misses_by_layer": {k: int(v)
+                                for k, v in sorted(cc_miss_by.items())},
+            "fallbacks_by_reason": {k: int(v)
+                                    for k, v in sorted(cc_fb_by.items())},
+        }
+
     # ---- hints ------------------------------------------------------
     hints: List[str] = []
     if agg["dropped_records"]:
@@ -360,7 +388,16 @@ def diagnose(paths: List[str]) -> dict:
         hints.append(f"{int(divergences)} divergence event(s): a "
                      "residual went non-finite")
     hints.extend(_forensics_hints(fr))
-    hints.extend(_setup_hints(setup, setup_fallbacks))
+    hints.extend(_setup_hints(setup, setup_fallbacks, compile_cache))
+    if compile_cache and compile_cache["fallbacks"]:
+        reasons = ", ".join(
+            f"{k}: {v}" for k, v
+            in compile_cache["fallbacks_by_reason"].items())
+        hints.append(
+            f"{compile_cache['fallbacks']} AOT-store fallback(s) "
+            f"({reasons}) — version-mismatched entries recompile "
+            "cleanly; re-warm the store after jaxlib upgrades, delete "
+            "it if corruption repeats")
     jit, _ = csum("amgx_jit_compile_total")
     if jit:
         hints.append(f"{int(jit)} XLA recompiles in-trace — if these "
@@ -411,6 +448,7 @@ def diagnose(paths: List[str]) -> dict:
         "forensics": fr,
         "setup": setup,
         "setup_fallbacks": setup_fallbacks,
+        "compile_cache": compile_cache,
         "hints": hints,
     }
 
@@ -518,15 +556,17 @@ _BENIGN_FALLBACKS = ("small", "disabled")
 
 
 def _setup_hints(setup: Optional[dict],
-                 setup_fallbacks: Optional[List[dict]] = None
-                 ) -> List[str]:
+                 setup_fallbacks: Optional[List[dict]] = None,
+                 compile_cache: Optional[dict] = None) -> List[str]:
     """Actionable setup-attribution hints (telemetry/setup_profile.py):
-    compile-bound setups earn the persistent-cache/AOT advice,
-    host-dominated classical components point at the device-side setup
-    engine (amg/device_setup/) — or, when its ``device_rap``/``spgemm``
-    phases are present, at the specific levels that FELL BACK to the
-    host path (with the recorded reason); chatty transfers point at
-    batching."""
+    compile-bound setups earn warm-start advice REFINED by the
+    compile-cache hit rate when the trace carries it (``warmup`` is
+    only suggested when misses dominate — a hitting cache with a high
+    compile share is a different problem), host-dominated classical
+    components point at the device-side setup engine
+    (amg/device_setup/) — or, when its ``device_rap``/``spgemm`` phases
+    are present, at the specific levels that FELL BACK to the host path
+    (with the recorded reason); chatty transfers point at batching."""
     if not setup:
         return []
     from .setup_profile import (COMPILE_HINT, DOMINANT_HINT,
@@ -541,10 +581,34 @@ def _setup_hints(setup: Optional[dict],
         cshare = min(((s.get("compile_s") or 0.0)
                       + (s.get("worker_compile_s") or 0.0)) / total, 1.0)
         if cshare >= COMPILE_HINT:
-            hints.append(
-                f"compile is {cshare:.0%} of setup → enable the "
-                "persistent compilation cache / AOT-lower the setup "
-                "executables so reruns skip it")
+            cc = compile_cache or {}
+            # per-layer: the XLA rate answers "did the compiles this
+            # share measures hit the cache"; the combined rate only
+            # serves when no XLA-layer traffic was recorded
+            rate = cc.get("xla_hit_rate")
+            if rate is None:
+                rate = cc.get("hit_rate")
+            if rate is None:
+                hints.append(
+                    f"compile is {cshare:.0%} of setup → set "
+                    "compile_cache_dir (persistent compilation cache) "
+                    "+ aot_store_dir, then warm up (scripts/warmup.py "
+                    "/ SolveService.warmup) so reruns skip it")
+            elif rate < 0.5:
+                hints.append(
+                    f"compile is {cshare:.0%} of setup and the compile "
+                    f"cache hit only {rate:.0%} of lookups → this "
+                    "process ran COLD: warm up at start "
+                    "(scripts/warmup.py / SolveService.warmup / "
+                    "AMGX_serve_warmup) so the next one loads instead "
+                    "of compiling")
+            else:
+                hints.append(
+                    f"compile is {cshare:.0%} of setup despite a "
+                    f"{rate:.0%} compile-cache hit rate — executable "
+                    "LOADS dominate; route the remaining hot bodies "
+                    "through the AOT store (aot_store_dir) to skip "
+                    "tracing too")
         tshare = (s.get("transfer_s") or 0.0) / total
         if tshare >= TRANSFER_HINT:
             hints.append(
@@ -737,6 +801,20 @@ def render(d: dict) -> str:
     setup = d.get("setup")
     if setup:
         L.extend(_render_setup(setup))
+    cc = d.get("compile_cache")
+    if cc:
+        L.append("")
+        L.append("warm start (compile cache + AOT store)")
+        L.append("-" * 40)
+        rate = cc.get("hit_rate")
+        L.append(f"  lookups: {cc['hits'] + cc['misses']}  hits: "
+                 f"{cc['hits']}  misses: {cc['misses']}"
+                 + (f"  (hit rate {rate:.0%})"
+                    if isinstance(rate, (int, float)) else ""))
+        for k, v in cc.get("hits_by_layer", {}).items():
+            L.append(f"  hits {k:<28} {v}")
+        for k, v in cc.get("fallbacks_by_reason", {}).items():
+            L.append(f"  FALLBACK {k:<24} {v}")
     fbs = d.get("setup_fallbacks")
     if fbs:
         L.append("")

@@ -53,12 +53,26 @@ def _meta_record() -> dict:
     processes — whose ``perf_counter`` epochs are unrelated — can be
     aligned onto one wall-clock timeline; ``dropped`` is the cumulative
     ring-overflow count so a truncated trace is detectable."""
-    return {"kind": "meta", "name": "amgx-telemetry",
-            "schema": recorder.SCHEMA_VERSION,
-            "pid": os.getpid(), "session": _SESSION_ID,
-            "host": socket.gethostname(),
-            "t_perf": time.perf_counter(), "t_unix": time.time(),
-            "dropped": recorder.dropped_count()}
+    rec = {"kind": "meta", "name": "amgx-telemetry",
+           "schema": recorder.SCHEMA_VERSION,
+           "pid": os.getpid(), "session": _SESSION_ID,
+           "host": socket.gethostname(),
+           "t_perf": time.perf_counter(), "t_unix": time.time(),
+           "dropped": recorder.dropped_count()}
+    # cumulative cache-efficacy counters (telemetry/runstate.py):
+    # in-process cache stats die with the process, so the meta header
+    # carries the CROSS-RESTART totals — what lets bench_trend.py (and
+    # any trace reader) judge warm-start efficacy across rounds.
+    # Folding here also keeps the state file fresh without a separate
+    # write path.  Absent when no warm-start dir is configured.
+    try:
+        from . import runstate
+        cum = runstate.fold()
+        if cum and cum.get("counters"):
+            rec["cum"] = dict(cum["counters"])
+    except Exception:
+        pass        # observability must never break a flush
+    return rec
 
 
 _NONFINITE = {"NaN": math.nan, "Infinity": math.inf,
@@ -137,6 +151,12 @@ def validate_record(rec: dict):
         if rec["name"] == "setup_profile":
             need(isinstance(rec["attrs"].get("wall_s"), (int, float)),
                  "setup_profile summary missing wall_s")
+        if rec["name"] == "compile_cache_fallback":
+            # warm-start fallbacks are the doctor's "why did this
+            # process compile anyway" input (serve/aot.py)
+            need(isinstance(rec["attrs"].get("reason"), str)
+                 and rec["attrs"]["reason"],
+                 "compile_cache_fallback event missing reason")
         if rec["name"] == "device_setup_fallback":
             # fallback events are the doctor's per-level "why did rap
             # run host-side" input (amg/device_setup/)

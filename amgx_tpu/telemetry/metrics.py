@@ -166,6 +166,24 @@ METRICS: Dict[str, Tuple[str, str]] = {
                     "{kind=full|resetup|reuse}"),
     "amgx_worker_task_failures_total":
         ("counter", "worker-pool tasks that raised (pool survives)"),
+    # ---- zero cold-start (utils/jaxcompat.py + serve/aot.py) --------
+    "amgx_compile_cache_hits_total":
+        ("counter", "executable loads that skipped compilation "
+                    "{layer=xla|aot}"),
+    "amgx_compile_cache_misses_total":
+        ("counter", "executable lookups that had to compile "
+                    "{layer=xla|aot}"),
+    "amgx_compile_cache_fallbacks_total":
+        ("counter", "AOT-store entries unusable at load (version "
+                    "mismatch, corruption, serialize failure) {reason}"),
+    "amgx_aot_store_bytes":
+        ("gauge", "serialized-executable bytes resident in the AOT "
+                  "store directory"),
+    "amgx_aot_store_entries":
+        ("gauge", "executables resident in the AOT store directory"),
+    "amgx_serve_warmup_seconds":
+        ("histogram", "wall seconds of one SolveService.warmup "
+                      "prefetch"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)

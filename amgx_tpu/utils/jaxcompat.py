@@ -11,12 +11,28 @@ machinery swaps tracers into those slots.
 ``install_compile_counter`` hooks ``jax.monitoring`` duration events to
 count jit cache misses for the telemetry registry: a retrace fires
 ``.../jaxpr_trace_duration`` (python-cache miss), an actual XLA backend
-compile fires ``.../backend_compile_duration`` (persistent-compile-
-cache hits do NOT fire it, matching what "recompile" means
-operationally).  The listener is process-wide and permanent — JAX has
-no unregister — so it is a no-op unless telemetry is enabled.
+compile fires ``.../backend_compile_duration``.  NOTE (measured on the
+pinned jax): the backend-compile duration event wraps
+``compile_or_get_cached`` and therefore fires on persistent-compile-
+cache HITS too — the cache-event listener below flags those per thread
+so a warm-cache executable load is counted as
+``amgx_compile_cache_hits_total``, NOT as an ``amgx_jit_compile_total``
+recompile (which is the operational meaning callers assert on, e.g. the
+cross-process zero-recompile test).  The listeners are process-wide and
+permanent — JAX has no unregister — and cost one dict update when
+telemetry is disabled.
+
+``enable_compilation_cache`` / ``serialize_compiled`` /
+``deserialize_compiled`` are the warm-start primitives: the first wires
+JAX's persistent compilation cache to a directory (the
+``compile_cache_dir`` config knob), the other two wrap
+``jax.experimental.serialize_executable`` for the explicit AOT
+executable store (:mod:`amgx_tpu.serve.aot`).
 """
 from __future__ import annotations
+
+import pickle
+import threading
 
 try:
     from jax._src.core import trace_state_clean
@@ -27,37 +43,98 @@ except ImportError:      # pragma: no cover - depends on the jax version
 
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 
 _compile_listener_installed = False
 
+#: ungated process totals of persistent-compile-cache traffic — the
+#: cross-process warm-start probes (bench cold/warm child, tier-1 test)
+#: read these without having to enable the telemetry recorder first
+_cc_stats = {"hits": 0, "misses": 0}
+_cc_lock = threading.Lock()
+_cc_tls = threading.local()
+
+
+def compile_cache_stats() -> dict:
+    """Process totals of persistent-compile-cache hits/misses (counted
+    since :func:`install_compile_counter`; independent of telemetry)."""
+    with _cc_lock:
+        return dict(_cc_stats)
+
+
+def thread_cache_hits() -> int:
+    """Persistent-cache hits observed on THIS thread (monotonic) —
+    compile events fire on the compiling thread, so a delta across a
+    ``lower().compile()`` call answers "was MY compile served from the
+    cache" immune to concurrent compiles on other threads."""
+    return getattr(_cc_tls, "hits_seen", 0)
+
 
 def install_compile_counter() -> bool:
-    """Register the jit cache-miss listener (idempotent); returns True
-    when a listener is in place.  Counts land in
-    ``amgx_jit_trace_total`` / ``amgx_jit_compile_total`` and compile
-    durations in the ``amgx_jit_compile_seconds`` histogram."""
+    """Register the jit cache-miss + persistent-cache listeners
+    (idempotent); returns True when listeners are in place.  Counts land
+    in ``amgx_jit_trace_total`` / ``amgx_jit_compile_total`` /
+    ``amgx_compile_cache_{hits,misses}_total`` and compile durations in
+    the ``amgx_jit_compile_seconds`` histogram."""
     global _compile_listener_installed
     if _compile_listener_installed:
         return True
 
+    def _on_event(event, **kwargs):
+        try:
+            if event == _CACHE_HIT_EVENT:
+                with _cc_lock:
+                    _cc_stats["hits"] += 1
+                # flag the thread: the backend-compile duration event
+                # that follows this hit is an executable LOAD, not a
+                # compile (see module docstring)
+                _cc_tls.hit = True
+                # never-consumed per-thread total: lets a caller detect
+                # post hoc that a compile IT ran was served from the
+                # cache (thread_cache_hits; the flag above is consumed
+                # by the duration listener)
+                _cc_tls.hits_seen = getattr(_cc_tls, "hits_seen", 0) + 1
+            elif event == _CACHE_MISS_EVENT:
+                with _cc_lock:
+                    _cc_stats["misses"] += 1
+            else:
+                return
+            from ..telemetry import metrics, recorder
+            if not recorder.is_enabled():
+                return
+            name = ("amgx_compile_cache_hits_total"
+                    if event == _CACHE_HIT_EVENT
+                    else "amgx_compile_cache_misses_total")
+            metrics.counter_inc(name, layer="xla")
+        except Exception:   # a metrics bug must never break compilation
+            pass
+
     def _on_duration(event, duration, **kwargs):
         try:
+            cache_hit = False
+            if event == _COMPILE_EVENT:
+                cache_hit = getattr(_cc_tls, "hit", False)
+                _cc_tls.hit = False
             from ..telemetry import metrics, recorder
             if not recorder.is_enabled():
                 return
             if event == _TRACE_EVENT:
                 metrics.counter_inc("amgx_jit_trace_total")
             elif event == _COMPILE_EVENT:
-                metrics.counter_inc("amgx_jit_compile_total")
-                metrics.hist_observe("amgx_jit_compile_seconds",
-                                     float(duration))
+                if not cache_hit:
+                    metrics.counter_inc("amgx_jit_compile_total")
+                    metrics.hist_observe("amgx_jit_compile_seconds",
+                                         float(duration))
             else:
                 return
             # setup attribution (telemetry/setup_profile.py): the
             # duration lands on the innermost open setup phase of the
             # firing thread — compiles run synchronously on the thread
             # that triggered them, so this answers "which setup phase
-            # paid that compile" exactly
+            # paid that compile" exactly.  A cache-hit load still
+            # forwards (it is wall time the phase spent in the compile
+            # pipeline), it just isn't a recompile.
             from ..telemetry import setup_profile
             setup_profile.note_duration(event == _COMPILE_EVENT,
                                         float(duration))
@@ -67,7 +144,156 @@ def install_compile_counter() -> bool:
     try:
         import jax.monitoring
         jax.monitoring.register_event_duration_secs_listener(_on_duration)
+        jax.monitoring.register_event_listener(_on_event)
     except Exception:    # pragma: no cover - depends on the jax version
         return False
     _compile_listener_installed = True
     return True
+
+
+# ------------------------------------------------------ warm-start layer
+def enable_compilation_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (the
+    ``compile_cache_dir`` config knob; an explicit knob overrides the
+    import-time env default).  Every jit in the stack becomes
+    disk-backed: a fresh process re-loads executables instead of
+    recompiling them.  Returns True when the cache is (now) active.
+
+    Size/time floors are zeroed — AMG setup compiles many small-but-
+    numerous executables whose aggregate, not individual, cost is the
+    cold-start problem.  Safe to call after compiles already ran: the
+    initialized-once cache singleton is reset so the new directory takes
+    effect."""
+    if not cache_dir:
+        return False
+    import jax
+    changed = jax.config.jax_compilation_cache_dir != cache_dir
+    if changed:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # guarded: this runs in every (nested) solver construction and
+    # jax.config.update is not free
+    if jax.config.jax_persistent_cache_min_compile_time_secs != 0.0:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    if jax.config.jax_persistent_cache_min_entry_size_bytes != 0:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if changed:
+        try:    # private, version-dependent: the dir still applies to
+                # future cache initialization if this shim ever breaks
+            from jax._src import compilation_cache as _cc
+            if _cc.is_initialized():
+                _cc.reset_cache()
+        except Exception:   # pragma: no cover
+            pass
+    install_compile_counter()
+    return True
+
+
+def backend_fingerprint() -> str:
+    """Identity of the executable-compatibility domain: platform +
+    device kind + device count.  Part of every AOT-store key — an
+    executable serialized for one domain never deserializes into
+    another (jax/jaxlib VERSIONS are deliberately meta-checked instead
+    of key-mixed, so an upgrade surfaces as a loud
+    ``compile_cache_fallback`` rather than a silent miss)."""
+    import jax
+    try:
+        devs = jax.devices()
+        kind = devs[0].device_kind if devs else "?"
+        return f"{jax.default_backend()}:{kind}:{len(devs)}"
+    except Exception:       # pragma: no cover - backend init failure
+        return "unknown"
+
+
+def runtime_versions() -> dict:
+    """The version tuple an AOT entry was built under (checked at load;
+    a mismatch falls back to a normal compile)."""
+    import jax
+    try:
+        import jaxlib
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:       # pragma: no cover
+        jl = "?"
+    return {"jax": jax.__version__, "jaxlib": jl}
+
+
+def aval_signature(args) -> str:
+    """Stable digest input of an argument pytree's shapes/dtypes +
+    structure — what decides whether one compiled executable can serve a
+    call (all values ride as arguments in this codebase, so the aval
+    signature IS the executable's shape identity)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    parts = [str(treedef)]
+    for l in leaves:
+        dt = getattr(l, "dtype", None)
+        sh = getattr(l, "shape", None)
+        if dt is None or sh is None:
+            import numpy as np
+            a = np.asarray(l)
+            dt, sh = a.dtype, a.shape
+        parts.append(f"{dt}{tuple(sh)}")
+    return ";".join(parts)
+
+
+def compile_uncached(jit_fn, args):
+    """``jit_fn.lower(*args).compile()`` with the XLA persistent cache
+    scoped OFF — producing an executable that is safe to serialize.
+
+    Why: on XLA CPU (measured on the pinned jax), serializing an
+    executable that was itself LOADED from the persistent compilation
+    cache yields a blob whose JIT-registered kernel symbols are missing
+    — every later ``deserialize_executable`` fails with "Symbols not
+    found", in any process.  An AOT-store entry must therefore come
+    from a genuine compile; the one-time extra compile (only when the
+    XLA cache is warm but the AOT store is cold) buys a permanently
+    loadable entry.  The config scope is thread-local, so concurrent
+    compiles on other threads keep their caching."""
+    try:
+        from jax._src import compilation_cache as _cc
+        from jax._src.config import enable_compilation_cache
+    except ImportError:      # pragma: no cover - jax version dependent
+        return jit_fn.lower(*args).compile()
+    # one uncached compile at a time: the reset/compile/reset dance
+    # manipulates jax's process-global check-once singleton, so two
+    # concurrent AOT compiles would race each other's resets.  A jit
+    # on an UNRELATED thread can still flip the global verdict back to
+    # cached mid-compile — callers detect that case with a
+    # thread_cache_hits() delta and skip persisting (serve/aot.py).
+    with _UNCACHED_LOCK:
+        try:
+            with enable_compilation_cache(False):
+                # the used-or-not verdict is a check-once singleton:
+                # once any compile ran with the cache on, the scoped
+                # disable above is ignored — reset forces a re-check,
+                # which sees the disabled scope and compiles for real
+                _cc.reset_cache()
+                return jit_fn.lower(*args).compile()
+        finally:
+            # ...and a second reset lets the NEXT normal compile
+            # re-enable caching (the verdict would otherwise stick at
+            # False)
+            _cc.reset_cache()
+
+
+_UNCACHED_LOCK = threading.Lock()
+
+
+def serialize_compiled(compiled) -> bytes:
+    """One self-contained blob for a ``jax.stages.Compiled`` —
+    (payload, in_tree, out_tree) pickled together (PyTreeDefs pickle on
+    the pinned jax; the payload is XLA's own serialized executable)."""
+    from jax.experimental.serialize_executable import serialize
+    payload, in_tree, out_tree = serialize(compiled)
+    return pickle.dumps((payload, in_tree, out_tree),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_compiled(blob: bytes):
+    """Rehydrate a :func:`serialize_compiled` blob into a callable
+    executable bound to the CURRENT backend.  Raises on any
+    incompatibility — callers treat that as a cache fallback."""
+    from jax.experimental.serialize_executable import \
+        deserialize_and_load
+    payload, in_tree, out_tree = pickle.loads(blob)
+    return deserialize_and_load(payload, in_tree, out_tree)

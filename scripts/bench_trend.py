@@ -44,6 +44,9 @@ CASES = (
     ("resetup_s", _x(("extras", "classical_device_resetup48",
                       "resetup_warm_s"))),
     ("serve_p50_ms", _x(("extras", "serving", "p50_ms"))),
+    # zero cold-start probe (ISSUE 8): fresh-process ready time with a
+    # populated cache dir; old rounds lack the block and render "-"
+    ("warm_s", _x(("extras", "warm_start", "warm_start_s"))),
     # setup attribution (AMGX_BENCH_SETUP_PROFILE=1 rounds): compile
     # share of the classical-64³ setup — the number whose silent growth
     # WAS the r02→r04 regression.  Older rounds lack the block and
@@ -145,7 +148,25 @@ def load_rounds(repo_dir: str):
                     "metric": parsed.get("metric"),
                     "values": {label: fn(parsed)
                                for label, fn in CASES},
-                    "setup_profile": _setup_detail(parsed)})
+                    "setup_profile": _setup_detail(parsed),
+                    "warm_start": _warm_detail(parsed)})
+    return out
+
+
+def _warm_detail(parsed: dict):
+    """Cold-vs-warm summary + cumulative cache efficacy of one round
+    (the ISSUE-8 ``warm_start`` block and the per-case ``compile_cache``
+    cum counters the runstate file persists across rounds); None on old
+    rounds."""
+    ws = (parsed.get("extras") or {}).get("warm_start")
+    if not isinstance(ws, dict) or "error" in ws:
+        return None
+    out = {k: ws.get(k) for k in ("cold_start_s", "warm_start_s",
+                                  "speedup", "warm_compile_share")}
+    cum = ((ws.get("warm_compile_cache") or {}) if ws else {})
+    if cum:
+        out["cc_hits"] = cum.get("hits")
+        out["cc_misses"] = cum.get("misses")
     return out
 
 
@@ -177,6 +198,21 @@ def render(rounds) -> str:
             L.append(f"        setup[{label}]: {tops}"
                      + (f" · compile {cs:.0%}"
                         if isinstance(cs, (int, float)) else ""))
+        # warm-start annotation (ISSUE-8 rounds): cold vs warm ready
+        # time + the warm run's compile share and cache traffic
+        ws = r.get("warm_start")
+        if ws and isinstance(ws.get("warm_start_s"), (int, float)):
+            parts = [f"cold {ws['cold_start_s']:.4g} s → "
+                     f"warm {ws['warm_start_s']:.4g} s"]
+            if isinstance(ws.get("speedup"), (int, float)):
+                parts.append(f"{ws['speedup']:.2g}×")
+            if isinstance(ws.get("warm_compile_share"), (int, float)):
+                parts.append(f"compile {ws['warm_compile_share']:.0%}")
+            h, m_ = ws.get("cc_hits"), ws.get("cc_misses")
+            if isinstance(h, (int, float)) and \
+                    isinstance(m_, (int, float)) and h + m_:
+                parts.append(f"cc-hit {h / (h + m_):.0%}")
+            L.append("        warm_start: " + " · ".join(parts))
     usable = [r for r in rounds if r["usable"]]
     L.append("")
     L.append(f"{len(usable)}/{len(rounds)} rounds usable")

@@ -43,9 +43,13 @@ DEFAULT_ITERS_RATIO = 1.3
 #: latency noise dominates sub-second measurements)
 TIME_FLOOR_S = 0.25
 
-#: per-case metrics the gate tracks: (key in the case dict, kind)
+#: per-case metrics the gate tracks: (key in the case dict, kind).
+#: cold/warm_start_s come from the bench ``warm_start`` block (ISSUE 8:
+#: a compile-cache regression shows as warm_start_s creeping back
+#: toward cold_start_s — gate it like any other time metric)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
-           ("iterations", "iters"))
+           ("iterations", "iters"),
+           ("cold_start_s", "time"), ("warm_start_s", "time"))
 
 
 def _extract_parsed(rec: dict):

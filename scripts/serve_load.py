@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Open-loop serving load test — the SLO harness CLI.
+
+Builds a :class:`~amgx_tpu.serve.SolveService`, warms it (sessions +
+batch-bucket executables, persisted via the cache/AOT knobs when
+given), then offers Poisson traffic at ``--rps`` over mixed patterns
+and multi-RHS bursts (:mod:`amgx_tpu.serve.loadgen`) and prints ONE
+bench-shaped JSON line: ``p99_ms`` as the headline metric, the full
+SLO block (p50/p95/p99, rejection rate, achieved throughput) in
+extras.  Overload behaviour is part of the contract: offered load the
+admission queue cannot hold must show as ``rejection_rate``, not as an
+unbounded queue.
+
+Usage:
+    python scripts/serve_load.py [--rps R] [--duration S]
+        [--pattern poisson7pt:N ...] [--config FILE_OR_STRING]
+        [--multi-rhs-frac F] [--max-rhs K] [--seed N]
+        [--cache-dir DIR] [--aot-dir DIR] [--no-warmup]
+
+Exit 0 when the run completed (whatever the SLOs say); 1 when any
+request FAILED outright (rejections are not failures).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from warmup import DEFAULT_CFG, build_matrix  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="serve_load.py")
+    ap.add_argument("--rps", type=float, default=20.0)
+    ap.add_argument("--duration", type=float, default=3.0)
+    ap.add_argument("--pattern", action="append", default=[])
+    ap.add_argument("--config", default=None)
+    ap.add_argument("--multi-rhs-frac", type=float, default=0.25)
+    ap.add_argument("--max-rhs", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cache-dir", default=None)
+    ap.add_argument("--aot-dir", default=None)
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip the warmup (measures cold-start mixed "
+                    "into the latency distribution)")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu as amgx
+    from amgx_tpu.serve import SolveService
+    from amgx_tpu.serve.loadgen import run_load
+
+    src = args.config or DEFAULT_CFG
+    cfg = amgx.AMGConfig.from_file(args.config) \
+        if args.config and os.path.exists(args.config) \
+        else amgx.AMGConfig(src)
+    if args.cache_dir:
+        cfg.set("compile_cache_dir", args.cache_dir)
+    if args.aot_dir:
+        cfg.set("aot_store_dir", args.aot_dir)
+    patterns = [build_matrix(s)
+                for s in (args.pattern or ["poisson7pt:8",
+                                           "poisson5pt:12"])]
+
+    svc = SolveService(cfg)
+    try:
+        warm = None
+        if not args.no_warmup:
+            # warm to the SERVICE's batch ceiling, not --max-rhs: the
+            # dispatcher stacks queued same-operator requests up to
+            # serve_max_batch regardless of per-arrival burst size
+            warm = svc.warmup(patterns)
+        out = run_load(svc, patterns, rps=args.rps,
+                       duration_s=args.duration,
+                       multi_rhs_frac=args.multi_rhs_frac,
+                       max_rhs=args.max_rhs, seed=args.seed)
+        st = svc.stats()
+    finally:
+        svc.shutdown()
+    print(json.dumps({
+        "metric": "serve_load_p99_ms",
+        "value": out["p99_ms"],
+        "unit": "ms",
+        "extras": {
+            "open_loop": out,
+            "warmup_s": warm["seconds"] if warm else None,
+            "cache": {k: st["cache"][k]
+                      for k in ("sessions", "hits", "misses",
+                                "evictions")},
+            "aot": st.get("aot"),
+            "worker_task_failures": st["worker_task_failures"],
+        },
+    }))
+    return 1 if out["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

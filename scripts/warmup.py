@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Warm the compile cache + AOT store before serving — the zero
+cold-start prefetch, run at deploy time or process start (before
+traffic, a cron'd re-warm after a jaxlib upgrade, …).
+
+For every pattern spec the script builds the operator, prepares a
+serving session and compiles the solve bodies for the power-of-two
+batch-bucket ladder (``SolveService.warmup``).  With
+``--cache-dir``/``--aot-dir`` (or the config knobs / env defaults)
+every executable lands on disk, so the NEXT process — the one actually
+taking traffic — serves its first request without compiling anything.
+
+Pattern specs (repeatable ``--pattern``):
+    poisson7pt:N          3D 7-point Poisson, N³ rows
+    poisson5pt:N          2D 5-point Poisson, N² rows
+    mm:path.mtx           a MatrixMarket system (the upload path)
+
+Usage:
+    python scripts/warmup.py --pattern poisson7pt:24 \
+        [--pattern mm:ops.mtx ...] [--config FILE_OR_STRING]
+        [--cache-dir DIR] [--aot-dir DIR] [--max-batch K] [--json]
+
+Exit 0 on success; the JSON summary reports per-pattern prepare kinds,
+the bucket ladder, wall seconds, and the store/cc traffic (a re-run
+over a warm store should show loads, not saves).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def build_matrix(spec: str):
+    import amgx_tpu as amgx
+    kind, _, arg = spec.partition(":")
+    if kind == "poisson7pt":
+        from amgx_tpu.io import poisson7pt
+        n = int(arg)
+        return amgx.Matrix(poisson7pt(n, n, n))
+    if kind == "poisson5pt":
+        import scipy.sparse as sp
+        from amgx_tpu.io import poisson5pt
+        n = int(arg)
+        return amgx.Matrix(sp.csr_matrix(poisson5pt(n, n)))
+    if kind == "mm":
+        from amgx_tpu.io.matrix_market import read_matrix_market
+        return amgx.Matrix(read_matrix_market(arg).A)
+    raise SystemExit(f"warmup: unknown pattern spec {spec!r} "
+                     "(poisson7pt:N | poisson5pt:N | mm:path)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="warmup.py")
+    ap.add_argument("--pattern", action="append", default=[],
+                    help="operator pattern spec (repeatable)")
+    ap.add_argument("--config", default=None,
+                    help="solver config: a file path or a config "
+                    "string (default: the serve-check PCG+AMG stack)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent XLA compile cache directory "
+                    "(sets the compile_cache_dir knob)")
+    ap.add_argument("--aot-dir", default=None,
+                    help="AOT executable store directory (sets the "
+                    "aot_store_dir knob)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="top of the batch-bucket ladder "
+                    "(default: serve_max_batch)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw summary JSON only")
+    args = ap.parse_args(argv)
+
+    import amgx_tpu as amgx
+    from amgx_tpu.serve import SolveService
+
+    src = args.config or DEFAULT_CFG
+    if args.config and os.path.exists(args.config):
+        cfg = amgx.AMGConfig.from_file(args.config)
+    else:
+        cfg = amgx.AMGConfig(src)
+    if args.cache_dir:
+        cfg.set("compile_cache_dir", args.cache_dir)
+    if args.aot_dir:
+        cfg.set("aot_store_dir", args.aot_dir)
+    patterns = [build_matrix(s) for s in (args.pattern
+                                          or ["poisson7pt:16"])]
+
+    # the service is only a compilation vehicle here — no dispatcher
+    # traffic, so no workers are ever woken
+    svc = SolveService(cfg, start=False)
+    try:
+        summary = svc.warmup(patterns, max_batch=args.max_batch)
+    finally:
+        svc.shutdown()
+    from amgx_tpu.utils.jaxcompat import compile_cache_stats
+    summary["compile_cache"] = compile_cache_stats()
+    if args.json:
+        print(json.dumps(summary))
+        return 0
+    a = summary.get("aot") or {}
+    print(f"warmup: {summary['patterns']} pattern(s) × buckets "
+          f"{summary['buckets']} in {summary['seconds']:.2f} s")
+    for d in summary["details"]:
+        print(f"  pattern {d['pattern'][:12]}…  prepare: {d['prepare']}")
+    cc = summary["compile_cache"]
+    print(f"  compile cache: {cc['hits']} hits / {cc['misses']} misses"
+          + (f"   AOT store: {a.get('loads', 0)} loaded, "
+             f"{a.get('saves', 0)} saved, {a.get('entries', 0)} "
+             f"entries ({a.get('bytes', 0) / 1e6:.1f} MB) at "
+             f"{a.get('root')}" if a else "   (no AOT store configured)"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,9 @@ def pytest_configure(config):
         "markers", "device_setup: device setup engine fast tests "
                    "(tier-1; pytest -m device_setup selects just "
                    "these)")
+    config.addinivalue_line(
+        "markers", "aot: compile-cache / AOT-store warm-start fast "
+                   "tests (tier-1; pytest -m aot selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.
