@@ -1001,9 +1001,22 @@ def AMGX_serve_warmup(srv: ServiceHandle, mtxs):
 @_catches(1)
 def AMGX_serve_stats(srv: ServiceHandle):
     """Operational snapshot: queue depth, completion/rejection counts,
-    latency percentiles, cache hit/miss/eviction and per-session
-    setup-reuse counts."""
+    latency percentiles, SLO attainment/burn rate, per-phase split,
+    cache hit/miss/eviction and per-session setup-reuse counts."""
     return srv.service.stats()
+
+
+@_catches(1)
+def AMGX_serve_endpoint(srv: ServiceHandle, port: int = None):
+    """Base URL of the service's observability endpoint
+    (``/metrics`` ``/healthz`` ``/statusz`` ``/debug/trace``
+    ``/debug/profile`` — telemetry/httpd.py).  Already running when the
+    config set ``metrics_port``; passing ``port`` here starts it on
+    demand (0 binds an ephemeral port).  Returns None when it is not
+    running and no port was given."""
+    if port is not None:
+        return srv.service.start_endpoint(int(port))
+    return srv.service.endpoint
 
 
 @_catches()

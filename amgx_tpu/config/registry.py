@@ -350,6 +350,25 @@ def register_default_parameters():
     R("serve_warmup_max_batch", int, 0,
       "warmup() prefetches batch buckets 1,2,4,.. up to this width "
       "(0: up to serve_max_batch)")
+    # live serving observability (telemetry/httpd.py + telemetry/slo.py
+    # + request-lifecycle tracing in serve/): everything off by default
+    # and one attribute check when disabled
+    R("metrics_port", int, 0,
+      "serve /metrics /healthz /statusz /debug/* on 127.0.0.1:port "
+      "while the service runs (0 disables; port 0 is rejected — use "
+      "SolveService.start_endpoint(0) for an ephemeral port)")
+    R("slo_window_s", float, 300.0,
+      "sliding window (seconds) of the SLO request-outcome reservoir")
+    R("slo_latency_ms", float, 0.0,
+      "per-request latency objective in ms; 0 means attainment counts "
+      "OK completion + deadline only")
+    R("slo_target", float, 0.99,
+      "SLO attainment objective; error budget = 1 - target, burn rate "
+      "= (1 - attainment) / (1 - target)")
+    R("serve_profile_every", int, 0,
+      "fence + profile every Nth served batch, feeding measured device "
+      "seconds into the cost model (achieved-vs-roofline per pattern; "
+      "0 disables)")
 
 
 register_default_parameters()

@@ -16,9 +16,11 @@ and another hits the iteration limit reports both truthfully.
 from __future__ import annotations
 
 import dataclasses
+import itertools
+import os
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -26,6 +28,29 @@ from .. import telemetry
 from ..errors import RC
 from ..solvers.base import SolveResult
 from .session import SessionKey, SolverSession
+
+_trace_counter = itertools.count(1)
+
+
+def _new_trace_id() -> str:
+    """Process-unique request trace id (pid + counter — cheap, sortable,
+    and stable across the request's log lines and trace slices)."""
+    return f"{os.getpid():x}-{next(_trace_counter):06x}"
+
+
+#: lifecycle mark → the phase it CLOSES (the duration since the
+#: previous mark); every consumer of ``amgx_serve_phase_seconds{phase}``
+#: and the doctor's phase-split table key on these names
+PHASE_OF_MARK = {
+    "admitted": "admit",        # submit() admission bookkeeping
+    "executing": "queue_wait",  # queue + batch window + worker pickup
+    "prepared": "prepare",      # session prepare (setup-cache path)
+    "solved": "solve",          # the device multi-RHS solve (fenced)
+    "errored": "errored",       # prepare/solve raised (failure path —
+                                # keeps failed device time out of the
+                                # other phases' split)
+    "done": "finalize",         # result split-out + completion
+}
 
 
 @dataclasses.dataclass
@@ -45,6 +70,34 @@ class SolveRequest:
     result: Optional[SolveResult] = None
     rc: RC = RC.OK
     error: Optional[str] = None
+    # ---- request-lifecycle trace (live serving observability) ------
+    trace_id: str = dataclasses.field(default_factory=_new_trace_id)
+    #: (mark name, time.perf_counter()) in lifecycle order; the
+    #: "submitted" mark is stamped at construction so every later
+    #: phase telescopes against it
+    marks: List[Tuple[str, float]] = dataclasses.field(
+        default_factory=list)
+    #: ``time.monotonic`` completion stamp (deadline_met math shares
+    #: the deadline's clock; the marks use perf_counter — the
+    #: recorder's clock — so trace slices align)
+    completed_mono: Optional[float] = None
+    #: terminal-accounting hook (the service's ``_finalize``): invoked
+    #: by :meth:`complete` BEFORE the waiter event is set, so a client
+    #: that wakes from ``wait()`` and immediately snapshots the SLO
+    #: window always sees this request counted
+    on_terminal: Optional[object] = dataclasses.field(
+        default=None, repr=False)
+    #: set by the deadline shed in :func:`execute_batch` — the
+    #: expired-vs-rejected distinction must not hang off the free-text
+    #: error message (outcome() classifies on this flag)
+    deadline_shed: bool = False
+
+    def __post_init__(self):
+        if not self.marks:
+            self.marks.append(("submitted", time.perf_counter()))
+
+    def mark(self, name: str):
+        self.marks.append((name, time.perf_counter()))
 
     def batch_key(self):
         return (self.key, self.values_fp)
@@ -53,13 +106,57 @@ class SolveRequest:
         return self.deadline_t is not None and \
             (now if now is not None else time.monotonic()) > self.deadline_t
 
+    # -------------------------------------------------------- trace views
+    def latency_s(self) -> float:
+        """submitted → last mark, on one clock (exact telescoping sum
+        of :meth:`phase_durations`)."""
+        return max(self.marks[-1][1] - self.marks[0][1], 0.0)
+
+    def phase_offsets(self) -> Dict[str, float]:
+        """Mark offsets from ``submitted`` (seconds), in lifecycle
+        order — monotone by construction."""
+        t0 = self.marks[0][1]
+        return {name: max(t - t0, 0.0) for name, t in self.marks[1:]}
+
+    def phase_durations(self) -> Dict[str, float]:
+        """Consecutive mark gaps labelled by :data:`PHASE_OF_MARK` —
+        their sum telescopes to :meth:`latency_s` exactly."""
+        out: Dict[str, float] = {}
+        for (_, t_prev), (name, t) in zip(self.marks, self.marks[1:]):
+            phase = PHASE_OF_MARK.get(name, name)
+            out[phase] = out.get(phase, 0.0) + max(t - t_prev, 0.0)
+        return out
+
+    def outcome(self) -> str:
+        """Terminal outcome label (the SLO window's vocabulary):
+        ``ok`` | ``failed`` (completed but did not converge) |
+        ``rejected`` (admission) | ``expired`` (deadline shed) |
+        ``error``."""
+        if self.rc == RC.OK:
+            if self.result is None:
+                return "error"
+            return "ok" if int(self.result.status) == 0 else "failed"
+        if self.rc == RC.REJECTED:
+            return "expired" if self.deadline_shed else "rejected"
+        return "error"
+
     # ----------------------------------------------------------- completion
     def complete(self, result: Optional[SolveResult], rc: RC = RC.OK,
                  error: Optional[str] = None):
+        if self._event.is_set():
+            return              # terminal exactly once (belt-and-braces
+                                # callers re-check done() racily)
         self.result = result
         self.rc = RC(rc)
         self.error = error
-        self._event.set()
+        self.completed_mono = time.monotonic()
+        self.mark("done")
+        try:
+            if self.on_terminal is not None:
+                self.on_terminal(self)
+        finally:
+            self._event.set()   # waiters ALWAYS wake, even if terminal
+                                # accounting raised
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -138,11 +235,15 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
     now = time.monotonic()
     live = []
     for r in requests:
+        # queue exit: the queue_wait phase ends here for every request
+        # of the batch, shed or not
+        r.mark("executing")
         if r.expired(now):
             telemetry.counter_inc("amgx_serve_rejected_total",
                                   reason="deadline")
             telemetry.counter_inc("amgx_serve_requests_total",
                                   status="REJECTED")
+            r.deadline_shed = True
             r.complete(None, rc=RC.REJECTED,
                        error="deadline expired before execution")
         else:
@@ -173,11 +274,30 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
                 np.asarray(r.x0).ravel() if r.x0 is not None
                 else np.zeros(n, dtype=B.dtype) for r in live])
         telemetry.hist_observe("amgx_serve_batch_size", float(len(live)))
+
+        def _mark_prepared(kind):
+            # called by prepare_and_solve between its prepare and
+            # solve, still under the session lock — the boundary the
+            # prepare/solve phase split needs
+            for r in live:
+                r.mark("prepared")
+
         # prepare + solve are ATOMIC on the session: a racing batch with
         # different values must not resetup the shared solver between
-        # this batch's prepare and its solve
-        kind, results = session.prepare_and_solve(
-            live[0].matrix, B, X0=X0, pad_to_bucket=True)
+        # this batch's prepare and its solve.  The span lands on the
+        # WORKER thread's track with the batch's request trace ids as
+        # args — the Chrome-trace link between a request slice and the
+        # batch that served it
+        with telemetry.span("serve_batch", batch=len(live),
+                            pattern=session.key.pattern[:12],
+                            trace_ids=[r.trace_id for r in live]):
+            kind, results = session.prepare_and_solve(
+                live[0].matrix, B, X0=X0, pad_to_bucket=True,
+                on_prepared=_mark_prepared)
+        # solve_multi fetched every lane's stats to host before
+        # returning, so this mark is FENCED device time, not dispatch
+        for r in live:
+            r.mark("solved")
         telemetry.counter_inc("amgx_serve_setup_total", kind=kind)
         if cache is not None and kind in ("full", "resetup"):
             cache.account(session)
@@ -186,6 +306,11 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
         for r in live:
             telemetry.counter_inc("amgx_serve_requests_total",
                                   status="ERROR")
+            # close the failed prepare/solve time under its own phase —
+            # folding a 2 s device failure into "finalize" would steer
+            # the doctor's congestion-vs-compute hint away from the
+            # actual failing solve path
+            r.mark("errored")
             r.complete(None, rc=RC.UNKNOWN, error=msg)
         return
     t_done = time.monotonic()

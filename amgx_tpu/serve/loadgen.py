@@ -14,9 +14,11 @@ back-to-back — the shape the micro-batcher
 (:func:`~amgx_tpu.serve.batch.split_batches`) exists to exploit.
 
 Reported numbers: offered/accepted/rejected/completed counts, the
-rejection rate, p50/p95/p99 of completed-request latency
-(submit → result, measured by the service), achieved throughput, and
-the generator's own schedule slip (a slipping generator means the
+rejection rate, p50/p95/p99 of request latency (submit → result,
+measured by the service's SLO window — shed and failed requests
+included), SLO attainment + error-budget burn rate against the
+``slo_*`` objectives, achieved throughput, and the generator's own
+schedule slip (a slipping generator means the
 HARNESS saturated, not the server — the numbers are then a lower bound
 on the offered load).  ``scripts/serve_load.py`` is the CLI;
 ``bench.py`` embeds a short run in its serving block.
@@ -91,8 +93,15 @@ def run_load(service: SolveService, patterns: Sequence, *,
         else:
             failed += 1
     wall = time.monotonic() - t0
-    lat = service.latency_percentiles()
     offered = len(pend)
+    # the SLO picture of exactly this run: reset_latency_stats() above
+    # cleared the window, so attainment/burn rate cover the offered
+    # wave only (the snapshot also publishes the amgx_slo_* gauges and
+    # the slo_window trace event when telemetry is enabled); the
+    # percentiles come from the SAME single window pass so they match
+    # the by_outcome counts reported next to them
+    slo = service.slo.snapshot()
+    lat = slo["latency_s"]
 
     def ms(v):
         return round(v * 1e3, 2) if isinstance(v, (int, float)) else None
@@ -111,6 +120,16 @@ def run_load(service: SolveService, patterns: Sequence, *,
         "p50_ms": ms(lat["p50"]),
         "p95_ms": ms(lat["p95"]),
         "p99_ms": ms(lat["p99"]),
+        #: SLO attainment + error-budget burn rate over this run's
+        #: window (telemetry/slo.py; objectives from the slo_* knobs)
+        "attainment": (round(slo["attainment"], 4)
+                       if slo["attainment"] is not None else None),
+        "burn_rate": (round(slo["burn_rate"], 3)
+                      if slo["burn_rate"] is not None else None),
+        "slo": {"objective": slo["objective"],
+                "window_s": slo["window_s"],
+                "by_outcome": slo["by_outcome"],
+                "overloaded": slo["overloaded"]},
         "gen_wall_s": round(gen_wall, 3),
         "wall_s": round(wall, 3),
         #: worst lag of the generator behind its schedule — nonzero

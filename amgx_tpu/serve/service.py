@@ -35,6 +35,7 @@ from .. import telemetry
 from ..config import AMGConfig
 from ..core.matrix import Matrix
 from ..errors import RC
+from ..telemetry import slo as _slo
 from ..utils.thread_manager import ThreadManager
 from .batch import (PendingSolve, SolveRequest, execute_batch,
                     split_batches)
@@ -66,11 +67,29 @@ class SolveService:
         self._accepting = False
         self._running = False
         self._dispatcher: Optional[threading.Thread] = None
-        self._latencies: List[float] = []      # completed-request seconds
         self._lat_lock = threading.Lock()
         self.submitted = 0
         self.rejected = 0
         self.completed = 0
+        #: the SLO reservoir replaces the old OK-only latency list:
+        #: EVERY terminal outcome lands here with its label, so shed
+        #: load can no longer flatter the percentiles (slo_* knobs)
+        self.slo = _slo.from_config(cfg)
+        #: running per-phase sums (queue-wait vs solve split in
+        #: stats()), keyed by the PHASE_OF_MARK vocabulary
+        self._phase_totals: dict = {}
+        #: sampled solve-path profiling: every Nth batch's fenced
+        #: device seconds vs the cost model (0 = off)
+        self.profile_every = int(g("serve_profile_every"))
+        self._batch_seq = 0
+        self._profile: dict = {}         # pattern -> capture summary
+        #: observability endpoint (telemetry/httpd.py), started with
+        #: the service when metrics_port > 0
+        self.metrics_port = int(g("metrics_port"))
+        self._endpoint = None
+        #: serializes endpoint start/stop — two racing start_endpoint
+        #: calls must not each bind a server (one would leak)
+        self._endpoint_lock = threading.Lock()
         if start:
             self.start()
 
@@ -88,7 +107,35 @@ class SolveService:
                                             name="amgx-serve-dispatch",
                                             daemon=True)
         self._dispatcher.start()
+        if self.metrics_port > 0 and self._endpoint is None:
+            try:
+                self.start_endpoint(self.metrics_port)
+            except Exception as e:   # noqa: BLE001 — port conflicts are
+                # OSError but an out-of-range port raises OverflowError;
+                # NO bind failure may kill the service — the
+                # observability layer is strictly additive
+                import warnings
+                warnings.warn(f"amgx serve: observability endpoint "
+                              f"failed to bind port "
+                              f"{self.metrics_port}: {e}")
         return self
+
+    def start_endpoint(self, port: Optional[int] = None) -> str:
+        """Start the observability endpoint
+        (:mod:`amgx_tpu.telemetry.httpd`: /metrics /healthz /statusz
+        /debug/trace /debug/profile) on 127.0.0.1; port 0 binds an
+        ephemeral port.  Returns the base URL.  Idempotent."""
+        from ..telemetry.httpd import serve_httpd
+        with self._endpoint_lock:
+            if self._endpoint is None:
+                p = self.metrics_port if port is None else int(port)
+                self._endpoint = serve_httpd(p, service=self)
+            return self._endpoint.url
+
+    @property
+    def endpoint(self) -> Optional[str]:
+        """Base URL of the running observability endpoint, or None."""
+        return self._endpoint.url if self._endpoint is not None else None
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Stop admitting, flush every queued request, finish in-flight
@@ -116,6 +163,10 @@ class SolveService:
             self._dispatcher.join(timeout=5.0)
             self._dispatcher = None
         self._tm.join_threads()
+        with self._endpoint_lock:
+            if self._endpoint is not None:
+                self._endpoint.stop()
+                self._endpoint = None
         return ok
 
     def __enter__(self):
@@ -141,7 +192,12 @@ class SolveService:
                            pattern=matrix.pattern_fingerprint()),
             values_fp=matrix.values_fingerprint(),
             submitted_t=now,
-            deadline_t=(now + ddl) if ddl else None)
+            deadline_t=(now + ddl) if ddl else None,
+            # terminal accounting (SLO window, phase fold, trace event)
+            # runs inside complete(), BEFORE the waiter event: a client
+            # that wakes from wait() and immediately snapshots the SLO
+            # window must see this request counted
+            on_terminal=self._finalize)
         with self._cond:
             # admission counts OUTSTANDING work — queued AND drained-but-
             # unfinished — against the capacity: the dispatcher empties
@@ -151,6 +207,7 @@ class SolveService:
             accepting = self._accepting
             reject = not accepting or outstanding >= self.queue_depth
             if not reject:
+                req.mark("admitted")
                 self._queue.append(req)
                 telemetry.gauge_set("amgx_serve_queue_depth",
                                     len(self._queue))
@@ -172,6 +229,51 @@ class SolveService:
         with self._lat_lock:
             self.submitted += 1
         return PendingSolve(req)
+
+    # ------------------------------------------------- request finalization
+    def _finalize(self, req: SolveRequest):
+        """Terminal accounting of ONE request, whatever its outcome:
+        feed the SLO window, fold the phase split, and emit the
+        schema-validated ``request_trace`` event + per-phase
+        histograms.  Runs exactly once per request, inside
+        ``SolveRequest.complete`` (the ``on_terminal`` hook) BEFORE the
+        waiter event is set — a client that wakes from ``wait()`` and
+        immediately snapshots the SLO window sees every finished
+        request counted."""
+        outcome = req.outcome()
+        latency = req.latency_s()
+        deadline_met = req.deadline_t is None or (
+            req.completed_mono is not None
+            and req.completed_mono <= req.deadline_t)
+        self.slo.record(latency, outcome, deadline_met=deadline_met)
+        # admission rejections never entered the lifecycle — their only
+        # post-submit mark is "done", and folding that micro-gap into
+        # the finalize phase would corrupt the split exactly when it
+        # matters (under shedding); they count in the SLO window only
+        admitted = any(nm == "admitted" for nm, _ in req.marks)
+        durs = req.phase_durations() if admitted else {}
+        with self._lat_lock:
+            for phase, d in durs.items():
+                tot = self._phase_totals.setdefault(phase, [0, 0.0])
+                tot[0] += 1
+                tot[1] += d
+        if telemetry.is_enabled():
+            for phase, d in durs.items():
+                telemetry.hist_observe("amgx_serve_phase_seconds", d,
+                                       phase=phase)
+            telemetry.event(
+                "request_trace", trace_id=req.trace_id,
+                outcome=outcome, rc=int(req.rc),
+                latency_s=round(latency, 6),
+                deadline_met=bool(deadline_met),
+                pattern=req.key.pattern[:12],
+                # "phases" speaks the DOCUMENTED phase vocabulary
+                # (admit|queue_wait|...|finalize — what the histogram
+                # labels and README teach); "marks" keeps the raw
+                # monotone mark offsets for timeline reconstruction
+                phases={k: round(v, 6) for k, v in durs.items()},
+                marks={k: round(v, 6)
+                       for k, v in req.phase_offsets().items()})
 
     def solve(self, matrix: Matrix, b, x0=None,
               timeout: Optional[float] = None):
@@ -259,16 +361,23 @@ class SolveService:
                 drained, self._queue = self._queue, []
                 self._inflight += len(drained)
                 telemetry.gauge_set("amgx_serve_queue_depth", 0)
+                telemetry.gauge_set("amgx_serve_inflight",
+                                    self._inflight)
             for batch in split_batches(drained, self.max_batch):
                 self._tm.push_work(self._batch_task(batch))
 
     def _batch_task(self, batch: List[SolveRequest]):
+        with self._lat_lock:
+            self._batch_seq += 1
+            profile = self.profile_every > 0 and \
+                self._batch_seq % self.profile_every == 0
+
         def run():
+            session = None
             try:
                 session, _created = self.cache.get_or_create(
                     self.cfg, batch[0].matrix, key=batch[0].key)
                 execute_batch(session, batch, cache=self.cache)
-                done_t = time.monotonic()
                 with self._lat_lock:
                     self.completed += sum(1 for r in batch
                                           if r.rc == RC.OK)
@@ -276,10 +385,8 @@ class SolveService:
                     # must show in stats() like any other rejection
                     self.rejected += sum(1 for r in batch
                                          if r.rc == RC.REJECTED)
-                    for r in batch:
-                        if r.rc == RC.OK:
-                            self._latencies.append(done_t - r.submitted_t)
-                    del self._latencies[:-4096]
+                if profile:
+                    self._profile_batch(session, batch)
             except Exception as e:    # noqa: BLE001 — swallowed ON PURPOSE:
                 # the failure is delivered through the request handles
                 # below; letting it reach the future would make a later
@@ -287,41 +394,131 @@ class SolveService:
                 msg = f"{type(e).__name__}: {e}"
                 for r in batch:
                     if not r.done():
+                        r.mark("errored")
                         r.complete(None, rc=RC.UNKNOWN, error=msg)
             finally:
                 for r in batch:
                     if not r.done():     # belt-and-braces: no waiter hangs
+                        r.mark("errored")
                         r.complete(None, rc=RC.UNKNOWN,
                                    error="batch task failed")
                 with self._cond:
                     self._inflight -= len(batch)
+                    telemetry.gauge_set("amgx_serve_inflight",
+                                        self._inflight)
                     self._cond.notify_all()
         return run
 
+    def _profile_batch(self, session, batch: List[SolveRequest]):
+        """Sampled solve-path profiling (``serve_profile_every``): the
+        batch's solve phase is already FENCED (solve_multi fetches
+        every lane's stats to host before the ``solved`` mark), so the
+        prepared→solved gap is measured device+dispatch seconds.  Fed
+        into the cost model (telemetry/costmodel.py) as a per-pattern
+        achieved-bandwidth floor: one fine-operator apply per iteration
+        per lane — AMG cycles move strictly more, so the roofline
+        fraction reported here is a lower bound."""
+        try:
+            ok = [r for r in batch
+                  if r.rc == RC.OK and r.result is not None]
+            if not ok:
+                return
+            t = dict(ok[0].marks)
+            solve_s = t.get("solved", 0.0) - t.get("prepared", 0.0)
+            if solve_s <= 0:
+                return
+            iters = sum(max(int(r.result.iterations), 1) for r in ok)
+            from ..telemetry import costmodel
+            cost = costmodel.spmv_cost(session.solver.Ad)
+            bpa = float(cost.get("bytes_per_apply") or 0)
+            gbs = costmodel.achieved_gbs(bpa * iters, solve_s)
+            frac = costmodel.roofline_fraction(gbs)
+            pattern = session.key.pattern
+            with self._lat_lock:
+                entry = self._profile.setdefault(pattern, {
+                    "captures": 0, "pack": cost.get("pack")})
+                entry["captures"] += 1
+                entry.update(
+                    batch=len(ok), iterations=iters,
+                    solve_s=round(solve_s, 6),
+                    bytes_per_apply=int(bpa),
+                    achieved_gbs=round(gbs, 3),
+                    roofline_fraction=round(frac, 4))
+            telemetry.counter_inc("amgx_serve_profile_total")
+            telemetry.gauge_set("amgx_serve_achieved_gbs", gbs,
+                                pattern=pattern[:12])
+            telemetry.event("serve_profile", pattern=pattern[:12],
+                            batch=len(ok), iterations=iters,
+                            solve_s=solve_s, achieved_gbs=gbs,
+                            roofline_fraction=frac,
+                            pack=cost.get("pack"))
+        except Exception:   # noqa: BLE001 — profiling must never fail
+            pass            # a served batch (cost-model gaps included)
+
     # ---------------------------------------------------------------- stats
     def latency_percentiles(self) -> dict:
-        """p50/p95/p99 of completed-request latency (seconds), computed
-        over the most recent completions."""
-        with self._lat_lock:
-            lat = sorted(self._latencies)
-        if not lat:
-            return {"p50": None, "p95": None, "p99": None}
-
-        def pct(p):
-            i = min(len(lat) - 1, max(0, int(round(p * (len(lat) - 1)))))
-            return lat[i]
-
-        return {"p50": pct(0.50), "p95": pct(0.95), "p99": pct(0.99)}
+        """p50/p95/p99 of request latency (seconds) over the SLO
+        window's waited outcomes — unlike the pre-SLO accounting this
+        INCLUDES failed and deadline-expired requests (their wait was
+        real); admission rejections count against attainment instead
+        of dragging the percentiles toward zero."""
+        return self.slo.percentiles()
 
     def reset_latency_stats(self):
-        """Drop collected request latencies (benchmark warm-up: separate
-        the compile-heavy first requests from steady-state numbers)."""
+        """Drop the SLO window + phase split (benchmark warm-up:
+        separate the compile-heavy first requests from steady-state
+        numbers)."""
+        self.slo.reset()
         with self._lat_lock:
-            self._latencies.clear()
+            self._phase_totals.clear()
+
+    def phase_split(self) -> dict:
+        """Mean seconds per lifecycle phase since the last reset — the
+        queue-wait vs solve split: a p99 dominated by ``queue_wait``
+        needs workers or shedding; one dominated by ``solve`` needs a
+        faster solver."""
+        with self._lat_lock:
+            return {phase: {"count": int(n),
+                            "mean_s": round(tot / n, 6) if n else None}
+                    for phase, (n, tot)
+                    in sorted(self._phase_totals.items())}
+
+    def health(self) -> dict:
+        """The liveness surface ``/healthz`` serves: queue +
+        in-flight + SLO overload state, one window pass per poll.
+        The trip wire's capacity leg counts OUTSTANDING work (queued +
+        in-flight) — the dispatcher drains the queue every batch
+        window, so under overload the backlog lives in-flight and the
+        raw queue depth alone would never trip.  Calling this also
+        refreshes the ``amgx_slo_*`` gauges (the /metrics scrape
+        path)."""
+        with self._cond:
+            depth = len(self._queue)
+            inflight = self._inflight
+            accepting = self._accepting
+        # emit_event=False: health/scrape polls refresh the gauges but
+        # must not append slo_window events to the bounded ring at the
+        # poller's rate (stats() keeps emitting them)
+        snap = self.slo.snapshot(queue_depth=depth + inflight,
+                                 queue_capacity=self.queue_depth,
+                                 emit_event=False,
+                                 include_percentiles=False)
+        return {
+            "ok": True,
+            "accepting": accepting,
+            "queue_depth": depth,
+            "queue_capacity": self.queue_depth,
+            "inflight": inflight,
+            "workers": self._tm._max_workers,
+            "overloaded": snap["overloaded"],
+            "slo_attainment": snap["attainment"],
+            "slo_burn_rate": snap["burn_rate"],
+        }
 
     def stats(self) -> dict:
         with self._cond:
             depth = len(self._queue)
+            inflight = self._inflight
         with self._lat_lock:
             submitted, completed, rejected = \
                 self.submitted, self.completed, self.rejected
@@ -331,6 +528,16 @@ class SolveService:
         # session cache it multiplies
         from ..amg.device_setup import engine_stats
         from . import aot
+        with self._lat_lock:
+            profile = {k: dict(v) for k, v in self._profile.items()}
+        # ONE snapshot serves both keys: the percentiles it already
+        # computed ("latency_s") and the SLO picture — attainment, burn
+        # rate, outcome counts, overload state over the sliding window
+        # (slo_* knobs).  Taking it here also publishes the amgx_slo_*
+        # gauges + slo_window event when telemetry is on; the capacity
+        # leg counts outstanding = queued + in-flight
+        snap = self.slo.snapshot(queue_depth=depth + inflight,
+                                 queue_capacity=self.queue_depth)
         return {
             "submitted": submitted,
             "completed": completed,
@@ -339,7 +546,14 @@ class SolveService:
             "queue_capacity": self.queue_depth,
             "workers": self._tm._max_workers,
             "worker_task_failures": self._tm.failed_tasks,
-            "latency_s": self.latency_percentiles(),
+            "latency_s": snap["latency_s"],
+            "slo": snap,
+            # queue-wait vs solve split of the request lifecycle
+            "phase_split": self.phase_split(),
+            # sampled solve-path profiling (serve_profile_every):
+            # per-pattern fenced device seconds vs the cost model
+            "profile": profile or None,
+            "endpoint": self.endpoint,
             "cache": self.cache.stats(),
             "device_setup": engine_stats(),
             # warm-start layer: AOT executable store traffic (None when

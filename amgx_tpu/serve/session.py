@@ -102,14 +102,19 @@ class SolverSession:
                                            pad_to_bucket=pad_to_bucket)
 
     def prepare_and_solve(self, matrix: Matrix, B, X0=None,
-                          pad_to_bucket: bool = False):
+                          pad_to_bucket: bool = False,
+                          on_prepared=None):
         """Atomic prepare + batched solve: (kind, results).  The lock is
         held across BOTH steps — two same-pattern batches with different
         values racing on one session must not interleave a resetup
         between the other's prepare and solve (the solve would run
-        against the wrong coefficients)."""
+        against the wrong coefficients).  ``on_prepared(kind)``, when
+        given, fires between the two steps (still under the lock) —
+        the request tracer's prepare/solve phase boundary."""
         with self.lock:
             kind = self.prepare(matrix)
+            if on_prepared is not None:
+                on_prepared(kind)
             return kind, self.solver.solve_multi(
                 B, X0=X0, pad_to_bucket=pad_to_bucket)
 

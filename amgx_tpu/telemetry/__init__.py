@@ -25,7 +25,12 @@ the grid-stats table:
 * **convergence forensics** (:mod:`.forensics`): per-level cycle
   anatomy (residual norms at the four cut points of every cycle),
   hierarchy quality probes at setup, asymptotic convergence-factor
-  estimates — gated by the ``forensics`` config knob.
+  estimates — gated by the ``forensics`` config knob;
+* **live serving observability**: :mod:`.slo` (time-windowed
+  request-outcome reservoir → attainment / error-budget burn rate /
+  overload detection) and :mod:`.httpd` (in-process
+  ``/metrics`` ``/healthz`` ``/statusz`` ``/debug/trace``
+  ``/debug/profile`` endpoint behind the ``metrics_port`` knob).
 
 Everything is **off by default** and compiled down to one attribute
 check per instrument; enable globally with :func:`enable`, per config
@@ -35,7 +40,7 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 from __future__ import annotations
 
 from . import (costmodel, export, forensics, metrics, recorder,
-               runstate, setup_profile, tracefile)
+               runstate, setup_profile, slo, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -57,8 +62,24 @@ __all__ = [
     "read_sessions", "aggregate_sessions",
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
     "costmodel", "forensics", "setup_profile", "runstate",
+    "slo", "httpd",
     "reset",
 ]
+
+
+def __getattr__(name):
+    # httpd is the ONLY lazily-bound submodule: it pulls the stdlib
+    # http.server → http.client → email import chain, which every
+    # non-serving `import amgx_tpu` would otherwise pay for an endpoint
+    # that is off by default (serve/service.py lazy-imports it too)
+    if name == "httpd":
+        # importlib, not `from . import`: the fromlist resolution calls
+        # getattr on this package and would re-enter this hook forever
+        import importlib
+        mod = importlib.import_module(".httpd", __name__)
+        globals()["httpd"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def reset():

@@ -278,6 +278,34 @@ def diagnose(paths: List[str]) -> dict:
                           "p99": _pct(0.99)},
         }
 
+    # ---- SLO (telemetry/slo.py + request-lifecycle tracing) ---------
+    slo_snap = None
+    outcome_counts: Dict[str, int] = {}
+    phase_tot: Dict[str, list] = {}
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] == "event" and r["name"] == "slo_window":
+                slo_snap = dict(r["attrs"])     # last snapshot wins
+            elif r["kind"] == "event" and r["name"] == "request_trace":
+                oc = str(r["attrs"].get("outcome", "?"))
+                outcome_counts[oc] = outcome_counts.get(oc, 0) + 1
+            elif r["kind"] == "hist" and \
+                    r["name"] == "amgx_serve_phase_seconds":
+                ph = str(r["labels"].get("phase", "?"))
+                d = phase_tot.setdefault(ph, [0, 0.0])
+                d[0] += 1
+                d[1] += float(r["value"])
+    slo = None
+    if slo_snap or outcome_counts:
+        slo = {
+            "window": slo_snap,
+            "outcomes": dict(sorted(outcome_counts.items())),
+            "phase_split": {ph: {"count": int(n),
+                                 "mean_s": round(t / n, 6) if n else None}
+                            for ph, (n, t)
+                            in sorted(phase_tot.items())},
+        }
+
     # ---- convergence ------------------------------------------------
     conv = {}
     for name, key in (("amgx_solve_iterations", "iterations"),
@@ -420,6 +448,33 @@ def diagnose(paths: List[str]) -> dict:
         if fails:
             hints.append(f"{int(fails)} worker task(s) raised — the pool "
                          "survived, but check the service error log")
+    if slo:
+        w = slo.get("window") or {}
+        burn = w.get("burn_rate")
+        if isinstance(burn, (int, float)) and burn > 1.0:
+            att = w.get("attainment")
+            hints.append(
+                f"SLO error budget burning at {burn:.1f}× "
+                + (f"(attainment {att:.1%} vs target "
+                   f"{w.get('target', 0):.1%})"
+                   if isinstance(att, (int, float)) else "")
+                + " — shed load earlier, add capacity, or relax the "
+                  "objective")
+        if w.get("overloaded"):
+            hints.append(
+                "overload trip wire is ON (windowed shed rate or queue "
+                "depth past threshold) — the service is past its "
+                "capacity; scale out or lower the offered rate")
+        ps = slo.get("phase_split", {})
+        qw = (ps.get("queue_wait") or {}).get("mean_s")
+        sv = (ps.get("solve") or {}).get("mean_s")
+        if isinstance(qw, (int, float)) and isinstance(sv, (int, float)) \
+                and sv > 0 and qw > sv:
+            hints.append(
+                f"queue_wait ({qw * 1e3:.1f} ms mean) exceeds solve "
+                f"({sv * 1e3:.1f} ms mean) per request — latency is "
+                "congestion, not compute: add serve_workers, shorten "
+                "serve_batch_window_ms, or shed earlier")
 
     return {
         "files": list(paths),
@@ -443,6 +498,7 @@ def diagnose(paths: List[str]) -> dict:
             "halo_local_ratio": halo_local_ratio,
         },
         "serving": serving,
+        "slo": slo,
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
         "forensics": fr,
@@ -797,6 +853,36 @@ def render(d: dict) -> str:
         if lat["p50"] is not None:
             L.append(f"  latency p50/p95/p99: {lat['p50']*1e3:.1f}/"
                      f"{lat['p95']*1e3:.1f}/{lat['p99']*1e3:.1f} ms")
+
+    slo = d.get("slo")
+    if slo:
+        L.append("")
+        L.append("SLO (windowed attainment + request lifecycle)")
+        L.append("-" * 40)
+        w = slo.get("window") or {}
+        att, burn = w.get("attainment"), w.get("burn_rate")
+        if isinstance(att, (int, float)):
+            L.append(
+                f"  attainment: {att:.2%} of {int(w.get('requests', 0))}"
+                f" windowed requests (target "
+                f"{w.get('target', 0):.1%}"
+                + (f", latency obj {w.get('latency_ms_objective'):.0f}"
+                   " ms" if w.get("latency_ms_objective") else "")
+                + ")")
+        if isinstance(burn, (int, float)):
+            L.append(f"  error-budget burn rate: {burn:.2f}×"
+                     + ("  OVERLOADED" if w.get("overloaded") else ""))
+        for oc, n in (slo.get("outcomes") or {}).items():
+            L.append(f"  outcome {oc:<22} {n}")
+        ps = slo.get("phase_split") or {}
+        if ps:
+            L.append(f"  {'phase':<12}{'count':>8}{'mean_ms':>10}")
+            for ph, v in ps.items():
+                m = v.get("mean_s")
+                L.append(f"  {ph:<12}{v['count']:>8}"
+                         + (f"{m * 1e3:>10.2f}"
+                            if isinstance(m, (int, float))
+                            else f"{'?':>10}"))
 
     setup = d.get("setup")
     if setup:

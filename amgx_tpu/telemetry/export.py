@@ -157,6 +157,40 @@ def validate_record(rec: dict):
             need(isinstance(rec["attrs"].get("reason"), str)
                  and rec["attrs"]["reason"],
                  "compile_cache_fallback event missing reason")
+        if rec["name"] == "request_trace":
+            # request-lifecycle traces are the analysis input of the
+            # doctor's SLO section and the Chrome-trace request slices
+            # (serve/service.py emits one per terminal request)
+            a = rec["attrs"]
+            need(isinstance(a.get("trace_id"), str) and a["trace_id"],
+                 "request_trace event missing trace_id")
+            need(a.get("outcome") in ("ok", "failed", "rejected",
+                                      "expired", "error"),
+                 f"request_trace event has unknown outcome "
+                 f"{a.get('outcome')!r}")
+            need(isinstance(a.get("latency_s"), (int, float))
+                 and a["latency_s"] >= 0.0,
+                 "request_trace event missing latency_s")
+            # "phases": durations in the documented phase vocabulary;
+            # "marks": raw monotone mark offsets from `submitted`
+            for key in ("phases", "marks"):
+                d = a.get(key)
+                need(isinstance(d, dict) and all(
+                    isinstance(v, (int, float)) and v >= 0.0
+                    for v in d.values()),
+                     f"request_trace event missing {key} dict")
+        if rec["name"] == "slo_window":
+            # SLO snapshots are what bench_trend and the doctor read
+            # for attainment/burn-rate trends
+            a = rec["attrs"]
+            need(isinstance(a.get("window_s"), (int, float)),
+                 "slo_window event missing window_s")
+            need(isinstance(a.get("requests"), int),
+                 "slo_window event missing requests")
+            for k in ("attainment", "burn_rate"):
+                need(a.get(k) is None
+                     or isinstance(a[k], (int, float)),
+                     f"slo_window event has non-numeric {k}")
         if rec["name"] == "device_setup_fallback":
             # fallback events are the doctor's per-level "why did rap
             # run host-side" input (amg/device_setup/)

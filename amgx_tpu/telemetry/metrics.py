@@ -184,6 +184,32 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_serve_warmup_seconds":
         ("histogram", "wall seconds of one SolveService.warmup "
                       "prefetch"),
+    # ---- live serving observability (telemetry/slo.py + httpd.py +
+    # ---- request-lifecycle tracing in serve/) -----------------------
+    "amgx_serve_phase_seconds":
+        ("histogram", "per-request lifecycle phase duration "
+                      "{phase=admit|queue_wait|prepare|solve|finalize"
+                      "|errored}"),
+    "amgx_serve_inflight":
+        ("gauge", "requests drained from the queue whose batch has not "
+                  "finished"),
+    "amgx_serve_overload":
+        ("gauge", "SLO overload trip wire (1 = windowed shed rate or "
+                  "queue depth past threshold)"),
+    "amgx_slo_attainment":
+        ("gauge", "fraction of windowed requests that completed OK "
+                  "within deadline and latency objective"),
+    "amgx_slo_burn_rate":
+        ("gauge", "error-budget burn rate (1-attainment)/(1-target) "
+                  "over the SLO window"),
+    "amgx_slo_window_requests":
+        ("gauge", "request outcomes currently held in the SLO window"),
+    "amgx_serve_profile_total":
+        ("counter", "served batches sampled by the solve-path profiler "
+                    "(serve_profile_every)"),
+    "amgx_serve_achieved_gbs":
+        ("gauge", "measured device bandwidth of the last profiled "
+                  "batch of one pattern {pattern}"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)

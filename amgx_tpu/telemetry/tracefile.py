@@ -13,7 +13,13 @@ multi-process ones) into Trace Event Format JSON — the format
 * ``event`` records become instants (``"i"``);
 * counter samples become counter (``"C"``) tracks with the RUNNING SUM
   (the trace format draws absolute values), gauges track their last
-  written value.
+  written value;
+* serving observability: threads that executed ``serve_batch`` spans
+  are named ``serve-worker-N`` tracks (``"M"`` thread_name metadata),
+  and every ``request_trace`` event becomes an async ``"b"``/``"e"``
+  pair spanning the request's whole lifetime, keyed by its trace id —
+  overlapping requests stack instead of mis-nesting, and the batch
+  slice that served a request carries its trace id in ``trace_ids``.
 
 Timestamps: record ``t`` is ``perf_counter`` seconds, whose epoch is
 per-process.  Session meta headers carry a paired
@@ -28,8 +34,9 @@ from typing import IO, List, Optional, Union
 from . import recorder
 from .export import _sanitize, read_sessions
 
-#: trace-event phases this exporter emits (telemetry_check validates)
-PHASES = ("X", "i", "C", "M")
+#: trace-event phases this exporter emits (telemetry_check validates);
+#: "b"/"e" are the async request-lifecycle slices
+PHASES = ("X", "i", "C", "M", "b", "e")
 
 
 def _args(d: dict) -> dict:
@@ -52,6 +59,7 @@ def _session_events(records: List[dict], pid: int, offset_s: float,
 
     begins = {}             # sid -> span_begin record
     counters = {}           # (name, labels) -> running sum
+    worker_tids = set()     # threads that executed serve_batch spans
     for r in records:
         kind = r["kind"]
         if kind == "span_begin":
@@ -60,11 +68,33 @@ def _session_events(records: List[dict], pid: int, offset_s: float,
             b = begins.pop(r["sid"], None)
             t1 = r["t"]
             dur = r.get("dur", 0.0) or 0.0
+            if r["name"] == "serve_batch":
+                worker_tids.add(r["tid"])
             out.append({
                 "ph": "X", "name": r["name"], "pid": pid,
                 "tid": r["tid"], "ts": ts(t1 - dur),
                 "dur": max(dur * 1e6, 0.0),
                 "args": _args(b["attrs"] if b else {}),
+            })
+        elif kind == "event" and r["name"] == "request_trace":
+            # one async b/e pair per request, spanning submit →
+            # terminal (the event fires at completion and carries the
+            # total latency); the trace id keys the pair AND appears
+            # in the serving batch slice's trace_ids args — the link
+            # between a request's lifetime and the batch that ran it
+            a = r.get("attrs", {})
+            lat = a.get("latency_s")
+            lat = float(lat) if isinstance(lat, (int, float)) else 0.0
+            rid = str(a.get("trace_id", "?"))
+            name = f"request:{a.get('outcome', '?')}"
+            out.append({
+                "ph": "b", "cat": "request", "id": rid, "name": name,
+                "pid": pid, "tid": r["tid"],
+                "ts": ts(r["t"] - lat), "args": _args(a),
+            })
+            out.append({
+                "ph": "e", "cat": "request", "id": rid, "name": name,
+                "pid": pid, "tid": r["tid"], "ts": ts(r["t"]),
             })
         elif kind == "event":
             out.append({
@@ -96,6 +126,11 @@ def _session_events(records: List[dict], pid: int, offset_s: float,
         out.append({"ph": "i", "name": b["name"] + " (open)", "pid": pid,
                     "tid": b["tid"], "ts": ts(b["t"]), "s": "t",
                     "args": _args(b["attrs"])})
+    # name the serving worker tracks — a mesh of anonymous tids is
+    # unreadable the moment two workers interleave batches
+    for i, t in enumerate(sorted(worker_tids)):
+        out.append({"ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": t, "args": {"name": f"serve-worker-{i}"}})
     return out
 
 
@@ -186,6 +221,13 @@ def validate_chrome_trace(trace: dict) -> int:
         if e["ph"] == "X":
             need(isinstance(e.get("dur"), (int, float))
                  and e["dur"] >= 0, f"bad dur: {e!r}")
+        if e["ph"] in ("b", "e"):
+            # async pairs match on (cat, id) — either missing breaks
+            # the request slice silently in Perfetto
+            need(isinstance(e.get("id"), str) and e["id"],
+                 f"async event missing id: {e!r}")
+            need(isinstance(e.get("cat"), str) and e["cat"],
+                 f"async event missing cat: {e!r}")
         if "args" in e:
             need(isinstance(e["args"], dict), f"bad args: {e!r}")
     # the whole thing must be strict JSON (Perfetto rejects bare NaN)

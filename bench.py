@@ -470,7 +470,11 @@ def _bench_serving(n_side: int = 12, n_requests: int = 32):
         "amg:selector=SIZE_2, amg:max_iters=1, "
         "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
         "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
-        "serve_batch_window_ms=2, serve_workers=2, serve_max_batch=8")
+        "serve_batch_window_ms=2, serve_workers=2, serve_max_batch=8, "
+        # live observability (ISSUE 9): an SLO objective so attainment
+        # and burn rate are meaningful, and solve-path profiling every
+        # 4th batch for the achieved-vs-roofline numbers
+        "slo_latency_ms=2000, slo_target=0.99, serve_profile_every=4")
     m = amgx.Matrix(A)
     rng = np.random.default_rng(5)
     n = A.shape[0]
@@ -505,6 +509,14 @@ def _bench_serving(n_side: int = 12, n_requests: int = 32):
             print(f"[bench] open-loop probe failed: {e}",
                   file=sys.stderr)
             open_loop = {"error": str(e)[:200]}
+        # re-snapshot AFTER the open-loop probe: run_load reset the SLO
+        # window, so this SLO/phase/profile picture is the open-loop
+        # steady state, not the closed warm-up wave's.  `st` (the
+        # closed-wave snapshot above) keeps feeding cache/setups/
+        # rejected so those fields stay comparable with pre-probe
+        # rounds and rejections are not double-reported next to
+        # open_loop["rejected"]
+        st_open = svc.stats()
         return {
             "n": int(n),
             "requests": int(n_requests),
@@ -524,6 +536,17 @@ def _bench_serving(n_side: int = 12, n_requests: int = 32):
             if st["cache"]["by_session"] else {},
             "rejected": int(st["rejected"]),
             "open_loop": open_loop,
+            # SLO attainment + error-budget burn rate over the probe
+            # window, and the queue-wait vs solve phase split — the
+            # live-observability numbers (telemetry/slo.py)
+            "slo": {k: st_open["slo"].get(k)
+                    for k in ("attainment", "burn_rate",
+                              "rejection_rate", "overloaded",
+                              "by_outcome")},
+            "phase_split": st_open.get("phase_split"),
+            # sampled solve-path profiling (serve_profile_every):
+            # per-pattern achieved-vs-roofline from fenced batches
+            "profile": st_open.get("profile"),
         }
     finally:
         svc.shutdown()

@@ -44,6 +44,15 @@ CASES = (
     ("resetup_s", _x(("extras", "classical_device_resetup48",
                       "resetup_warm_s"))),
     ("serve_p50_ms", _x(("extras", "serving", "p50_ms"))),
+    # live serving observability (ISSUE 9): the open-loop probe's tail
+    # latency, shed fraction and SLO attainment — the numbers the
+    # sustained-load SLO story trends on.  Pre-PR-9 rounds lack the
+    # fields and render "-"
+    ("serve_p99_ms", _x(("extras", "serving", "open_loop", "p99_ms"))),
+    ("rej%", lambda d: _pct(_x(
+        ("extras", "serving", "open_loop", "rejection_rate"))(d))),
+    ("slo%", lambda d: _pct(_x(
+        ("extras", "serving", "open_loop", "attainment"))(d))),
     # zero cold-start probe (ISSUE 8): fresh-process ready time with a
     # populated cache dir; old rounds lack the block and render "-"
     ("warm_s", _x(("extras", "warm_start", "warm_start_s"))),

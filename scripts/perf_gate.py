@@ -42,14 +42,23 @@ DEFAULT_ITERS_RATIO = 1.3
 #: absolute floor below which a time metric never regresses (tunnel
 #: latency noise dominates sub-second measurements)
 TIME_FLOOR_S = 0.25
+#: absolute slack for rate metrics (rejection rate): ratios are
+#: meaningless near zero — a baseline of 0.00 shed would flag ANY
+#: nonzero shed — so a rate regresses when it exceeds the baseline by
+#: this much in absolute terms
+RATE_SLACK = 0.05
 
 #: per-case metrics the gate tracks: (key in the case dict, kind).
 #: cold/warm_start_s come from the bench ``warm_start`` block (ISSUE 8:
 #: a compile-cache regression shows as warm_start_s creeping back
-#: toward cold_start_s — gate it like any other time metric)
+#: toward cold_start_s — gate it like any other time metric);
+#: serve_p99_s/rejection_rate come from the serving block's open-loop
+#: probe (ISSUE 9: the steady-state SLO numbers — a serving regression
+#: shows as the tail latency or the shed fraction creeping up)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
-           ("cold_start_s", "time"), ("warm_start_s", "time"))
+           ("cold_start_s", "time"), ("warm_start_s", "time"),
+           ("serve_p99_s", "time"), ("rejection_rate", "rate"))
 
 
 def _extract_parsed(rec: dict):
@@ -123,6 +132,19 @@ def load_round(path: str) -> dict:
                 if isinstance(d.get(k), (int, float))}
         if vals:
             cases[name] = vals
+    # the serving block IS tracked, but through its open-loop probe's
+    # steady-state numbers (ISSUE 9) — the closed-loop warm-up wave
+    # includes compile time and would make a useless baseline
+    ol = (extras.get("serving") or {}).get("open_loop") \
+        if isinstance(extras.get("serving"), dict) else None
+    if isinstance(ol, dict) and "error" not in ol:
+        vals = {}
+        if isinstance(ol.get("p99_ms"), (int, float)):
+            vals["serve_p99_s"] = round(ol["p99_ms"] / 1e3, 4)
+        if isinstance(ol.get("rejection_rate"), (int, float)):
+            vals["rejection_rate"] = ol["rejection_rate"]
+        if vals:
+            cases["serving"] = vals
     return cases
 
 
@@ -151,10 +173,14 @@ def compare(baseline: dict, cases: dict, time_ratio=None,
                     not isinstance(v, (int, float)):
                 continue
             checked += 1
-            ratio = t_ratio if kind == "time" else i_ratio
-            limit = b * ratio
-            if kind == "time" and limit < TIME_FLOOR_S:
-                limit = TIME_FLOOR_S
+            if kind == "rate":
+                # absolute slack, not a ratio: rates live near zero
+                limit = b + RATE_SLACK
+            else:
+                ratio = t_ratio if kind == "time" else i_ratio
+                limit = b * ratio
+                if kind == "time" and limit < TIME_FLOOR_S:
+                    limit = TIME_FLOOR_S
             if v > limit:
                 regressions.append({
                     "case": case, "metric": key, "baseline": b,

@@ -356,17 +356,106 @@ def main():
              "section")
     telemetry.setup_profile.disable()
 
+    # 12. live serving observability (ISSUE 9): a live SolveService
+    # with telemetry on emits schema-valid request_trace/slo_window
+    # events, its /metrics + /healthz endpoint answers while it
+    # serves, and the doctor's SLO section renders from the trace
+    telemetry.reset()
+    telemetry.disable()
+    import urllib.request
+
+    from amgx_tpu.serve.service import SolveService
+    path_o = path + ".serve_obs"
+    if os.path.exists(path_o):
+        os.unlink(path_o)
+    cfg_o = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        "serve_workers=2, serve_batch_window_ms=2, "
+        "slo_latency_ms=60000, slo_target=0.99, "
+        f"out:telemetry=1, out:telemetry_path={path_o}")
+    svc = SolveService(cfg_o)
+    try:
+        url = svc.start_endpoint(0)   # ephemeral port, loopback only
+        mo = amgx.Matrix(A)
+        import numpy as _np
+        pend = [svc.submit(mo, _np.ones(A.shape[0])) for _ in range(6)]
+        for p in pend:
+            if p.wait(timeout=120.0) is None:
+                fail(f"serving smoke request failed: rc={p.rc} "
+                     f"{p.error}")
+        st = svc.stats()              # publishes amgx_slo_* + slo_window
+        if st["slo"]["attainment"] != 1.0:
+            fail(f"serving smoke attainment != 1.0: {st['slo']}")
+        if not st["phase_split"].get("solve", {}).get("count"):
+            fail(f"phase split missing solve: {st['phase_split']}")
+        mtxt = urllib.request.urlopen(url + "/metrics",
+                                      timeout=10).read().decode()
+        for name in ("amgx_serve_phase_seconds", "amgx_slo_attainment",
+                     "amgx_serve_batch_size"):
+            if name not in mtxt:
+                fail(f"/metrics scrape is missing {name!r}")
+        hz = json.loads(urllib.request.urlopen(url + "/healthz",
+                                               timeout=10).read())
+        for key in ("ok", "accepting", "queue_depth", "queue_capacity",
+                    "inflight", "overloaded", "slo_attainment"):
+            if key not in hz:
+                fail(f"/healthz is missing {key!r}: {hz}")
+        if hz["overloaded"] or not hz["accepting"]:
+            fail(f"idle service reads unhealthy: {hz}")
+        telemetry.flush_jsonl(path_o)
+    finally:
+        svc.shutdown()
+    with open(path_o) as f:
+        lines_o = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_o)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"serving trace: {e}")
+    recs_o = [json.loads(l) for l in lines_o if l.strip()]
+    traces = [r["attrs"] for r in recs_o if r["kind"] == "event"
+              and r["name"] == "request_trace"]
+    if len(traces) < 6:
+        fail(f"expected >= 6 request_trace events, saw {len(traces)}")
+    for a in traces:
+        offs = list(a["marks"].values())
+        if offs != sorted(offs):
+            fail(f"request_trace mark offsets not monotone: {a}")
+        if abs(sum(a["phases"].values()) - a["latency_s"]) > 5e-6:
+            fail(f"request_trace phases do not telescope: {a}")
+    if not any(r["kind"] == "event" and r["name"] == "slo_window"
+               for r in recs_o):
+        fail("serving trace is missing the slo_window event")
+    diag_o = doctor.diagnose([path_o])
+    slo_d = diag_o.get("slo")
+    if not slo_d or slo_d.get("outcomes", {}).get("ok", 0) < 6:
+        fail(f"doctor SLO section empty/short: {slo_d}")
+    if "SLO (windowed attainment" not in doctor.render(diag_o):
+        fail("doctor report is missing the SLO section")
+    trace_o = telemetry.chrome_trace(path_o)
+    telemetry.validate_chrome_trace(trace_o)
+    if not any(e["ph"] == "b" and e.get("cat") == "request"
+               for e in trace_o["traceEvents"]):
+        fail("chrome trace is missing async request slices")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
-          f"setup-profile OK, coverage {cov:.0%}, device-setup OK)")
+          f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
+          f"serving-obs OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
         os.unlink(path_s)
         os.unlink(path_d)
         os.unlink(path_d2)
+        os.unlink(path_o)
 
 
 if __name__ == "__main__":

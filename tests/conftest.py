@@ -55,6 +55,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "aot: compile-cache / AOT-store warm-start fast "
                    "tests (tier-1; pytest -m aot selects just these)")
+    config.addinivalue_line(
+        "markers", "serve_obs: live serving observability fast tests "
+                   "(tier-1; pytest -m serve_obs selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

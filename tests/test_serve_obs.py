@@ -1,0 +1,448 @@
+"""Live serving observability tests (ISSUE 9): request-lifecycle
+tracing, the SLO window, the in-process endpoint, and sampled
+solve-path profiling.
+
+The acceptance contract: phase timestamps are monotone and telescope to
+the end-to-end latency exactly, /metrics and /healthz answer while the
+service is under concurrent load, the SLO window evicts by age and its
+burn-rate math is the SRE formula, shed requests (rejected AND
+deadline-expired) are visible in attainment instead of vanishing from
+the percentiles, and the solve-path profiler fires every Nth batch —
+and never when the knob is 0.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.errors import RC
+from amgx_tpu.io import poisson5pt
+from amgx_tpu.serve.service import SolveService
+from amgx_tpu.telemetry.slo import (OVERLOAD_REJECT_RATE, SLOWindow,
+                                    WAITED_OUTCOMES)
+
+pytestmark = pytest.mark.serve_obs
+
+
+AMG_PCG_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-10, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def _service_cfg(extra=""):
+    return amgx.AMGConfig(AMG_PCG_CFG + ", serve_batch_window_ms=5, "
+                          "serve_workers=2, serve_max_batch=8" + extra)
+
+
+def _poisson():
+    import scipy.sparse as sp
+    return sp.csr_matrix(poisson5pt(9, 9))
+
+
+# ---------------------------------------------------------------------------
+# request-lifecycle tracing
+# ---------------------------------------------------------------------------
+def test_phase_marks_monotone_and_telescope(rng):
+    """Every request's marks are monotone in time and the labelled
+    phase durations sum to the end-to-end latency EXACTLY (telescoping
+    sum — same clock, consecutive gaps)."""
+    A = _poisson()
+    n = A.shape[0]
+    with telemetry.capture() as tel:
+        with SolveService(_service_cfg()) as svc:
+            pend = [svc.submit(amgx.Matrix(A), rng.standard_normal(n))
+                    for _ in range(6)]
+            for p in pend:
+                assert p.wait(120) is not None
+            reqs = [p._request for p in pend]
+            for r in reqs:
+                times = [t for _, t in r.marks]
+                assert times == sorted(times)
+                names = [nm for nm, _ in r.marks]
+                assert names[0] == "submitted" and names[-1] == "done"
+                # the full lifecycle was marked, in order
+                assert names == ["submitted", "admitted", "executing",
+                                 "prepared", "solved", "done"]
+                total = sum(r.phase_durations().values())
+                assert total == pytest.approx(r.latency_s(), abs=1e-12)
+                assert r.outcome() == "ok"
+    # one schema-valid request_trace event per request: "marks" are the
+    # monotone offsets, "phases" speak the documented phase vocabulary
+    # (the histogram's label set) and telescope to the latency
+    traces = tel.events("request_trace")
+    assert len(traces) == 6
+    ids = set()
+    for e in traces:
+        a = e["attrs"]
+        telemetry.validate_record(e)
+        ids.add(a["trace_id"])
+        offs = list(a["marks"].values())
+        assert offs == sorted(offs)
+        assert set(a["phases"]) == {"admit", "queue_wait", "prepare",
+                                    "solve", "finalize"}
+        assert sum(a["phases"].values()) == pytest.approx(
+            a["latency_s"], abs=5e-6)       # rounded to 6 digits each
+        assert a["outcome"] == "ok"
+        assert a["latency_s"] == pytest.approx(offs[-1], rel=1e-3)
+    assert len(ids) == 6          # trace ids are unique
+
+
+def test_stats_phase_split_and_histogram(rng):
+    """stats() carries the queue-wait vs solve split and the per-phase
+    histogram observes every lifecycle phase."""
+    A = _poisson()
+    n = A.shape[0]
+    with telemetry.capture() as tel:
+        with SolveService(_service_cfg()) as svc:
+            for _ in range(4):
+                svc.solve(amgx.Matrix(A), rng.standard_normal(n),
+                          timeout=120)
+            st = svc.stats()
+    ps = st["phase_split"]
+    for phase in ("admit", "queue_wait", "prepare", "solve", "finalize"):
+        assert ps[phase]["count"] == 4
+        assert ps[phase]["mean_s"] >= 0.0
+    phases = {h["labels"]["phase"] for h in tel.metric_records(
+        "amgx_serve_phase_seconds", kind="hist")}
+    assert {"admit", "queue_wait", "prepare", "solve",
+            "finalize"} <= phases
+
+
+# ---------------------------------------------------------------------------
+# the SLO window
+# ---------------------------------------------------------------------------
+def test_slo_window_evicts_by_age():
+    w = SLOWindow(window_s=10.0)
+    w.record(0.1, "ok", now=0.0)
+    w.record(0.2, "ok", now=5.0)
+    w.record(0.3, "failed", now=9.0)
+    assert w.counts(now=9.0) == {"ok": 2, "failed": 1, "rejected": 0,
+                                 "expired": 0, "error": 0}
+    # advance past the first sample's age
+    assert w.counts(now=11.0)["ok"] == 1
+    # and past everything
+    assert sum(w.counts(now=25.0).values()) == 0
+    assert w.attainment(now=25.0) is None
+    assert w.burn_rate(now=25.0) is None
+
+
+def test_slo_burn_rate_math():
+    """attainment = good/total; burn = (1-att)/(1-target).  99% target
+    with 90% attainment burns the budget at 10×."""
+    w = SLOWindow(window_s=1e6, latency_ms=100.0, target=0.99)
+    for _ in range(90):
+        w.record(0.01, "ok", now=0.0)          # good: fast OK
+    for _ in range(5):
+        w.record(0.5, "ok", now=0.0)           # OK but over the 100 ms
+    for _ in range(5):
+        w.record(0.0, "rejected", now=0.0)     # shed
+    assert w.attainment(now=0.0) == pytest.approx(0.90)
+    assert w.burn_rate(now=0.0) == pytest.approx(10.0)
+    # deadline misses are not good even when fast
+    w2 = SLOWindow(window_s=1e6, target=0.5)
+    w2.record(0.01, "ok", deadline_met=False, now=0.0)
+    assert w2.attainment(now=0.0) == 0.0
+    assert w2.burn_rate(now=0.0) == pytest.approx(2.0)
+
+
+def test_slo_percentiles_exclude_admission_rejections():
+    """Admission rejections return in microseconds — they count against
+    attainment but must NOT drag the latency percentiles toward zero."""
+    w = SLOWindow(window_s=1e6)
+    for _ in range(10):
+        w.record(1.0, "ok", now=0.0)
+        w.record(1e-6, "rejected", now=0.0)
+    assert "rejected" not in WAITED_OUTCOMES
+    assert w.percentiles(now=0.0)["p50"] == pytest.approx(1.0)
+    # expired requests DID wait — they are in the population
+    w.record(9.0, "expired", now=0.0)
+    assert w.percentiles(now=0.0)["p99"] == pytest.approx(9.0)
+    assert w.attainment(now=0.0) == pytest.approx(10 / 21)
+
+
+def test_overload_trip_wire():
+    w = SLOWindow(window_s=1e6)
+    for _ in range(97):
+        w.record(0.1, "ok", now=0.0)
+    assert not w.overloaded(now=0.0)
+    for _ in range(10):
+        w.record(0.0, "rejected", now=0.0)     # ~9.3% shed
+    assert 10 / 107 > OVERLOAD_REJECT_RATE
+    assert w.overloaded(now=0.0)
+    # the queue-depth leg trips BEFORE the first rejection
+    w2 = SLOWindow(window_s=1e6)
+    assert not w2.overloaded(queue_depth=1, queue_capacity=10, now=0.0)
+    assert w2.overloaded(queue_depth=9, queue_capacity=10, now=0.0)
+
+
+def test_rejected_and_expired_visible_in_attainment(rng):
+    """The blind spot this PR removes: shed requests (admission
+    rejections AND deadline expiries) land in the SLO window and lower
+    attainment — an overloaded service can no longer look healthy by
+    shedding."""
+    A = _poisson()
+    n = A.shape[0]
+    svc = SolveService(_service_cfg())
+    try:
+        ok = svc.submit(amgx.Matrix(A), rng.standard_normal(n))
+        assert ok.wait(120) is not None
+        # a deadline in the past: the worker sheds it at queue exit
+        exp = svc.submit(amgx.Matrix(A), rng.standard_normal(n),
+                         deadline_s=1e-9)
+        assert exp.wait_done(120) and exp.rc == RC.REJECTED
+        assert "deadline" in exp.error
+        # stop admission: the next submit is an admission rejection
+        assert svc.drain(60)
+        rej = svc.submit(amgx.Matrix(A), rng.standard_normal(n))
+        assert rej.rc == RC.REJECTED
+        snap = svc.slo.snapshot()
+    finally:
+        svc.shutdown()
+    assert snap["by_outcome"]["ok"] == 1
+    assert snap["by_outcome"]["expired"] == 1
+    assert snap["by_outcome"]["rejected"] == 1
+    assert snap["attainment"] == pytest.approx(1 / 3)
+    assert snap["rejection_rate"] == pytest.approx(2 / 3)
+    # the old return shape survives, now fed by the window
+    lat = svc.latency_percentiles()
+    assert set(lat) == {"p50", "p95", "p99"}
+
+
+# ---------------------------------------------------------------------------
+# the endpoint under concurrent load
+# ---------------------------------------------------------------------------
+def test_endpoint_scrape_under_concurrent_load(rng):
+    """/metrics and /healthz answer correctly WHILE workers are solving
+    — scrapes from several threads, load from several more."""
+    A = _poisson()
+    n = A.shape[0]
+    with telemetry.capture():
+        with SolveService(_service_cfg(", slo_latency_ms=60000")) as svc:
+            url = svc.start_endpoint(0)       # ephemeral loopback port
+            assert url.startswith("http://127.0.0.1:")
+            assert svc.endpoint == url
+            errors = []
+            scrapes = {"metrics": 0, "healthz": 0}
+
+            def load():
+                try:
+                    for _ in range(3):
+                        svc.solve(amgx.Matrix(A),
+                                  rng.standard_normal(n), timeout=120)
+                except Exception as e:      # noqa: BLE001
+                    errors.append(e)
+
+            def scrape():
+                try:
+                    for _ in range(4):
+                        m = urllib.request.urlopen(url + "/metrics",
+                                                   timeout=30)
+                        assert m.status == 200
+                        scrapes["metrics"] += 1
+                        h = urllib.request.urlopen(url + "/healthz",
+                                                   timeout=30)
+                        body = json.loads(h.read())
+                        assert h.status == 200 and body["ok"]
+                        assert body["queue_capacity"] == svc.queue_depth
+                        scrapes["healthz"] += 1
+                except Exception as e:      # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=load) for _ in range(2)]
+            threads += [threading.Thread(target=scrape)
+                        for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors
+            assert scrapes == {"metrics": 8, "healthz": 8}
+            svc.stats()                     # publish the SLO gauges
+            text = urllib.request.urlopen(url + "/metrics",
+                                          timeout=30).read().decode()
+            for name in ("amgx_serve_phase_seconds",
+                         "amgx_slo_attainment", "amgx_slo_burn_rate"):
+                assert name in text
+            # the debug trace drain returns validating JSONL
+            tr = urllib.request.urlopen(url + "/debug/trace",
+                                        timeout=30).read().decode()
+            lines = tr.strip().splitlines()
+            telemetry.validate_jsonl(lines)
+            assert any('"request_trace"' in l for l in lines)
+        # shutdown stopped the endpoint with the service
+        assert svc.endpoint is None
+
+
+def test_healthz_503_when_overloaded():
+    """The load-balancer eviction contract: /healthz flips to 503 the
+    moment the SLO window reads overloaded — and stays 503 for a
+    drained service (accepting=false), which rejects every submission
+    long before the shed rate would trip the wire."""
+    svc = SolveService(_service_cfg(), start=False)
+    try:
+        url = svc.start_endpoint(0)
+        # not started yet → not accepting → unroutable
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        svc.start()
+        assert urllib.request.urlopen(url + "/healthz",
+                                      timeout=30).status == 200
+        for _ in range(20):
+            svc.slo.record(0.0, "rejected")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["overloaded"] is True
+        svc.slo.reset()
+        assert svc.drain(60)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["accepting"] is False
+        assert body["overloaded"] is False
+    finally:
+        svc.shutdown()
+
+
+def test_unknown_route_404():
+    svc = SolveService(_service_cfg(), start=False)
+    try:
+        url = svc.start_endpoint(0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/nope", timeout=30)
+        assert ei.value.code == 404
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# sampled solve-path profiling
+# ---------------------------------------------------------------------------
+def test_profiler_respects_sampling_knob(rng):
+    """serve_profile_every=1 profiles every batch; the stats block
+    carries achieved-vs-roofline per pattern."""
+    A = _poisson()
+    n = A.shape[0]
+    with telemetry.capture() as tel:
+        with SolveService(_service_cfg(", serve_profile_every=1")) as svc:
+            for _ in range(3):
+                svc.solve(amgx.Matrix(A), rng.standard_normal(n),
+                          timeout=120)
+            st = svc.stats()
+    assert st["profile"] is not None
+    (entry,) = st["profile"].values()
+    assert entry["captures"] >= 1
+    assert entry["solve_s"] > 0
+    assert entry["achieved_gbs"] > 0
+    assert 0.0 <= entry["roofline_fraction"] <= 1.0
+    assert tel.events("serve_profile")
+    assert tel.counter_total("amgx_serve_profile_total") >= 1
+
+
+def test_profiler_inert_at_zero(rng):
+    """The default (serve_profile_every=0) never profiles — no stats
+    block, no counter, no event."""
+    A = _poisson()
+    n = A.shape[0]
+    with telemetry.capture() as tel:
+        with SolveService(_service_cfg()) as svc:
+            for _ in range(3):
+                svc.solve(amgx.Matrix(A), rng.standard_normal(n),
+                          timeout=120)
+            st = svc.stats()
+    assert st["profile"] is None
+    assert not tel.events("serve_profile")
+    assert tel.counter_total("amgx_serve_profile_total") == 0
+
+
+# ---------------------------------------------------------------------------
+# trace export + schema
+# ---------------------------------------------------------------------------
+def test_chrome_trace_request_slices_and_worker_tracks(rng, tmp_path):
+    """The Chrome-trace export carries one async b/e pair per request
+    (keyed by its trace id) and names the worker-thread tracks."""
+    A = _poisson()
+    n = A.shape[0]
+    path = str(tmp_path / "serve.jsonl")
+    telemetry.reset()        # dump_jsonl writes the whole ring
+    with telemetry.capture():
+        with SolveService(_service_cfg()) as svc:
+            for _ in range(4):
+                svc.solve(amgx.Matrix(A), rng.standard_normal(n),
+                          timeout=120)
+        telemetry.dump_jsonl(path)
+    trace = telemetry.chrome_trace(path)
+    telemetry.validate_chrome_trace(trace)
+    ev = trace["traceEvents"]
+    begins = [e for e in ev if e["ph"] == "b" and e["cat"] == "request"]
+    ends = [e for e in ev if e["ph"] == "e" and e["cat"] == "request"]
+    assert len(begins) == 4 and len(ends) == 4
+    assert {e["id"] for e in begins} == {e["id"] for e in ends}
+    # the serving batch slice links back to the requests it carried
+    batches = [e for e in ev if e["ph"] == "X"
+               and e["name"] == "serve_batch"]
+    linked = {rid for e in batches
+              for rid in e["args"].get("trace_ids", [])}
+    assert {e["id"] for e in begins} <= linked
+    # worker tracks are named
+    names = [e for e in ev if e["ph"] == "M"
+             and e["name"] == "thread_name"]
+    assert any(e["args"]["name"].startswith("serve-worker-")
+               for e in names)
+
+
+def test_slo_window_event_schema_and_doctor(rng, tmp_path):
+    """stats() emits a schema-valid slo_window event and the doctor
+    renders an SLO section with the outcome table from the trace."""
+    A = _poisson()
+    n = A.shape[0]
+    path = str(tmp_path / "slo.jsonl")
+    telemetry.reset()        # dump_jsonl writes the whole ring
+    with telemetry.capture():
+        with SolveService(_service_cfg()) as svc:
+            for _ in range(2):
+                svc.solve(amgx.Matrix(A), rng.standard_normal(n),
+                          timeout=120)
+            svc.stats()
+        telemetry.dump_jsonl(path)
+    with open(path) as f:
+        lines = f.readlines()
+    telemetry.validate_jsonl(lines)
+    recs = [json.loads(l) for l in lines if l.strip()]
+    assert any(r["kind"] == "event" and r["name"] == "slo_window"
+               for r in recs)
+    from amgx_tpu.telemetry import doctor
+    diag = doctor.diagnose([path])
+    assert diag["slo"]["outcomes"]["ok"] == 2
+    assert diag["slo"]["phase_split"]["solve"]["count"] == 2
+    report = doctor.render(diag)
+    assert "SLO (windowed attainment" in report
+    assert "outcome ok" in report
+
+
+def test_loadgen_reports_attainment(rng):
+    """run_load carries attainment + burn rate against the slo_*
+    objectives (the bench serving block embeds exactly this)."""
+    from amgx_tpu.serve import loadgen
+    A = _poisson()
+    with SolveService(_service_cfg(", slo_latency_ms=60000")) as svc:
+        out = loadgen.run_load(svc, [amgx.Matrix(A)], rps=30.0,
+                               duration_s=0.5, seed=7)
+    assert out["attainment"] == pytest.approx(1.0)
+    assert out["burn_rate"] == pytest.approx(0.0)
+    assert out["slo"]["objective"]["latency_ms"] == 60000.0
+    assert out["slo"]["by_outcome"]["ok"] == out["completed"]
