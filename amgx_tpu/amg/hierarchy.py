@@ -112,9 +112,17 @@ def _narrow_dia(cur: Matrix, arrs):
     """Mixed precision: coarse GRIDS live in the device dtype — they are
     preconditioner data (outer refinement owns final accuracy, the
     reference's dDFI split); narrowing before the Galerkin halves its
-    bandwidth and makes every coarse pack a zero-copy view."""
+    bandwidth and makes every coarse pack a zero-copy view.
+
+    The narrowing FLOORS at f32: an 8-bit-mantissa (bf16) Galerkin
+    product would distort the hierarchy itself, so sub-f32 device
+    dtypes keep the setup math in f32 and the values are cast at
+    upload (``Matrix.device`` / the precision policy's views)."""
     dd = np.dtype(cur.device_dtype) if cur.device_dtype is not None \
         else None
+    if dd is not None:
+        from ..core.precision import compute_dtype
+        dd = compute_dtype(dd)
     if dd is not None and dd.itemsize < arrs[1].dtype.itemsize:
         return (arrs[0], arrs[1].astype(dd))
     return arrs
@@ -164,6 +172,16 @@ class AMGHierarchy:
         #: convergence forensics (telemetry/forensics.py): cycle-anatomy
         #: instrumentation in build_cycle + setup-time quality probes
         self.forensics = int(g("forensics"))
+        #: mixed precision (core/precision.py): storage dtype of level
+        #: operators, smoother data and transfer packs from
+        #: ``mixed_precision_from_level`` down; None = inherit (a
+        #: sub-f32 fine-matrix device_dtype implies the policy so the
+        #: tpu_matrix_dtype=bfloat16 path narrows device-born levels
+        #: too).  Setup math (strength/interp/RAP) always runs at f32+
+        #: — values are narrowed at upload or by a device-side cast.
+        from ..core.precision import resolve_dtype
+        self.hierarchy_dtype = resolve_dtype(str(g("hierarchy_dtype")))
+        self.mixed_from_level = int(g("mixed_precision_from_level"))
         #: device-side setup engine (amg/device_setup/): route the
         #: classical/aggregation Galerkin RAP through pattern-keyed
         #: device SpGEMM executables (host scipy stays the fallback)
@@ -592,8 +610,25 @@ class AMGHierarchy:
         dvals = curd.vals if keep is None else curd.vals[keep]
         with cpu_profiler("dia_device_derive"), \
                 setup_profile.phase("dia_derive", kind="device"):
-            outs = derive_hierarchy_device(steps, offs, dvals)
+            outs = self._derive_dia_f32(steps, offs, dvals)
         return self._append_dia_levels(cur, steps, outs)
+
+    @staticmethod
+    def _derive_dia_f32(steps, offs, dvals):
+        """Run the device hierarchy derivation with the Galerkin math in
+        f32+ even when the fine pack stores bf16 (the narrowing rule:
+        RAP never computes below f32); outputs are cast back to the
+        storage dtype on device."""
+        from ..core.precision import is_sub_f32
+        from .dia_device import derive_hierarchy_device
+        store_dt = dvals.dtype
+        narrow = is_sub_f32(store_dt)
+        if narrow:
+            dvals = dvals.astype(np.float32)
+        outs = derive_hierarchy_device(steps, offs, dvals)
+        if narrow:
+            outs = [tuple(a.astype(store_dt) for a in o) for o in outs]
+        return outs
 
     def _reuse_dia_device(self, cur: Matrix, old) -> tuple:
         """Numeric refresh of a reused structured/pairwise prefix ON
@@ -639,7 +674,7 @@ class AMGHierarchy:
         dvals = curd.vals if keep is None else curd.vals[keep]
         with cpu_profiler("dia_device_derive"), \
                 setup_profile.phase("dia_derive", kind="device"):
-            outs = derive_hierarchy_device(steps, offs, dvals)
+            outs = self._derive_dia_f32(steps, offs, dvals)
         return len(steps), self._append_dia_levels(cur, steps, outs)
 
     #: below this logical size the device pipeline hands the tail to the
@@ -726,9 +761,14 @@ class AMGHierarchy:
         # path takes over rather than OOMing the chip.
         from .classical.device_pipeline import (ahat_plan,
                                                 rap_candidate_offsets)
+        from ..core.precision import compute_dtype
         p_offs = ahat_plan(offs)[0] if params["interp_d2"] else offs
         n_cand = len(rap_candidate_offsets(offs, p_offs))
-        itemsize = np.dtype(cur.device_dtype or cur.dtype).itemsize
+        # the pipeline's Galerkin math runs at the COMPUTE dtype (f32
+        # floor — see _narrow_dia's narrowing rule), so the HBM guard
+        # sizes the f32 intermediates even for a bf16 fine pack
+        itemsize = compute_dtype(
+            np.dtype(cur.device_dtype or cur.dtype)).itemsize
         if n_cand * cur.n_block_rows * itemsize > (8 << 30):
             return None
         import jax.numpy as jnp
@@ -742,6 +782,12 @@ class AMGHierarchy:
         seed = _tiebreak_seed(self.cfg)
         n = cur.n_block_rows
         dvals = curd.vals if keep is None else curd.vals[keep]
+        from ..core.precision import is_sub_f32
+        if is_sub_f32(dvals.dtype):
+            # strength/PMIS/interpolation/RAP never compute below f32;
+            # the precision policy narrows the resulting level PACKS
+            # afterwards (setup math wide, storage narrow)
+            dvals = dvals.astype(jnp.float32)
         with cpu_profiler("classical_device_fine_embedded"), \
                 setup_profile.phase("device_fine", level=0,
                                     kind="device"):
@@ -1367,6 +1413,66 @@ class AMGHierarchy:
                                  n_coarse=n_parts * nc_loc)
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
+    def _effective_hierarchy_dtype(self):
+        """The per-level storage dtype the precision policy applies, or
+        None.  An explicit ``hierarchy_dtype`` wins; otherwise a
+        sub-f32 fine-matrix ``device_dtype`` (the tpu_matrix_dtype /
+        AMGX mode path) implies the same narrowing for device-born
+        levels, which inherit-by-construction only on the host paths."""
+        if self.hierarchy_dtype is not None:
+            return np.dtype(self.hierarchy_dtype)
+        if not self.levels:
+            return None
+        from ..core.precision import is_sub_f32
+        fine = self.levels[0].A
+        dd = getattr(fine, "device_dtype", None)
+        if dd is not None and is_sub_f32(dd):
+            return np.dtype(dd)
+        return None
+
+    def _apply_precision_policy(self):
+        """Narrow the STORED hierarchy to the policy dtype, level
+        ``mixed_precision_from_level`` down: each covered level's
+        operator and transfer packs are replaced by precision views
+        (``core.precision.precision_view`` — device-side cast when the
+        f32 pack already exists, cast-on-upload otherwise).  Host-side
+        setup structures stay shared and wide, the caller's matrix and
+        the coarsest grid (dense-LU data) are untouched, and packs
+        whose SpMV would lose an f32-only kernel keep their dtype."""
+        hd = self._effective_hierarchy_dtype()
+        if hd is None:
+            return
+        from ..core import precision
+        from_level = max(self.mixed_from_level, 0)
+        for i, lvl in enumerate(self.levels):
+            if i < from_level:
+                continue
+            A = lvl.A
+            if isinstance(A, Matrix) and A.dist is None:
+                cur_dt = np.dtype(A.device_dtype or A.dtype)
+                if hd.itemsize < cur_dt.itemsize:
+                    view = precision.precision_view(A, hd)
+                    if view is not A:
+                        lvl.A = view
+                        lvl._Ad = view._device
+            for mslot, dslot in (("_Pm", "_Pd"), ("_Rm", "_Rd")):
+                Pm = getattr(lvl, mslot, None)
+                if Pm is not None:
+                    if Pm.dist is not None:
+                        continue
+                    pdt = np.dtype(Pm.device_dtype or Pm.dtype)
+                    if hd.itemsize < pdt.itemsize:
+                        v = precision.precision_view(Pm, hd)
+                        if v is not Pm:
+                            setattr(lvl, mslot, v)
+                            setattr(lvl, dslot, v._device)
+                elif getattr(lvl, dslot, None) is not None:
+                    # device-born transfer (classical device pipeline)
+                    d = getattr(lvl, dslot)
+                    if precision.narrowable_pack(d) and \
+                            np.dtype(d.dtype).itemsize > hd.itemsize:
+                        setattr(lvl, dslot, d.astype(hd))
+
     def _setup_smoothers_and_coarse(self, coarsest: Matrix):
         from ..core.matrix import batch_upload
         from ..utils.thread_manager import ThreadManager
@@ -1384,6 +1490,10 @@ class AMGHierarchy:
                     setup_profile.phase("upload", kind="device"):
                 stream.join_threads()
             self._stream_uploader = None
+        # mixed precision: the policy runs AFTER the streamed uploads
+        # land (their f32 packs cast on device, zero wire bytes) and
+        # BEFORE the arena upload (host-built levels then ship narrow)
+        self._apply_precision_policy()
         with cpu_profiler("hierarchy_upload"), \
                 setup_profile.phase("upload", kind="device"):
             mats, fine_ids = [], set()
@@ -1461,6 +1571,28 @@ class AMGHierarchy:
                         grid_complexity=round(grid_cmpl, 6),
                         setup_s=round(self.setup_time, 6))
 
+    def level_costs(self, sizes=None) -> List[tuple]:
+        """(level index, spmv cost dict) per level whose device pack
+        already exists, fine to coarsest — the single pack walk behind
+        the cost-telemetry gauges AND bench's bytes-per-cycle column.
+        Reads packs only where they are materialised (never triggers a
+        device upload as a side effect — ``.Ad`` would)."""
+        from ..telemetry import costmodel
+        if sizes is None:
+            sizes = self.level_sizes()
+        packs = [l._Ad if l._Ad is not None
+                 else getattr(l.A, "_device", None) for l in self.levels]
+        packs.append(getattr(self.coarsest, "_device", None))
+        out = []
+        for i, Ad in enumerate(packs):
+            if Ad is None:
+                continue
+            try:
+                out.append((i, costmodel.spmv_cost(Ad, nnz=sizes[i][1])))
+            except Exception:
+                continue      # a cost-model gap must never break setup
+        return out
+
     def _emit_cost_telemetry(self, sizes):
         """Per-level static cost descriptors (telemetry/costmodel.py):
         modelled SpMV bytes/FLOPs and the padding-waste ratio of each
@@ -1468,30 +1600,24 @@ class AMGHierarchy:
         achieved-vs-peak bandwidth fractions.  ``sizes`` is the
         ``level_sizes()`` list, so the true nnz comes for free (no
         device download just for telemetry)."""
-        from ..telemetry import costmodel
         reg = telemetry.registry()
         for name in ("amgx_level_spmv_bytes", "amgx_level_spmv_flops",
                      "amgx_level_padding_waste"):
             reg.gauge_clear(name)
-        # read packs only where they already exist (telemetry must not
-        # trigger a device upload as a side effect — `.Ad` would)
-        packs = [l._Ad if l._Ad is not None
-                 else getattr(l.A, "_device", None) for l in self.levels]
-        packs.append(getattr(self.coarsest, "_device", None))
-        for i, Ad in enumerate(packs):
-            if Ad is None:
-                continue
-            try:
-                cost = costmodel.spmv_cost(Ad, nnz=sizes[i][1])
-            except Exception:
-                continue      # a cost-model gap must never break setup
+        for i, cost in self.level_costs(sizes):
             if cost.get("bytes_per_apply") is not None:
+                # dtype-labeled (mixed precision): a Prometheus consumer
+                # can see per level which precision the bytes stream at
+                dt = str(cost.get("dtype", "?"))
                 telemetry.gauge_set("amgx_level_spmv_bytes",
-                                    cost["bytes_per_apply"], level=i)
+                                    cost["bytes_per_apply"], level=i,
+                                    dtype=dt)
                 telemetry.gauge_set("amgx_level_spmv_flops",
-                                    cost["flops_per_apply"], level=i)
+                                    cost["flops_per_apply"], level=i,
+                                    dtype=dt)
                 telemetry.gauge_set("amgx_level_padding_waste",
-                                    cost["padding_waste"], level=i)
+                                    cost["padding_waste"], level=i,
+                                    dtype=dt)
             telemetry.event("level_cost", level=i, **cost)
 
     def grid_stats(self) -> str:

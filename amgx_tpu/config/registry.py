@@ -278,7 +278,27 @@ def register_default_parameters():
     R("eig_eigenvector_solver", str, "default")
     # --- TPU-build extensions (no reference equivalent)
     R("tpu_matrix_dtype", str, "default",
-      "override device matrix dtype <default|float64|float32|bfloat16>")
+      "override device matrix dtype <default|float64|float32|bfloat16>",
+      ("default", "float64", "float32", "bfloat16"))
+    # mixed precision (core/precision.py — the dDFI mixed-mode analog,
+    # amgx_config.h:114-123): the AMG hierarchy's level operators,
+    # smoother data and transfer packs are STORED in hierarchy_dtype
+    # (arithmetic accumulates in f32); Krylov vectors, dot products and
+    # residual monitoring run in krylov_dtype; tolerances below the
+    # active precision's floor promote through the defect-correction
+    # ladder (bf16 preconditioner -> f32 Krylov -> f64 refinement)
+    R("hierarchy_dtype", str, "default",
+      "storage dtype of AMG hierarchy levels from "
+      "mixed_precision_from_level down (bf16 halves per-cycle HBM "
+      "bytes; RAP/setup still compute in f32+)",
+      ("default", "float64", "float32", "bfloat16"))
+    R("krylov_dtype", str, "default",
+      "device dtype of the outer Krylov loop (vectors, dots, residual "
+      "monitoring); applied by the top-level solver only",
+      ("default", "float64", "float32", "bfloat16"))
+    R("mixed_precision_from_level", int, 0,
+      "first hierarchy level stored in hierarchy_dtype (0 = the whole "
+      "hierarchy incl. the fine-level smoothing pack)")
     R("tpu_ell_max_width", int, 2048,
       "max padded row width before SpMV falls back to CSR segment-sum")
     # structured telemetry (amgx_tpu/telemetry/): process-global

@@ -202,6 +202,15 @@ class ComposedDIA:
     def dtype(self):
         return self.diag.dtype
 
+    def astype(self, dtype) -> "ComposedDIA":
+        """Cast every factor's streams (mixed precision: the composed
+        apply reads P/A/R diagonal rows — narrowing them is exactly the
+        per-apply bandwidth the bf16 hierarchy buys)."""
+        return dataclasses.replace(
+            self, P=self.P.astype(dtype), A=self.A.astype(dtype),
+            R=self.R.astype(dtype), diag=self.diag.astype(dtype),
+            l1row=self.l1row.astype(dtype))
+
 
 def pack_kind(Ad) -> str:
     """Human-readable pack/kernel selection of a device matrix — the
@@ -358,7 +367,9 @@ class Matrix:
         #: preferred dtype of the device pack (mixed precision: host keeps
         #: the wide dtype for setup + iterative-refinement residuals while
         #: the device computes narrow — the reference's dDFI mixed mode,
-        #: amgx_config.h:114-123)
+        #: amgx_config.h:114-123).  A property: changing it invalidates
+        #: the cached pattern fingerprint, which is dtype-keyed so the
+        #: serving/AOT caches never reuse a hierarchy across precisions.
         self.device_dtype = None
         #: cached row-aligned diagonal decomposition (offsets, vals) — the
         #: hierarchy's native representation for stencil operators; when a
@@ -372,6 +383,18 @@ class Matrix:
         self._dia_thunk = None
         if a is not None:
             self.set(a, block_dim=block_dim)
+
+    @property
+    def device_dtype(self):
+        return self._device_dtype_pref
+
+    @device_dtype.setter
+    def device_dtype(self, v):
+        self._device_dtype_pref = None if v is None else np.dtype(v)
+        # the pattern fingerprint is precision-keyed (equal structure at
+        # different pack dtypes must NOT share a serving session's
+        # hierarchy through resetup) — a dtype change invalidates it
+        self._pattern_fp = None
 
     def set_distribution(self, mesh, axis: str = "p", offsets=None,
                          n_loc=None):
@@ -655,7 +678,12 @@ class Matrix:
             return fp
         import hashlib
         h = hashlib.blake2b(digest_size=16)
-        h.update(repr((tuple(self.shape), self.block_dim)).encode())
+        # the device pack dtype is part of the identity: a bf16 pack
+        # and an f32 pack of the same structure cannot share a solver
+        # hierarchy (serve sessions / AOT executables key on this)
+        dd = self.device_dtype
+        h.update(repr((tuple(self.shape), self.block_dim,
+                       "" if dd is None else dd.name)).encode())
         if self._host is not None:
             h.update(b"csr")
             # shared structural digest — the SAME key the device setup

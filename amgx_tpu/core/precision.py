@@ -1,0 +1,180 @@
+"""Precision policy: dtype resolution, honesty floors, promotion ladder.
+
+The mixed-precision design (the TPU realisation of the reference's dDFI
+mixed modes, ``amgx_config.h:114-123``) splits a solve into three tiers:
+
+* **storage** — AMG level operators, smoother data and transfer packs
+  may live in a narrow dtype (``hierarchy_dtype=bfloat16``): SpMV is
+  memory-bound, so halving the stored bytes halves the per-cycle HBM
+  traffic.  Arithmetic never runs at storage precision — every SpMV
+  accumulates in at least f32 (``ops/spmv.py``; the Pallas kernels'
+  MXU paths already accumulate f32 by construction).
+* **Krylov** — the outer iteration's vectors, dot products and residual
+  monitoring run in ``krylov_dtype`` (f32 by default on TPU).  The
+  preconditioner being bf16 does not move the honestly reachable
+  tolerance: the Krylov residual is computed against the Krylov-dtype
+  operator.
+* **refinement** — tolerances below the Krylov dtype's floor promote
+  through the defect-correction ladder (``Solver._solve_refined``):
+  inner solves at the pack dtype, true residuals recomputed one rung
+  wider (bf16 → f32 → f64), bounded by the precision of the uploaded
+  host matrix.
+
+Everything here is host-side dtype arithmetic — no device work.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+#: registered names of the dtype-valued config knobs
+DTYPE_NAMES = ("default", "float64", "float32", "bfloat16")
+
+#: relative-residual honesty multiplier: below ``floor = FLOOR_ULPS·eps``
+#: a convergence claim in that dtype cannot be distinguished from
+#: rounding noise (matches the historical ``Solver._tolerance_floor``)
+FLOOR_ULPS = 25.0
+
+
+def resolve_dtype(name: "str | None") -> Optional[np.dtype]:
+    """The numpy dtype a config knob names, or None for ``default``.
+
+    ``bfloat16`` resolves through ml_dtypes (registered by jax); an
+    unknown name raises so a typo never silently runs at the wrong
+    precision."""
+    if name is None:
+        return None
+    name = str(name).strip()
+    if name in ("", "default"):
+        return None
+    if name not in DTYPE_NAMES:
+        from ..errors import BadParametersError
+        raise BadParametersError(
+            f"unknown precision {name!r}; allowed: {DTYPE_NAMES}")
+    return np.dtype(name)
+
+
+def is_floating(dtype) -> bool:
+    """Real-floating check that also recognises the ml_dtypes extension
+    types (``np.issubdtype`` reports bfloat16 as kind 'V')."""
+    import jax.numpy as jnp
+    return bool(jnp.issubdtype(np.dtype(dtype), jnp.floating))
+
+
+def is_sub_f32(dtype) -> bool:
+    """True for floating dtypes narrower than float32 (bf16/f16) —
+    storage-only precisions whose arithmetic must accumulate wider."""
+    dt = np.dtype(dtype)
+    return is_floating(dt) and dt.itemsize < 4
+
+
+def compute_dtype(dtype) -> np.dtype:
+    """The accumulation dtype of arithmetic over ``dtype`` storage:
+    at least f32 (the MXU/VPU native accumulator width)."""
+    dt = np.dtype(dtype)
+    return np.dtype(np.float32) if is_sub_f32(dt) else dt
+
+
+def tolerance_floor(dtype) -> float:
+    """Smallest relative residual honestly reachable in ``dtype``."""
+    import jax.numpy as jnp
+    # jnp.finfo also understands ml_dtypes (bfloat16); np.finfo raises
+    return FLOOR_ULPS * float(jnp.finfo(jnp.dtype(np.dtype(dtype).name))
+                              .eps)
+
+
+#: the promotion ladder, narrow to wide — each rung is a dtype the
+#: defect-correction outer loop can recompute true residuals in
+LADDER = (np.dtype(np.float32), np.dtype(np.float64))
+
+
+def promotion_target(device_dtype, host_dtype,
+                     tolerance: float) -> Optional[np.dtype]:
+    """The narrowest ladder rung that honestly reaches ``tolerance``.
+
+    None when no promotion is needed (``tolerance`` is reachable at the
+    device dtype) or none is possible: a rung must be wider than the
+    device dtype, within the host matrix's precision, have a floor at
+    or below the tolerance, AND be reconstructable from the device pack
+    plus ONE rounding-residue plane — hi+lo roughly doubles the stored
+    mantissa, so a rung at most twice the device itemsize (bf16 → f32,
+    f32 → f64; a bf16 pack cannot honestly claim f64 residuals — route
+    deep tolerances through an f32 Krylov pack with a bf16
+    ``hierarchy_dtype`` instead)."""
+    ddt, hdt = np.dtype(device_dtype), np.dtype(host_dtype)
+    if not is_floating(ddt):
+        return None
+    if tolerance >= tolerance_floor(ddt):
+        return None
+    for rung in LADDER:
+        if rung.itemsize <= ddt.itemsize or rung.itemsize > hdt.itemsize:
+            continue
+        if rung.itemsize > 2 * ddt.itemsize:
+            continue
+        if tolerance >= tolerance_floor(rung):
+            return rung
+    return None
+
+
+def narrowable_pack(dm) -> bool:
+    """Can this device pack be narrowed without losing its SpMV path?
+
+    Packs carrying an f32-only Pallas kernel layout (tile-DIA shift,
+    windowed one-hot, binned sliced-ELL planes) keep their dtype — the
+    kernel gates reject sub-f32 values and the gather fallback would
+    cost far more than the bytes saved.  DIA (the bf16 kernel exists),
+    dense (MXU-native), plain gather/segment-sum layouts (same dispatch
+    either way) all narrow."""
+    if getattr(dm, "fmt", "") == "dia3":
+        return True
+    return (getattr(dm, "sh_vals", None) is None
+            and getattr(dm, "win_codes", None) is None
+            and getattr(dm, "bn_codes", None) is None)
+
+
+def device_cast(dm, dtype):
+    """Cast an already-built device pack to ``dtype`` ON DEVICE (no
+    re-upload); returns ``dm`` unchanged when the pack is not
+    :func:`narrowable_pack`-safe at the target dtype."""
+    dtype = np.dtype(dtype)
+    if is_sub_f32(dtype) and not narrowable_pack(dm):
+        return dm
+    return dm.astype(dtype)
+
+
+def precision_view(parent, dtype):
+    """A shallow Matrix view of ``parent`` whose DEVICE pack lives in
+    ``dtype`` while every host-side structure (scipy CSR, diagonal
+    arrays, hints, geometry) stays shared — and wide.
+
+    This is how the hierarchy applies its per-level precision policy
+    without touching the caller's matrix: the outer Krylov keeps the
+    parent's pack, the level smooths through the view's.  When the
+    parent already has a device pack the view casts it on device (zero
+    wire bytes); otherwise the view's ``device_dtype`` makes the next
+    upload carry narrow values (cast on upload — RAP and every other
+    setup computation has already run at the wide dtype by then,
+    preserving the hierarchy narrowing rule of ``amg/hierarchy.py``)."""
+    import copy
+    dtype = np.dtype(dtype)
+    m = copy.copy(parent)
+    m.device_dtype = dtype
+    m._dinv_dev = None
+    dev = getattr(parent, "_device", None)
+    if dev is not None:
+        cast = device_cast(dev, dtype)
+        if cast is dev:
+            return parent       # pack not narrow-safe: keep the original
+        m._device = cast
+        m._device_dtype = dtype
+        # record the value chain for honest refinement residues: this
+        # pack holds dtype(parent_dtype(v)), NOT dtype(v) — one extra
+        # rounding that ``Solver._ensure_refine_data`` must model or
+        # hi+lo reconstructs a subtly wrong wide operator
+        m._pack_cast_via = np.dtype(dev.dtype)
+    else:
+        m._device = None
+        m._device_dtype = None
+    return m

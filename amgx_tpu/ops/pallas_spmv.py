@@ -43,23 +43,33 @@ _MAX_ABS_OFFSET = 4 << 20
 _INTERPRET = os.environ.get("AMGX_PALLAS_INTERPRET", "") == "1"
 
 
-def _block_rows(nd: int) -> int:
-    """Block rows Tr: vals block fits its VMEM budget, multiple of 8."""
-    return max(8, min(1024, (_VALS_BLOCK_BYTES // (nd * 128 * 4)) // 8 * 8))
+def _block_rows(nd: int, itemsize: int = 4) -> int:
+    """Block rows Tr: vals block fits its VMEM budget.  Multiple of 8
+    for f32 (the 8×128 tile), 16 for bf16 value planes (the 16×128
+    sublane tile — a misaligned second-minor block would fail Mosaic
+    layout, not fall back)."""
+    q = 16 if itemsize < 4 else 8
+    return max(q, min(1024,
+                      (_VALS_BLOCK_BYTES // (nd * 128 * itemsize))
+                      // q * q))
 
 
 def dia_spmv_supported(n: int, offsets: Sequence[int], dtype) -> bool:
-    if jnp.dtype(dtype) != jnp.float32:
+    dt = jnp.dtype(dtype)
+    # bf16 VALUE planes are supported (mixed precision: half the HBM
+    # bytes per apply); the x window and the accumulator stay f32
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return False
     if n % 128 != 0 or n < 16384:
         return False
     if not offsets or max(abs(o) for o in offsets) > _MAX_ABS_OFFSET:
         return False
-    # the x-window scratch (offset span + Tr rows of 128 lanes) must fit
-    # its VMEM share, or the kernel would fail to compile rather than
-    # fall back to the XLA path
+    # the x-window scratch (offset span + Tr rows of 128 f32 lanes)
+    # must fit its VMEM share, or the kernel would fail to compile
+    # rather than fall back to the XLA path
     span_rows = (max(offsets) - min(offsets)) // 128 + 2
-    if (span_rows + _block_rows(len(offsets))) * 512 > (6 << 20):
+    if (span_rows + _block_rows(len(offsets), dt.itemsize)) * 512 \
+            > (6 << 20):
         return False
     return True
 
@@ -82,13 +92,15 @@ def _dia_spmv_call(vals, xp2, meta):
             else:
                 shifted = jnp.concatenate(
                     [xw[d:d + Tr, r:], xw[d + 1:d + Tr + 1, :r]], axis=1)
-            term = vals_ref[k] * shifted
+            # bf16 value planes convert in-register; accumulation stays
+            # at the x window's f32 (the mixed-precision contract)
+            term = vals_ref[k].astype(shifted.dtype) * shifted
             acc = term if acc is None else acc + term
         y_ref[:] = acc
 
     return pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((n_rows128, 128), vals.dtype),
+        out_shape=jax.ShapeDtypeStruct((n_rows128, 128), xp2.dtype),
         grid=(grid,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),           # xp2 stays in HBM
@@ -101,7 +113,7 @@ def _dia_spmv_call(vals, xp2, meta):
         out_specs=pl.BlockSpec((Tr, 128), lambda i: (i, jnp.int32(0)),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((W, 128), vals.dtype),
+            pltpu.VMEM((W, 128), xp2.dtype),
             pltpu.SemaphoreType.DMA(()),
         ],
         interpret=_INTERPRET,
@@ -114,7 +126,7 @@ def dia_spmv(A, x: jax.Array) -> jax.Array:
     offs = A.dia_offsets
     nd = len(offs)
 
-    Tr = _block_rows(nd)
+    Tr = _block_rows(nd, jnp.dtype(A.vals.dtype).itemsize)
     n_rows128 = n // 128
     grid = -(-n_rows128 // Tr)
     n_cov = grid * Tr * 128                     # grid-covered rows

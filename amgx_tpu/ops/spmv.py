@@ -63,11 +63,39 @@ def _tel_pack(pack: str, fallback: str = None, A=None):
         pass      # a cost-model gap must never break SpMV dispatch
 
 
+# sub-f32 floating STORAGE dtype (bf16/f16): arithmetic over it must
+# accumulate in f32 — an 8-bit-mantissa reduction over a long row would
+# lose the mixed-precision contract (the Pallas kernels' MXU paths
+# accumulate f32 by construction; these XLA paths must match).  One
+# predicate, owned by the precision policy.
+from ..core.precision import is_sub_f32 as _sub_f32
+
+
+def _widen(v: jax.Array) -> jax.Array:
+    """Upcast a sub-f32 operand to f32 (XLA fuses the convert into the
+    consuming elementwise op — the narrow bytes still stream once)."""
+    return v.astype(jnp.float32) if _sub_f32(v.dtype) else v
+
+
+def _narrow_to(y: jax.Array, A, x: jax.Array) -> jax.Array:
+    """Cast an f32-accumulated result back to the promoted output dtype
+    (bf16 matrix × f32 vector → f32; an all-bf16 apply rounds once at
+    the end instead of per term)."""
+    out = jnp.promote_types(A.dtype, x.dtype)
+    return y if y.dtype == out else y.astype(out)
+
+
 def spmv(A, x: jax.Array) -> jax.Array:
     """y = A @ x.  ``x`` is a flat (n_cols * block_dim,) vector.
 
     Dispatches on the matrix pack: DeviceMatrix (single device) or
     ShardedMatrix (mesh-distributed with halo exchange).
+
+    Mixed precision: sub-f32 packs (``hierarchy_dtype=bfloat16``)
+    accumulate in f32 on every path — kernel or XLA fallback — and the
+    result is cast to ``promote_types(A.dtype, x.dtype)``, so an f32
+    Krylov vector flowing through a bf16 hierarchy stays f32 end to
+    end while the matrix bytes stream at half width.
     """
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
@@ -86,9 +114,15 @@ def spmv(A, x: jax.Array) -> jax.Array:
     if A.fmt == "dia":
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
-                and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)):
+                and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)
+                # the kernel's x window/accumulator is f32: a wider x
+                # (f64 Krylov over an f32-narrowed level) must take the
+                # XLA slices path, not compile an f64 Mosaic kernel
+                and jnp.dtype(x.dtype).itemsize <= 4):
+            # the kernel takes an f32 x window and accumulates f32 even
+            # for bf16 value planes (halved HBM value bytes)
             _tel_pack("dia/kernel", A=A)
-            return dia_spmv(A, x)
+            return _narrow_to(dia_spmv(A, _widen(x)), A, x)
         _tel_pack("dia/slices", A=A)
         # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
         # padded copy of x — no gathers (reference SpMV kernel dispatch
@@ -96,20 +130,21 @@ def spmv(A, x: jax.Array) -> jax.Array:
         n = A.n_rows
         offs = A.dia_offsets
         maxo = max(max(abs(o) for o in offs), 1)
-        xp = jnp.pad(x, (maxo, maxo))
-        acc = A.vals[0] * jax.lax.slice(xp, (maxo + offs[0],),
-                                        (maxo + offs[0] + n,))
+        xp = jnp.pad(_widen(x), (maxo, maxo))
+        acc = _widen(A.vals[0]) * jax.lax.slice(xp, (maxo + offs[0],),
+                                                (maxo + offs[0] + n,))
         for k in range(1, len(offs)):
-            acc = acc + A.vals[k] * jax.lax.slice(
+            acc = acc + _widen(A.vals[k]) * jax.lax.slice(
                 xp, (maxo + offs[k],), (maxo + offs[k] + n,))
-        return acc
+        return _narrow_to(acc, A, x)
     b = A.block_dim
     if A.fmt == "dense":
         # small scattered coarse operator: one MXU matvec (HIGHEST
         # precision keeps the f32 product exact — the matrices are tiny)
         _tel_pack("dense", A=A)
-        return jnp.dot(A.vals, x,
-                       precision=jax.lax.Precision.HIGHEST)
+        return _narrow_to(jnp.dot(_widen(A.vals), _widen(x),
+                                  precision=jax.lax.Precision.HIGHEST),
+                          A, x)
     if A.fmt == "ell":
         if b == 1:
             from .pallas_shift import shift_spmv, shift_supported
@@ -141,8 +176,8 @@ def spmv(A, x: jax.Array) -> jax.Array:
                           or getattr(A, "win_codes", None) is not None
                           or getattr(A, "bn_codes", None) is not None)
                       else None, A=A)
-            return jnp.sum(A.ell_vals_view() * x[A.ell_cols_view()],
-                           axis=1)
+            prod = _widen(A.ell_vals_view()) * _widen(x)[A.ell_cols_view()]
+            return _narrow_to(jnp.sum(prod, axis=1), A, x)
         from .pallas_csr import binned_spmv, binned_supported
         if binned_supported(A):
             # the pack carries the block matrix's SCALAR expansion —
@@ -155,9 +190,11 @@ def spmv(A, x: jax.Array) -> jax.Array:
                   A=A)
         xb = x.reshape(A.n_cols, b)
         xg = xb[A.cols]                      # (n, K, b)
+        pet = jnp.float32 if (_sub_f32(A.vals.dtype)
+                              or _sub_f32(xg.dtype)) else A.vals.dtype
         y = jnp.einsum("nkab,nkb->na", A.vals, xg,
-                       preferred_element_type=A.vals.dtype)
-        return y.reshape(-1)
+                       preferred_element_type=pet)
+        return _narrow_to(y.reshape(-1), A, x)
     # CSR path: binned sliced-ELL kernel first, segment-sum fallback
     from .pallas_csr import (binned_entries_view, binned_spmv,
                              binned_supported)
@@ -171,37 +208,45 @@ def spmv(A, x: jax.Array) -> jax.Array:
             _tel_pack("csr/segsum-lean",
                       fallback="kernel_gate_rejected", A=A)
             rows, cols, vals = binned_entries_view(A)
-            prod = vals * x[cols]
-            return jax.ops.segment_sum(prod, rows,
-                                       num_segments=A.n_rows)
+            prod = _widen(vals) * _widen(x)[cols]
+            return _narrow_to(
+                jax.ops.segment_sum(prod, rows, num_segments=A.n_rows),
+                A, x)
         _tel_pack("csr/segsum",
                   fallback="kernel_gate_rejected"
                   if getattr(A, "bn_codes", None) is not None else None,
                   A=A)
-        prod = A.vals * x[A.cols]
-        return jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
+        prod = _widen(A.vals) * _widen(x)[A.cols]
+        return _narrow_to(
+            jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows),
+            A, x)
     _tel_pack("csr/block-segsum", A=A)
     xb = x.reshape(A.n_cols, b)
-    prod = jnp.einsum("eab,eb->ea", A.vals, xb[A.cols],
-                      preferred_element_type=A.vals.dtype)
+    xg = xb[A.cols]
+    pet = jnp.float32 if (_sub_f32(A.vals.dtype) or _sub_f32(xg.dtype)) \
+        else A.vals.dtype
+    prod = jnp.einsum("eab,eb->ea", A.vals, xg,
+                      preferred_element_type=pet)
     y = jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
-    return y.reshape(-1)
+    return _narrow_to(y.reshape(-1), A, x)
 
 
 def abs_rowsum(A) -> jax.Array:
     """Σ_j |A[i, j]| per scalar row, from any pack (pad/explicit zeros
     contribute 0).  Serves the L1-Jacobi diagonal and Chebyshev
-    Gershgorin bound without host work or extra uploads."""
+    Gershgorin bound without host work or extra uploads.  Sub-f32 packs
+    accumulate (and return) in f32 — consumers that want narrow
+    smoother data cast the result back themselves."""
     import jax.numpy as jnp
     if A.fmt == "dia3":
-        return A.l1row          # precomputed from the embedded form
+        return _widen(A.l1row)  # precomputed from the embedded form
     if A.fmt == "dia":
-        return jnp.sum(jnp.abs(A.vals), axis=0)
+        return jnp.sum(jnp.abs(_widen(A.vals)), axis=0)
     if A.fmt == "dense":
-        return jnp.sum(jnp.abs(A.vals), axis=1)
+        return jnp.sum(jnp.abs(_widen(A.vals)), axis=1)
     if A.fmt == "ell":
         # ell_vals_view reconstructs row-major values on a lean pack
-        return jnp.sum(jnp.abs(A.ell_vals_view()), axis=1)
+        return jnp.sum(jnp.abs(_widen(A.ell_vals_view())), axis=1)
     if A.fmt == "sharded-ell":
         # (P, n_loc, K) → flat sharded row sums (halo entries belong to
         # the row, padding rows sum to their identity 1)
@@ -210,7 +255,7 @@ def abs_rowsum(A) -> jax.Array:
         # lean binned pack: the planes are the only value arrays
         from .pallas_csr import binned_abs_rowsum
         return binned_abs_rowsum(A)
-    return jax.ops.segment_sum(jnp.abs(A.vals), A.row_ids,
+    return jax.ops.segment_sum(jnp.abs(_widen(A.vals)), A.row_ids,
                                num_segments=A.n_rows)
 
 

@@ -63,6 +63,7 @@ class AMGSolver(Solver):
         self._solve_fn = None
         self._refined_fn = None
         self._solve_multi = None
+        self._solve_multi_refined = None
         self._bindings = None
 
     def grid_stats(self):

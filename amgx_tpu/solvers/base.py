@@ -30,7 +30,8 @@ import numpy as np
 from .. import telemetry
 from ..config import AMGConfig
 from ..core.matrix import DeviceMatrix, Matrix
-from ..errors import BadConfigurationError, SolveStatus
+from ..errors import (BadConfigurationError, BadParametersError,
+                      SolveStatus)
 from ..ops import blas
 from ..ops.spmv import spmv
 from ..utils.logging import amgx_output
@@ -218,6 +219,7 @@ class Solver:
         self._solve_fn = None
         self._refined_fn = None
         self._solve_multi = None
+        self._solve_multi_refined = None
         self._bindings = None
         self.setup_time = 0.0
 
@@ -280,11 +282,54 @@ class Solver:
                 telemetry.flush_jsonl(self.telemetry_path)
         return self
 
+    def _apply_precision_knobs(self, A: Matrix) -> Matrix:
+        """``krylov_dtype`` / ``tpu_matrix_dtype``: the TOP-LEVEL
+        solver's device pack dtype — which IS the Krylov loop's
+        vector/dot/monitoring precision.  Only the outermost solver
+        applies it: nested smoothers get their storage precision from
+        the hierarchy policy (``hierarchy_dtype``), and a default-scope
+        knob leaking into every nested setup would override it.
+
+        Returns a shallow VIEW when the knob applies — the caller's
+        matrix is never mutated (a second solver sharing the same
+        Matrix must see its own dtype choice, not this one's).  Packs
+        that would lose an f32-only kernel layout keep their dtype
+        (``precision.precision_view`` returns ``A`` unchanged)."""
+        from ..core import precision
+        kd = precision.resolve_dtype(
+            str(self.cfg.get("krylov_dtype", self.scope)))
+        if kd is None:
+            kd = precision.resolve_dtype(
+                str(self.cfg.get("tpu_matrix_dtype", self.scope)))
+        if kd is None:
+            return A
+        cur = np.dtype(A.device_dtype or A.dtype)
+        kd = np.dtype(kd)
+        if cur == kd:
+            return A
+        dev = A._device
+        if dev is not None and kd.itemsize > \
+                np.dtype(dev.dtype).itemsize:
+            # widening an existing pack must rebuild from the wide host
+            # values (an on-device upcast would keep the narrow digits)
+            import copy
+            m = copy.copy(A)
+            m.device_dtype = kd
+            m._device = None
+            m._device_dtype = None
+            m._dinv_dev = None
+            return m
+        # narrowing (or no pack yet): on-device cast when a pack
+        # exists (zero wire bytes), cast-on-upload otherwise
+        return precision.precision_view(A, kd)
+
     def _setup_impl(self, A: "Matrix | DeviceMatrix"):
         self.scaler = None
         self._reorder = None
         scaling = str(self.cfg.get("scaling", self.scope))
         if isinstance(A, Matrix):
+            if getattr(self, "_toplevel", False) and A.dist is None:
+                A = self._apply_precision_knobs(A)
             if scaling != "NONE" and A.dist is None and A.block_dim == 1:
                 # scale a copy (reference scales in place then "unscales";
                 # solver.cu:441-475 documents that workaround — a copy is
@@ -295,7 +340,11 @@ class Solver:
                     self.scaler = create_scaler(scaling, self.cfg,
                                                 self.scope)
                     self.scaler.setup(A.scalar_csr())
+                    dd = A.device_dtype
                     A = Matrix(self.scaler.scale_matrix(A.scalar_csr()))
+                    # the scaled copy must keep the precision knobs'
+                    # pack dtype (the reorder copy does the same)
+                    A.device_dtype = dd
             if getattr(self, "_toplevel", False):
                 # reordering is OWNED by the outermost solver: only its
                 # solve() has the permute boundary — a nested smoother/
@@ -316,7 +365,8 @@ class Solver:
             self.solver_setup()
         if getattr(self, "_numeric_resetup", False) \
                 and (self._solve_fn is not None
-                     or self._solve_multi is not None) \
+                     or self._solve_multi is not None
+                     or self._solve_multi_refined is not None) \
                 and self._bindings is not None:
             # numeric re-setup (resetup() only — a plain setup() keeps
             # its full-rebuild contract): keep the jitted executables and
@@ -337,6 +387,7 @@ class Solver:
             self._solve_fn = None
             self._refined_fn = None
             self._solve_multi = None
+            self._solve_multi_refined = None
             # a full rebuild replaces hierarchy/level objects: bindings
             # slots referencing the OLD objects would keep serving stale
             # device data to a later solve_multi
@@ -452,9 +503,82 @@ class Solver:
 
     # ------------------------------------------------------------- solve API
     def _tolerance_floor(self, dtype) -> float:
-        """Smallest relative residual honestly reachable in ``dtype``."""
-        # jnp.finfo also understands ml_dtypes (bfloat16); np.finfo raises
-        return 25.0 * float(jnp.finfo(jnp.dtype(dtype)).eps)
+        """Smallest relative residual honestly reachable in ``dtype``
+        (core/precision.py owns the floor formula and the ladder)."""
+        from ..core.precision import tolerance_floor
+        return tolerance_floor(dtype)
+
+    def _promotion_plan(self):
+        """(refine_active, wide_dtype, structural_block) for the
+        current tolerance.
+
+        ``refine_active`` says whether the mixed-precision
+        defect-correction outer loop (``_solve_refined``) runs;
+        ``wide_dtype`` is the ladder rung recomputing true residuals
+        (``core.precision.promotion_target``: bf16 → f32, f32 → f64 —
+        one rounding-residue plane per promotion).  The rung needs the
+        wide HOST matrix: ``lo = vals_w − w(pack(vals_w))``
+        reconstructs the exact wide operator only against genuinely
+        wider uploaded values.  ``structural_block`` is True when
+        refinement is unavailable for reasons no precision choice can
+        fix (distribution, scaling, device-only operator, complex
+        modes) — the single predicate ``_check_tolerance_floor`` keys
+        its warn-vs-raise split on."""
+        dtype = self.Ad.dtype
+        if not (self.monitor_residual
+                and self.tolerance < self._tolerance_floor(dtype)):
+            return False, None, False
+        from ..core import precision
+        if self.tolerance <= 0 \
+                or self.Ad.fmt == "sharded-ell" \
+                or self.scaler is not None or self.A is None \
+                or not precision.is_floating(np.dtype(dtype)):
+            # tolerance<=0 is the run-to-max_iters convention (the
+            # reference's "never converge, fixed sweeps") — no
+            # convergence claim is ever made, so no honesty error; it
+            # keeps the historical warn-and-run like the other
+            # structurally-unrefinable cases
+            return False, None, True
+        # Matrix.dtype, not .host.dtype: the property would lazily
+        # assemble CSR for DIA-backed operators
+        host_dt = np.dtype(self.A.dtype)
+        if host_dt.itemsize <= np.dtype(dtype).itemsize:
+            return False, None, False
+        wide = precision.promotion_target(dtype, host_dt,
+                                          self.tolerance)
+        if wide is None:
+            return False, None, False
+        return True, np.dtype(wide), False
+
+    def _check_tolerance_floor(self, refine: bool, structural: bool):
+        """Below-floor tolerances without a promotion rung are a
+        configuration error, not a silent stall: the solve would burn
+        its full iteration budget and report NOT_CONVERGED at best —
+        or, in a narrow dtype, declare a convergence no true residual
+        supports.  Structurally-unrefinable solves (``structural`` from
+        ``_promotion_plan``: complex modes, distribution, scaling,
+        device-only operators) keep the historical warn-and-run — an
+        error whose guidance could not help them would break existing
+        deep-tolerance workflows."""
+        dtype = self.Ad.dtype
+        floor = self._tolerance_floor(dtype)
+        if refine or not self.monitor_residual \
+                or self.tolerance >= floor:
+            return
+        if structural:
+            amgx_output(
+                f"WARNING: tolerance {self.tolerance:g} is below the "
+                f"{np.dtype(dtype).name} precision floor (~{floor:.1g});"
+                " convergence to it cannot be honestly declared.\n")
+            return
+        raise BadParametersError(
+            f"tolerance {self.tolerance:g} is below the "
+            f"{np.dtype(dtype).name} precision floor (~{floor:.1g}) "
+            "and no promotion rung is available: upload the matrix at "
+            "a wider dtype (f64 host + narrow device pack enables the "
+            "defect-correction ladder), raise the tolerance, or run "
+            "the Krylov loop wider (krylov_dtype=float32 with "
+            "hierarchy_dtype=bfloat16 keeps the bandwidth win)")
 
     def solve(self, b, x0=None, zero_initial_guess: bool = False
               ) -> SolveResult:
@@ -495,25 +619,11 @@ class Solver:
         x0_in = None if zero_initial_guess else x0
         dist = self.Ad.fmt == "sharded-ell"
 
-        floor = self._tolerance_floor(dtype)
-        # refinement requires an f32 device pack: the rounding residue
-        # lo = vals64 − f64(f32(vals64)) reconstructs the exact f64
-        # operator only when hi is the f32 rounding (a bf16 hi+lo pair
-        # would be ~1e-7 off and could declare false convergence)
-        refine = (self.monitor_residual and self.tolerance < floor
-                  and not dist and self.scaler is None
-                  and self.A is not None
-                  and jnp.dtype(dtype) == jnp.float32
-                  # Matrix.dtype, not .host.dtype: the property would
-                  # lazily assemble CSR for DIA-backed operators
-                  and np.dtype(self.A.dtype).itemsize >
-                  np.dtype(dtype).itemsize)
-        if (self.monitor_residual and self.tolerance < floor
-                and not refine):
-            amgx_output(
-                f"WARNING: tolerance {self.tolerance:g} is below the "
-                f"{np.dtype(dtype).name} precision floor (~{floor:.1g}); "
-                "convergence to it cannot be honestly declared.\n")
+        # the promotion ladder (core/precision.py): inner solves at the
+        # pack dtype, true residuals recomputed one rung wider
+        # (bf16 → f32, f32 → f64), bounded by the uploaded host matrix
+        refine, wide, structural = self._promotion_plan()
+        self._check_tolerance_floor(refine, structural)
 
         if dist:
             from ..distributed.matrix import shard_vector
@@ -579,9 +689,10 @@ class Solver:
             if refine:
                 self._ensure_refine_data()
             self._bindings = DeviceBindings(self)
-            # the batched executable closes over the bindings object —
-            # a rebuilt bindings set means it must re-bind too
+            # the batched executables close over the bindings object —
+            # a rebuilt bindings set means they must re-bind too
             self._solve_multi = None
+            self._solve_multi_refined = None
             if dist:
                 self._bindings.normalize_placement(self.Ad.mesh)
             self._solve_fn = jax.jit(
@@ -597,7 +708,7 @@ class Solver:
                 # rhs/guess — the dtype-cast b/x0 above would fold the
                 # fp32 rounding of b itself into the "converged" solution
                 x, iters, nrm, nrm_ini, history = self._solve_refined(
-                    b_in, x0_in)
+                    b_in, x0_in, wide)
             else:
                 import contextlib
                 ctx = jax.default_device(pin) if pin is not None \
@@ -775,13 +886,14 @@ class Solver:
             return []
         dtype = self.Ad.dtype
         dist = self.Ad.fmt == "sharded-ell"
-        floor = self._tolerance_floor(dtype)
-        refine = (self.monitor_residual and self.tolerance < floor
-                  and not dist and self.scaler is None
-                  and self.A is not None
-                  and jnp.dtype(dtype) == jnp.float32
-                  and np.dtype(self.A.dtype).itemsize >
-                  np.dtype(dtype).itemsize)
+        refine, wide, structural = self._promotion_plan()
+        self._check_tolerance_floor(refine, structural)
+        # the bf16 → f32 promotion rung is BATCHABLE: the refined outer
+        # loop vmaps like the plain solve body (f32 is TPU-native); the
+        # f32 → f64 rung keeps the sequential fallback — emulated-f64
+        # SpMVs under vmap blow past sane executable sizes
+        refined_batch = (refine and wide == np.dtype(np.float32)
+                         and not dist)
         pin = None
         if not dist:
             try:
@@ -790,7 +902,8 @@ class Solver:
                     pin = devs[0]
             except Exception:
                 pin = None
-        if k == 1 or dist or refine or pin is not None:
+        if k == 1 or dist or (refine and not refined_batch) \
+                or pin is not None:
             out = []
             for j, bj in enumerate(B):
                 xj = None if X0 is None else X0[j]
@@ -825,34 +938,38 @@ class Solver:
                     X0m = np.concatenate(
                         [X0m, np.zeros((bucket - k, X0m.shape[1]),
                                        X0m.dtype)])
-        Bd = jnp.asarray(Bm, dtype)
-        X0d = jnp.zeros_like(Bd) if X0m is None \
-            else jnp.asarray(X0m, dtype)
-
-        if self._solve_multi is None:
-            from ._bind import DeviceBindings, bind_for_trace
-            if self._bindings is None:
-                self._bindings = DeviceBindings(self)
-            bindings = self._bindings
-            vm = jax.vmap(self._packed_solve_fn(),
-                          in_axes=(0, 0, None, None))
-            self._solve_multi = (bindings,
-                                 jax.jit(bind_for_trace(bindings, vm)))
-        bindings, fn = self._solve_multi
-
         t0 = time.perf_counter()
         with telemetry.span("solve_multi", solver=self.config_name,
-                            scope=self.scope, batch=k), \
+                            scope=self.scope, batch=k,
+                            refined=bool(refined_batch)), \
                 cpu_profiler(f"solve_multi:{self.config_name}"):
-            rdt = np.zeros((), dtype).real.dtype
-            call_args = (bindings.collect(), Bd, X0d,
-                         jnp.asarray(self.tolerance, rdt),
-                         jnp.asarray(self.max_iters, jnp.int32))
-            # warm-start layer: each batch bucket (Bd's leading dim) is
-            # its own AOT executable — the serving micro-batcher's
-            # power-of-two padding keeps that set log2(max_batch)-sized
-            X, stats, history = self._maybe_aot(
-                "solve_multi", fn, call_args)(*call_args)
+            if refined_batch:
+                X, stats, history = self._solve_multi_refined_call(
+                    Bm, X0m, wide)
+            else:
+                Bd = jnp.asarray(Bm, dtype)
+                X0d = jnp.zeros_like(Bd) if X0m is None \
+                    else jnp.asarray(X0m, dtype)
+                if self._solve_multi is None:
+                    from ._bind import DeviceBindings, bind_for_trace
+                    if self._bindings is None:
+                        self._bindings = DeviceBindings(self)
+                    bindings = self._bindings
+                    vm = jax.vmap(self._packed_solve_fn(),
+                                  in_axes=(0, 0, None, None))
+                    self._solve_multi = (
+                        bindings, jax.jit(bind_for_trace(bindings, vm)))
+                bindings, fn = self._solve_multi
+                rdt = np.zeros((), dtype).real.dtype
+                call_args = (bindings.collect(), Bd, X0d,
+                             jnp.asarray(self.tolerance, rdt),
+                             jnp.asarray(self.max_iters, jnp.int32))
+                # warm-start layer: each batch bucket (Bd's leading
+                # dim) is its own AOT executable — the serving
+                # micro-batcher's power-of-two padding keeps that set
+                # log2(max_batch)-sized
+                X, stats, history = self._maybe_aot(
+                    "solve_multi", fn, call_args)(*call_args)
             stats = np.asarray(stats)      # ONE host fetch: (k, 1+2m)
         solve_time = time.perf_counter() - t0
         Xh = None
@@ -929,6 +1046,62 @@ class Solver:
             if self.telemetry_path:
                 telemetry.flush_jsonl(self.telemetry_path)
         return results
+
+    def _solve_multi_refined_call(self, Bm, X0m, wide):
+        """The batched bf16 → f32 promotion rung: the refined outer
+        loop (``_build_refined_fn``) vmapped over the RHS axis — each
+        lane runs its own defect-correction ladder with per-lane
+        convergence, so a narrow-pack multi-RHS batch stays one
+        executable instead of falling back to sequential solves (the
+        f64 rung keeps that fallback; see ``solve_multi``)."""
+        dtype = self.Ad.dtype
+        wide = np.dtype(wide)
+        had_refine = hasattr(self, "_refine_lo")
+        self._ensure_refine_data()
+        if self._solve_multi_refined is None \
+                or self._solve_multi_refined[0] != wide:
+            from ._bind import DeviceBindings, bind_for_trace
+            if self._bindings is None or not had_refine:
+                # fresh bindings so the refine residue (when present)
+                # rides as a bound argument, never a trace constant —
+                # executables closing over the OLD bindings object must
+                # re-bind.  Bindings that already cover the refine data
+                # are REUSED: replacing them here would invalidate
+                # _solve_fn, whose next call would invalidate this
+                # executable right back — a retrace ping-pong for
+                # workloads alternating single- and multi-RHS solves
+                self._bindings = DeviceBindings(self)
+                self._solve_fn = None
+                self._refined_fn = None
+                self._solve_multi = None
+            vm = jax.vmap(self._build_refined_fn(wide),
+                          in_axes=(0, 0, 0, 0, None, None))
+            self._solve_multi_refined = (
+                wide, self._bindings,
+                jax.jit(bind_for_trace(self._bindings, vm)))
+        _, bindings, fn = self._solve_multi_refined
+        lo_dt = np.float32
+        B64 = Bm.astype(np.float64, copy=False)
+        Bhi = B64.astype(dtype)
+        Blo = (B64 - Bhi.astype(np.float64)).astype(lo_dt)
+        if X0m is None:
+            Xhi = np.zeros_like(Bhi)
+            Xlo = np.zeros(Bhi.shape, dtype=lo_dt)
+        else:
+            X64 = X0m.astype(np.float64, copy=False)
+            Xhi = X64.astype(dtype)
+            Xlo = (X64 - Xhi.astype(np.float64)).astype(lo_dt)
+        wdt = jnp.dtype(wide.name)
+        call_args = (bindings.collect(), jnp.asarray(Bhi),
+                     jnp.asarray(Blo), jnp.asarray(Xhi),
+                     jnp.asarray(Xlo),
+                     jnp.asarray(self.tolerance, wdt),
+                     jnp.asarray(self.max_iters, jnp.int32))
+        # the warm-start layer covers the refined batches too: without
+        # it a restarted mixed-precision serving process would pay the
+        # full trace+compile on the first batch of every bucket size
+        return self._maybe_aot("solve_multi_refined", fn,
+                               call_args)(*call_args)
 
     def _emit_solve_telemetry(self, iters, nrm, nrm_ini, status,
                               history, solve_time):
@@ -1013,35 +1186,51 @@ class Solver:
 
     def _ensure_refine_data(self):
         """Device data for on-device refinement: the rounding residue
-        ``lo = vals64 − f64(f32(vals64))`` of the device pack vs the wide
-        host matrix, so the traced wide SpMV can reconstruct the exact f64
-        operator as ``vals.astype(f64) + lo``.  ``lo`` is exactly zero for
-        integer-valued stencils (Poisson) — no extra upload then."""
+        ``lo = vals_w − w(pack(vals_w))`` of the device pack vs the wide
+        host matrix, so the traced wide SpMV can reconstruct the exact
+        wide operator as ``vals.astype(w) + lo``.  ``lo`` is stored in
+        f32 whatever the pack dtype (it exactly carries an f32 pack's
+        f64 residue AND a bf16 pack's f32 residue), and is None —
+        no extra upload — for integer-valued stencils (Poisson), which
+        are exactly representable in the pack dtype."""
         if hasattr(self, "_refine_lo"):
             return
-        if getattr(self.A, "_vals_f32_exact", False):
-            # device-generated integer-valued stencils declare exactness
-            # analytically — no host values to scan
+        pdt = np.dtype(self.Ad.dtype)
+        if pdt == np.float32 and getattr(self.A, "_vals_f32_exact",
+                                         False):
+            # device-generated integer-valued stencils declare f32
+            # exactness analytically — no host values to scan (a bf16
+            # pack still scans: the hint promises f32, not bf16)
             self._refine_lo = None
             return
+        # a pack produced by an ON-DEVICE cast holds pdt(via(v)), not
+        # pdt(v) — one extra rounding (precision_view records the
+        # chain); the residue must model the pack's ACTUAL values or
+        # hi+lo reconstructs a subtly wrong wide operator and the
+        # refined loop's "true" residual stops being true
+        via = getattr(self.A, "_pack_cast_via", None) \
+            if self.A is not None else None
+
+        def to_pack(c):
+            return (c.astype(via) if via is not None else c).astype(pdt)
+
         vals64 = self._host_pack_vals64()
         # chunked exactness scan with early exit: integer-valued stencils
         # (the common benchmark operators) are exactly representable in
-        # f32, and detecting that must not cost four full passes over a
-        # ~1 GB fine-level array
+        # the narrow dtype, and detecting that must not cost four full
+        # passes over a ~1 GB fine-level array
         flat = vals64.reshape(-1)
         exact = True
         step = 1 << 22
         for s in range(0, flat.size, step):
             c = flat[s:s + step]
-            if not np.array_equal(c.astype(np.float32).astype(np.float64),
-                                  c):
+            if not np.array_equal(to_pack(c).astype(np.float64), c):
                 exact = False
                 break
         if exact:
             self._refine_lo = None
             return
-        lo = (vals64 - vals64.astype(np.float32).astype(np.float64)) \
+        lo = (vals64 - to_pack(vals64).astype(np.float64)) \
             .astype(np.float32)
         self._refine_lo = jnp.asarray(lo)
 
@@ -1084,8 +1273,10 @@ class Solver:
         out[for_rows, pos] = data
         return out
 
-    def _wide_pack(self):
-        """The traced f64 device pack of the exact host operator."""
+    def _wide_pack(self, wide=np.float64):
+        """The traced wide device pack of the exact host operator
+        (``wide`` is the promotion rung: f64 for an f32 pack, f32 for a
+        bf16 pack)."""
         Ad64 = self.Ad
         if Ad64.fmt == "ell" and Ad64.vals is None:
             # lean windowed pack: the f64 path needs the gather-form
@@ -1103,33 +1294,42 @@ class Solver:
             Ad64 = dataclasses.replace(
                 Ad64, bn_codes=None, bn_vals=None, bn_meta=None,
                 bn_pos=None, bn_dims=())
-        Ad64 = Ad64.astype(jnp.float64)
+        wdt = jnp.dtype(np.dtype(wide).name)
+        Ad64 = Ad64.astype(wdt)
         if self._refine_lo is not None:
             Ad64 = dataclasses.replace(
-                Ad64, vals=Ad64.vals + self._refine_lo.astype(jnp.float64))
+                Ad64, vals=Ad64.vals + self._refine_lo.astype(wdt))
         return Ad64
 
-    def _spmv_wide(self, x64, Ad64=None):
-        """Traced f64 SpMV of the exact host operator (XLA emulates f64 on
-        TPU — slower than f32 but bit-honest, which is all the refinement
-        residual needs).  Pass a precomputed ``Ad64`` when calling inside
-        a loop: XLA does not reliably hoist the ~2×vals widening out of
-        ``while`` bodies, and at 256³ that is ~1 GB of rematerialisation
-        per refinement pass."""
-        return spmv(self._wide_pack() if Ad64 is None else Ad64, x64)
+    def _spmv_wide(self, x64, Ad64=None, wide=np.float64):
+        """Traced wide SpMV of the exact host operator (XLA emulates f64
+        on TPU — slower than f32 but bit-honest, which is all the
+        refinement residual needs; the bf16 → f32 rung runs native).
+        Pass a precomputed ``Ad64`` when calling inside a loop: XLA does
+        not reliably hoist the ~2×vals widening out of ``while`` bodies,
+        and at 256³ that is ~1 GB of rematerialisation per refinement
+        pass."""
+        return spmv(self._wide_pack(wide) if Ad64 is None else Ad64, x64)
 
-    def _solve_refined(self, b, x0):
-        """Mixed-precision iterative refinement, entirely on device: inner
-        solves run in the pack dtype, true residuals are recomputed in f64
-        (XLA-emulated on TPU) inside the same executable, and the outer
-        correction loop is a ``lax.while_loop`` — ONE host round trip per
-        solve, which is what the remote-attached TPU tunnel demands (the
-        old host-side outer loop paid ~2 s of vector transfers per pass).
-        The dDFI analog of the reference's mixed modes
-        (``amgx_config.h:114-123``).  ``b``/``x0`` arrive in the CALLER's
-        precision, never pre-rounded to the device dtype."""
+    def _solve_refined(self, b, x0, wide=np.float64):
+        """Mixed-precision iterative refinement, entirely on device:
+        inner solves run in the pack dtype, true residuals are
+        recomputed at the ``wide`` promotion rung (f64 is XLA-emulated
+        on TPU; the bf16 → f32 rung runs native) inside the same
+        executable, and the outer correction loop is a
+        ``lax.while_loop`` — ONE host round trip per solve, which is
+        what the remote-attached TPU tunnel demands (the old host-side
+        outer loop paid ~2 s of vector transfers per pass).  The dDFI
+        analog of the reference's mixed modes
+        (``amgx_config.h:114-123``).  ``b``/``x0`` arrive in the
+        CALLER's precision, never pre-rounded to the device dtype."""
         from ._bind import bind_for_trace
         dtype = self.Ad.dtype
+        wide = np.dtype(wide)
+        wdt = jnp.dtype(wide.name)
+        # the residue plane always rides f32: it must carry digits the
+        # pack dtype cannot (a bf16 lo would forfeit the promotion)
+        lo_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
 
         def split(v):
             """Caller-precision vector → device-dtype (hi, lo residue)."""
@@ -1137,7 +1337,7 @@ class Solver:
                 return v, None          # device-resident input: exact
             v64 = np.asarray(v, dtype=np.float64).ravel()
             hi = v64.astype(dtype)
-            lo = (v64 - hi.astype(np.float64)).astype(dtype)
+            lo = (v64 - hi.astype(np.float64)).astype(lo_dt)
             return jnp.asarray(hi), \
                 (jnp.asarray(lo) if np.any(lo) else None)
 
@@ -1145,12 +1345,12 @@ class Solver:
         x_hi = x_lo = None
         if x0 is not None:
             x_hi, x_lo = split(x0)
-        if self._refined_fn is None:
-            self._refined_fn = jax.jit(
-                bind_for_trace(self._bindings, self._build_refined_fn()))
-        x64, stats, history = self._refined_fn(
+        if self._refined_fn is None or self._refined_fn[0] != wide:
+            self._refined_fn = (wide, jax.jit(bind_for_trace(
+                self._bindings, self._build_refined_fn(wide))))
+        x64, stats, history = self._refined_fn[1](
             self._bindings.collect(), b_hi, b_lo, x_hi, x_lo,
-            jnp.asarray(self.tolerance, jnp.float64),
+            jnp.asarray(self.tolerance, wdt),
             jnp.asarray(self.max_iters, jnp.int32))
         stats = np.asarray(stats)       # ONE small host fetch
         iters = int(stats[0])
@@ -1159,15 +1359,29 @@ class Solver:
         # device dtype would throw away the digits refinement bought
         return x64, iters, stats[1:1 + m], stats[1 + m:], history
 
-    def _build_refined_fn(self) -> Callable:
+    def _build_refined_fn(self, wide=np.float64) -> Callable:
         body = self._build_solve_fn()
         dtype = self.Ad.dtype
         crit, alt_tol = self.convergence, self.alt_rel_tolerance
         inner_tol = max(self.tolerance, 2.0 * self._tolerance_floor(dtype))
         max_iters = self.max_iters
-        max_outer = 8
+        # each outer pass reduces the wide residual by roughly the
+        # inner tolerance; a bf16 inner floor (~0.4 per pass) needs far
+        # more rungs to reach its f32 target than the f32 → f64 case's
+        # historical 8 — size the budget from the reduction per pass
+        import math
+        if 0.0 < inner_tol < 1.0:
+            need = math.log(max(self.tolerance, 1e-300)) \
+                / math.log(inner_tol)
+            max_outer = int(min(64, max(8, math.ceil(need) + 4)))
+        else:
+            max_outer = 8
         keep_history = self.store_res_history or self.print_solve_stats
-        f64 = jnp.float64
+        f64 = jnp.dtype(np.dtype(wide).name)    # the promotion rung
+        tiny = float(np.finfo(np.dtype(wide)).tiny)
+        # the history buffer floors at f32: a bf16 pack's residual
+        # trajectory spans magnitudes bf16 cannot represent
+        hist_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
 
         def norm64(r):
             return jnp.atleast_1d(blas.norm(r, self.norm_type,
@@ -1181,14 +1395,14 @@ class Solver:
         def refined_fn(b_hi, b_lo, x_hi, x_lo, tol, it_limit):
             # widen the operator ONCE, outside the while body (see
             # _spmv_wide: XLA won't hoist the ~2×vals materialisation)
-            Ad64 = self._wide_pack()
+            Ad64 = self._wide_pack(wide)
             b64 = widen(b_hi, b_lo)
             x64 = jnp.zeros_like(b64) if x_hi is None else widen(x_hi, x_lo)
-            r64 = b64 - self._spmv_wide(x64, Ad64)
+            r64 = b64 - self._spmv_wide(x64, Ad64, wide)
             nrm_ini = norm64(r64)
             m = nrm_ini.shape[0]
-            hist = jnp.zeros((max_iters + 1, m), dtype)
-            hist = hist.at[0].set(nrm_ini.astype(dtype))
+            hist = jnp.zeros((max_iters + 1, m), hist_dt)
+            hist = hist.at[0].set(nrm_ini.astype(hist_dt))
             done0 = check_convergence(crit, nrm_ini, nrm_ini, nrm_ini,
                                       tol, alt_tol)
 
@@ -1199,13 +1413,13 @@ class Solver:
             def outer(c):
                 x64, r64, it_tot, _nrm, _done, hist, k = c
                 scale = jnp.maximum(jnp.max(jnp.abs(r64)),
-                                    jnp.asarray(1e-300, f64))
+                                    jnp.asarray(tiny, f64))
                 rb = (r64 / scale).astype(dtype)
                 dx, it, _, _, h_in = body(
                     rb, jnp.zeros_like(rb),
                     jnp.asarray(inner_tol, dtype), it_limit - it_tot)
                 x64n = x64 + scale * dx.astype(f64)
-                r64n = b64 - self._spmv_wide(x64n, Ad64)
+                r64n = b64 - self._spmv_wide(x64n, Ad64, wide)
                 nrm_n = norm64(r64n)
                 if keep_history:
                     # place h_in rows 1..it (scaled) at hist rows
@@ -1214,9 +1428,11 @@ class Solver:
                     src = rows - it_tot
                     take = jnp.broadcast_to(
                         jnp.clip(src, 0, max_iters), (max_iters + 1, m))
-                    cand = jnp.take_along_axis(h_in, take, axis=0)
+                    cand = jnp.take_along_axis(h_in, take, axis=0) \
+                        .astype(hist_dt)
                     mask = (src >= 1) & (src <= it)
-                    hist = jnp.where(mask, cand * scale.astype(dtype), hist)
+                    hist = jnp.where(mask, cand * scale.astype(hist_dt),
+                                     hist)
                 done_n = check_convergence(crit, nrm_n, nrm_ini, nrm_ini,
                                            tol, alt_tol) \
                     | ~jnp.all(jnp.isfinite(nrm_n))

@@ -75,9 +75,11 @@ def _lanczos_spectrum(matvec, n: int, dtype, m: int = 40, seed: int = 0):
 
 def _power_iteration_lambda_max(Ad, dinv, n_iters=20, seed=0):
     """Estimate λmax of D⁻¹A by power iteration (device, fixed iterations)."""
+    from ..core.precision import compute_dtype
     n = Ad.n_rows * Ad.block_dim
+    dt = compute_dtype(np.dtype(Ad.dtype))   # estimate at f32+, always
     x = jnp.asarray(np.random.default_rng(seed).standard_normal(n),
-                    dtype=Ad.dtype)
+                    dtype=dt)
 
     def body(i, carry):
         x, lam = carry
@@ -87,7 +89,7 @@ def _power_iteration_lambda_max(Ad, dinv, n_iters=20, seed=0):
         return y / jnp.maximum(nrm, 1e-30), lam
 
     _, lam = jax.lax.fori_loop(0, n_iters, body,
-                               (x, jnp.asarray(1.0, Ad.dtype)))
+                               (x, jnp.asarray(1.0, dt)))
     return lam
 
 
@@ -136,9 +138,13 @@ class ChebyshevSolver(_PrecondMixin, Solver):
         no_pre = (self.preconditioner is None
                   or self.preconditioner.config_name == "NOSOLVER")
         if self.lambda_mode == 0:
+            # spectrum estimation always runs at f32+ — an 8-bit
+            # mantissa Lanczos recurrence would hand the smoother a
+            # garbage interval (mixed precision: bf16 is storage only)
+            from ..core.precision import compute_dtype
             lmin_r, lmax = _lanczos_spectrum(
                 lambda v: self._apply_M(spmv(self.Ad, v)),
-                self.Ad.n, self.Ad.dtype)
+                self.Ad.n, compute_dtype(np.dtype(self.Ad.dtype)))
             if lmax <= 0:
                 # degenerate Lanczos estimate (indefinite/garbage Ritz
                 # values): the old fallback set lmin = 0.125·lmax >
@@ -186,11 +192,12 @@ class ChebyshevSolver(_PrecondMixin, Solver):
         an amplifier — so the estimate gets extra iterations plus a
         safety factor beyond the usual 1.05 (a slightly generous interval
         only costs a little smoothing efficiency)."""
+        from ..core.precision import compute_dtype
         n = self.Ad.n
+        dt = compute_dtype(np.dtype(self.Ad.dtype))
         x = jnp.asarray(
-            np.random.default_rng(0).standard_normal(n),
-            dtype=self.Ad.dtype)
-        lam = jnp.asarray(1.0, self.Ad.dtype)
+            np.random.default_rng(0).standard_normal(n), dtype=dt)
+        lam = jnp.asarray(1.0, dt)
         for _ in range(30):
             y = self._apply_M(spmv(self.Ad, x))
             nrm = blas.nrm2(y)

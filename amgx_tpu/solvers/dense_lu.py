@@ -21,15 +21,21 @@ class DenseLUSolver(Solver):
     is_smoother = False
 
     def solver_setup(self):
+        # the factorisation dtype FLOORS at f32 (mixed precision: a
+        # bf16 LU would make the coarse solve the hierarchy's noise
+        # floor; the coarsest grid is tiny, so f32 storage costs
+        # nothing) — scipy also cannot densify into sub-f32 buffers
+        from ..core.precision import compute_dtype
+        fdt = compute_dtype(np.dtype(self.Ad.dtype))
         if self.A is not None:
             # block-distributed coarsest: the coarsest grid is tiny, so
             # assembling it here is the consolidation, not a scalability
             # leak
             host = (self.A.assemble_global() if self.A.host is None
                     and self.A.blocks is not None else self.A.host)
-            dense = np.asarray(host.todense(), dtype=self.Ad.dtype)
+            dense = np.asarray(host.todense()).astype(fdt)
         else:
-            dense = _densify_device(self.Ad)
+            dense = _densify_device(self.Ad).astype(fdt)
         if self.Ad.fmt == "sharded-ell":
             # consolidation analog (reference "glue", distributed/glue.h):
             # the tiny coarsest system is replicated on every device and
@@ -50,12 +56,21 @@ class DenseLUSolver(Solver):
             pass
         self._lu, self._piv = jax.scipy.linalg.lu_factor(dense_dev)
 
+    def _lu_apply(self, b):
+        # sub-f32 inputs solve at the factor's f32 and round once on
+        # the way out (the vectors' dtype is the cycle's contract);
+        # wider b (f64 refinement residuals) keeps jax promotion
+        from ..core.precision import is_sub_f32
+        narrow = is_sub_f32(b.dtype)
+        bw = b.astype(self._lu.dtype) if narrow else b
+        x = jax.scipy.linalg.lu_solve((self._lu, self._piv), bw)
+        return x.astype(b.dtype) if narrow else x
+
     def solve_iteration(self, b, x, state, iter_idx):
-        x = jax.scipy.linalg.lu_solve((self._lu, self._piv), b)
-        return x, state
+        return self._lu_apply(b), state
 
     def apply(self, b, x0=None, n_iters=None):
-        return jax.scipy.linalg.lu_solve((self._lu, self._piv), b)
+        return self._lu_apply(b)
 
 
 def _densify_device(Ad) -> np.ndarray:

@@ -137,8 +137,12 @@ def _l1_dinv_fn():
     from ..ops.spmv import abs_rowsum
 
     def fn(Ad):
+        # abs_rowsum accumulates (and returns) f32 for sub-f32 packs;
+        # the STORED dinv rides at the pack dtype — smoother data must
+        # not silently upcast (mixed-precision bandwidth contract)
         absrow = abs_rowsum(Ad)
-        return 1.0 / jnp.where(absrow == 0, 1.0, absrow)
+        dinv = 1.0 / jnp.where(absrow == 0, 1.0, absrow)
+        return dinv.astype(Ad.diag.dtype)
 
     return jax.jit(fn)
 

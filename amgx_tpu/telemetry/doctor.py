@@ -399,6 +399,24 @@ def diagnose(paths: List[str]) -> dict:
             hints.append(
                 f"level {lvl} pack {d.get('pack', '?')} wastes "
                 f"{w:.2f}× bandwidth on padding slots")
+    # mixed precision (core/precision.py): a multi-level f32 hierarchy
+    # whose SpMV is bandwidth-class (dia/shift/window/binned — the
+    # memory-bound packs) leaves the single biggest single-chip lever
+    # unpulled: bf16 storage halves every level's value bytes while
+    # arithmetic stays f32
+    lvl_dts = {str(d.get("dtype")) for d in levels.values()
+               if d.get("dtype")}
+    bw_packs = ("dia", "dia3", "ell/shift", "ell/window", "ell/binned",
+                "csr/binned")
+    if len(levels) >= 2 and lvl_dts and lvl_dts <= {"float32"} and \
+            any(str(d.get("pack", "")).startswith(bw_packs)
+                for d in levels.values()):
+        hints.append(
+            "bandwidth-bound f32 hierarchy: every level stores float32"
+            " — try mixed precision (hierarchy_dtype=bfloat16) to "
+            "halve per-cycle HBM bytes; arithmetic accumulates in f32 "
+            "and tolerances below the f32 floor still converge via "
+            "the promotion ladder (krylov_dtype stays float32)")
     if halo_local_ratio is not None and halo_local_ratio > HALO_HINT:
         hints.append(
             f"halo exchange moves {halo_local_ratio:.2f}× the local "
@@ -802,7 +820,7 @@ def render(d: dict) -> str:
         L.append("hierarchy cost model (per level)")
         L.append("-" * 40)
         L.append(f"  {'lvl':<4}{'rows':>10}{'nnz':>12}{'pack':>14}"
-                 f"{'bytes/apply':>14}{'waste':>8}")
+                 f"{'dtype':>10}{'bytes/apply':>14}{'waste':>8}")
         for lvl, x in sorted(d["levels"].items(),
                              key=lambda kv: int(kv[0])
                              if str(kv[0]).isdigit() else 99):
@@ -811,6 +829,7 @@ def render(d: dict) -> str:
                 f"{int(x.get('rows', 0)):>10}"
                 f"{int(x.get('nnz', 0)):>12}"
                 f"{str(x.get('pack', '?')):>14}"
+                f"{str(x.get('dtype', '?')):>10}"
                 f"{_fmt_bytes(x.get('bytes_per_apply')):>14}"
                 + (f"{x['padding_waste']:>8.2f}"
                    if isinstance(x.get("padding_waste"), (int, float))

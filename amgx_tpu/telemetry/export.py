@@ -191,6 +191,21 @@ def validate_record(rec: dict):
                 need(a.get(k) is None
                      or isinstance(a[k], (int, float)),
                      f"slo_window event has non-numeric {k}")
+        if rec["name"] in ("level_cost", "op_cost", "operator_cost"):
+            # cost-model descriptors are the doctor's roofline input;
+            # the dtype field is the mixed-precision contract — a level
+            # whose precision stopped being reported would silently
+            # break the bf16-vs-f32 bandwidth accounting
+            a = rec["attrs"]
+            need(isinstance(a.get("pack"), str) and a["pack"],
+                 f"{rec['name']} event missing pack")
+            need(isinstance(a.get("dtype"), str) and a["dtype"],
+                 f"{rec['name']} event missing dtype")
+            need(isinstance(a.get("itemsize"), int),
+                 f"{rec['name']} event missing itemsize")
+            if rec["name"] == "level_cost":
+                need(isinstance(a.get("level"), int),
+                     "level_cost event missing integer level")
         if rec["name"] == "device_setup_fallback":
             # fallback events are the doctor's per-level "why did rap
             # run host-side" input (amg/device_setup/)

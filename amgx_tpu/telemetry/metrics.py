@@ -87,16 +87,18 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_forensics_asymptotic_rate":
         ("gauge", "asymptotic per-iteration residual reduction of the "
                   "last solve (trailing-half estimate)"),
-    # ---- static cost model (telemetry/costmodel.py) ----------------
+    # ---- static cost model (telemetry/costmodel.py); the dtype label
+    # is the level's STORAGE precision (mixed precision: bf16 levels
+    # stream half the value bytes of f32 ones) ---------------------
     "amgx_level_spmv_bytes":
         ("gauge", "modelled HBM bytes of one SpMV on one hierarchy "
-                  "level {level}"),
+                  "level {level,dtype}"),
     "amgx_level_spmv_flops":
         ("gauge", "useful flops (2*nnz) of one SpMV on one hierarchy "
-                  "level {level}"),
+                  "level {level,dtype}"),
     "amgx_level_padding_waste":
         ("gauge", "stored slots / nnz of one level's device pack "
-                  "{level}"),
+                  "{level,dtype}"),
     # ---- setup profiler (telemetry/setup_profile.py) ----------------
     "amgx_setup_phase_seconds":
         ("gauge", "exclusive wall seconds of one setup phase component "

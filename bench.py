@@ -336,6 +336,69 @@ def _run_case_inner(oracle, make_matrix, cfg, dtype, sync_shape=None,
             "pack": pack_kind(Ad)}
 
 
+def _hier_cycle_bytes(slv):
+    """(modelled bytes one V-cycle streams, per-level dtypes) of a kept
+    solver's hierarchy — the cost-model numerator of the bench's
+    mixed-precision effective-GB/s columns (telemetry/costmodel.py; no
+    device work, shapes only)."""
+    from amgx_tpu.telemetry import costmodel
+    hier = getattr(getattr(slv, "preconditioner", None), "hierarchy",
+                   None) or getattr(slv, "hierarchy", None)
+    if hier is None or not hier.levels:
+        return None, None
+    costs = [c for _, c in hier.level_costs()]
+    if not costs:
+        return None, None
+    hc = costmodel.hierarchy_cost(costs)
+    return int(hc["total_bytes_per_cycle"]), \
+        [c.get("dtype") for c in costs]
+
+
+def _bench_mixed_precision(oracle, make_matrix, cfg_str, dtype,
+                           sync_shape, f32_case, f32_bytes, f32_dts):
+    """bf16-hierarchy variant of the headline case (ISSUE 10): same
+    solver stack with ``amg:hierarchy_dtype=bfloat16``, reporting
+    iteration counts, modelled bytes/cycle and achieved GB/s per
+    variant, plus the EFFECTIVE speedup — f32-equivalent work rate
+    (f32 bytes-per-cycle ÷ per-cycle wall), so halved bytes at equal
+    achieved bandwidth reads as ~2×."""
+    import amgx_tpu as amgx
+    hold = []
+    case_bf = _run_case(
+        oracle, make_matrix,
+        amgx.AMGConfig(cfg_str + ", amg:hierarchy_dtype=bfloat16"),
+        dtype, sync_shape=sync_shape, keep=hold)
+    bf_bytes, bf_dts = _hier_cycle_bytes(hold[0])
+
+    def _variant(case, byts, dts):
+        percyc = case["solve_s"] / max(case["iterations"], 1)
+        v = {"solve_s": case["solve_s"], "setup_s": case["setup_s"],
+             "iterations": case["iterations"], "relres": case["relres"],
+             "status": case["status"],
+             "per_cycle_s": round(percyc, 6),
+             "bytes_per_cycle": byts,
+             "level_dtypes": dts}
+        if byts:
+            v["achieved_gbs"] = round(byts / max(percyc, 1e-12) / 1e9,
+                                      1)
+        return v
+
+    out = {"f32": _variant(f32_case, f32_bytes, f32_dts),
+           "bf16": _variant(case_bf, bf_bytes, bf_dts)}
+    pc32 = out["f32"]["per_cycle_s"]
+    pcbf = out["bf16"]["per_cycle_s"]
+    if pc32 and pcbf:
+        # f32-equivalent achieved rate ratio: both variants do the same
+        # numerical work per cycle — charge both at the f32 bytes
+        out["effective_speedup"] = round(pc32 / pcbf, 3)
+        if f32_bytes:
+            out["effective_gbs_f32equiv"] = round(
+                f32_bytes / pcbf / 1e9, 1)
+    out["iters_ratio"] = round(
+        case_bf["iterations"] / max(f32_case["iterations"], 1), 3)
+    return out, case_bf
+
+
 def _warm_start_child() -> int:
     """One cold/warm-start probe process (``bench.py
     --warm-start-child``): import → classical setup → first solve, all
@@ -797,7 +860,7 @@ def main():
     # short Krylov memory, and FGMRES orthogonalisation traffic scales
     # with the basis size (measured best total time at 128³ and 256³);
     # 2+2 sweeps trades slightly costlier cycles for fewer iterations
-    cfg = amgx.AMGConfig(
+    cfg_str = (
         "config_version=2, solver(out)=FGMRES, out:max_iters=100, "
         "out:monitor_residual=1, out:tolerance=1e-8, "
         "out:convergence=RELATIVE_INI, out:gmres_n_restart=6, "
@@ -807,11 +870,33 @@ def main():
         "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
         "amg:presweeps=2, amg:postsweeps=2, amg:min_coarse_rows=32, "
         "amg:coarse_solver=DENSE_LU_SOLVER" + fore_knob)
+    cfg = amgx.AMGConfig(cfg_str)
     precompile_poisson7pt(n_side, n_side, n_side, dtype)
+    hold_f32 = []
     case = _run_case(
         A, lambda: poisson7pt_device(n_side, n_side, n_side,
                                      device_dtype=dtype),
-        cfg, dtype, sync_shape=(7, n))
+        cfg, dtype, sync_shape=(7, n), keep=hold_f32)
+
+    # mixed-precision A/B (ISSUE 10): the SAME headline stack with a
+    # bf16-stored hierarchy under the f32 Krylov — iteration counts,
+    # bytes/cycle and the effective (f32-equivalent) speedup; a failure
+    # here must not take down the headline JSON line
+    mixed = None
+    case_bf16 = None
+    try:
+        f32_bytes, f32_dts = _hier_cycle_bytes(hold_f32[0]) \
+            if hold_f32 else (None, None)
+        mixed, case_bf16 = _bench_mixed_precision(
+            A, lambda: poisson7pt_device(n_side, n_side, n_side,
+                                         device_dtype=dtype),
+            cfg_str, dtype, (7, n), case, f32_bytes, f32_dts)
+    except Exception as e:
+        import traceback
+        print(f"[bench] mixed-precision benchmark failed: {e}",
+              file=sys.stderr)
+        traceback.print_exc()
+        mixed = {"error": str(e)[:200]}
 
     # north-star scale (BASELINE config 3: 256³ FGMRES + aggregation AMG):
     # measured in the same run when the headline ran at the default size
@@ -996,6 +1081,22 @@ def main():
         extra_cases["classical_device_resetup48"] = guarded(
             "classical_device_resetup48", case_resetup)
 
+        # bf16-hierarchy headline case at 128³ (ISSUE 10 acceptance):
+        # the perf-gate case — solve/setup/iterations like every other
+        # case plus the effective-speedup FLOOR metric
+        if case_bf16 is not None and isinstance(mixed, dict) \
+                and "error" not in mixed:
+            extra_cases["poisson128_bf16"] = {
+                "setup_s": case_bf16["setup_s"],
+                "solve_s": case_bf16["solve_s"],
+                "iterations": case_bf16["iterations"],
+                "relres": case_bf16["relres"],
+                "pack": case_bf16.get("pack"),
+                "bf16_effective_speedup": mixed.get(
+                    "effective_speedup"),
+                "achieved_gbs": mixed["bf16"].get("achieved_gbs"),
+            }
+
     # serving mode (amgx_tpu/serve/): request-level latency percentiles
     # + cache/batch stats, mirroring the PR 3 telemetry embedding — a
     # transient failure must not take down the headline JSON line
@@ -1077,6 +1178,7 @@ def main():
             "telemetry": case.get("telemetry"),
             "serving": serving,
             **({"warm_start": warm_start} if warm_start else {}),
+            **({"mixed_precision": mixed} if mixed else {}),
             "device_dtype": str(dtype),
             **({"poisson256": big} if big else {}),
             **extra_cases,

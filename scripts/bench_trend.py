@@ -56,6 +56,12 @@ CASES = (
     # zero cold-start probe (ISSUE 8): fresh-process ready time with a
     # populated cache dir; old rounds lack the block and render "-"
     ("warm_s", _x(("extras", "warm_start", "warm_start_s"))),
+    # mixed precision (ISSUE 10): bf16-vs-f32 effective per-cycle
+    # speedup of the headline stack (f32-equivalent bytes ÷ wall) and
+    # the bf16 variant's iteration count; pre-PR-10 rounds render "-"
+    ("bf16_x", _x(("extras", "mixed_precision", "effective_speedup"))),
+    ("bf16_iters", _x(("extras", "mixed_precision", "bf16",
+                       "iterations"))),
     # setup attribution (AMGX_BENCH_SETUP_PROFILE=1 rounds): compile
     # share of the classical-64³ setup — the number whose silent growth
     # WAS the r02→r04 regression.  Older rounds lack the block and

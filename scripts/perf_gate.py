@@ -47,6 +47,11 @@ TIME_FLOOR_S = 0.25
 #: nonzero shed — so a rate regresses when it exceeds the baseline by
 #: this much in absolute terms
 RATE_SLACK = 0.05
+#: relative slack for floor (higher-is-better) metrics: after --update
+#: ratchets the baseline to a measured value, ordinary run-to-run noise
+#: must not fail the gate — a floor regresses when the value falls more
+#: than this fraction below the baseline
+FLOOR_SLACK = 0.05
 
 #: per-case metrics the gate tracks: (key in the case dict, kind).
 #: cold/warm_start_s come from the bench ``warm_start`` block (ISSUE 8:
@@ -54,11 +59,16 @@ RATE_SLACK = 0.05
 #: toward cold_start_s — gate it like any other time metric);
 #: serve_p99_s/rejection_rate come from the serving block's open-loop
 #: probe (ISSUE 9: the steady-state SLO numbers — a serving regression
-#: shows as the tail latency or the shed fraction creeping up)
+#: shows as the tail latency or the shed fraction creeping up);
+#: bf16_effective_speedup is a FLOOR metric from the bench
+#: mixed_precision block (ISSUE 10: the bf16 hierarchy must keep its
+#: f32-equivalent per-cycle rate advantage — dropping below the pinned
+#: floor means the precision win regressed)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
            ("cold_start_s", "time"), ("warm_start_s", "time"),
-           ("serve_p99_s", "time"), ("rejection_rate", "rate"))
+           ("serve_p99_s", "time"), ("rejection_rate", "rate"),
+           ("bf16_effective_speedup", "floor"))
 
 
 def _extract_parsed(rec: dict):
@@ -173,6 +183,16 @@ def compare(baseline: dict, cases: dict, time_ratio=None,
                     not isinstance(v, (int, float)):
                 continue
             checked += 1
+            if kind == "floor":
+                # higher-is-better metric (speedup factors): regresses
+                # by FALLING more than FLOOR_SLACK below the baseline
+                limit = b * (1.0 - FLOOR_SLACK)
+                if v < limit:
+                    regressions.append({
+                        "case": case, "metric": key, "baseline": b,
+                        "value": v, "ratio": round(v / b, 3)
+                        if b else None, "limit": round(limit, 4)})
+                continue
             if kind == "rate":
                 # absolute slack, not a ratio: rates live near zero
                 limit = b + RATE_SLACK

@@ -443,12 +443,113 @@ def main():
                for e in trace_o["traceEvents"]):
         fail("chrome trace is missing async request slices")
 
+    # 13. mixed precision (ISSUE 10): the cost-model events are
+    # dtype-labeled (level_cost/op_cost schema now REQUIRES pack, dtype
+    # and itemsize — validate_jsonl above enforces it on every trace),
+    # a bf16-hierarchy solve reports bfloat16 levels in the events, the
+    # gauges and the doctor's cost-model table, and the all-f32 trace
+    # from section 1 earns the "try mixed precision" hint
+    def _cost_events(recs_):
+        return [r["attrs"] for r in recs_ if r["kind"] == "event"
+                and r["name"] == "level_cost"]
+
+    lv_64 = _cost_events(recs)
+    if not lv_64:
+        fail("section-1 trace has no level_cost events")
+    if len({a.get("dtype") for a in lv_64}) != 1:
+        fail(f"section-1 level_cost dtypes inconsistent: "
+             f"{[a.get('dtype') for a in lv_64]}")
+    # an all-f32 bandwidth-class hierarchy earns the hint …
+    telemetry.reset()
+    telemetry.disable()
+    path_32 = path + ".f32"
+    if os.path.exists(path_32):
+        os.unlink(path_32)
+    cfg_32 = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-5, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        "krylov_dtype=float32, "
+        f"out:telemetry=1, out:telemetry_path={path_32}")
+    slv_32 = amgx.create_solver(cfg_32)
+    slv_32.setup(amgx.Matrix(A))
+    slv_32.solve(np.ones(A.shape[0]))
+    with open(path_32) as f:
+        lines_32 = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_32)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"f32 trace: {e}")
+    recs_32 = [json.loads(l) for l in lines_32 if l.strip()]
+    lv_f32 = _cost_events(recs_32)
+    if not all(a.get("dtype") == "float32" for a in lv_f32):
+        fail(f"f32 trace level_cost dtypes drifted: "
+             f"{[a.get('dtype') for a in lv_f32]}")
+    diag_32 = doctor.diagnose([path_32])
+    if not any("hierarchy_dtype=bfloat16" in h
+               for h in diag_32.get("hints", ())):
+        fail("doctor did not hint mixed precision for the "
+             "bandwidth-bound all-f32 hierarchy")
+    # … while a bf16 one reports bfloat16 levels and no hint
+    telemetry.reset()
+    telemetry.disable()
+    path_m = path + ".mixed"
+    if os.path.exists(path_m):
+        os.unlink(path_m)
+    cfg_m = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-6, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        "amg:hierarchy_dtype=bfloat16, "
+        f"out:telemetry=1, out:telemetry_path={path_m}")
+    slv_m = amgx.create_solver(cfg_m)
+    slv_m.setup(amgx.Matrix(A))
+    res_m = slv_m.solve(np.ones(A.shape[0]))
+    if int(res_m.status) != 0:
+        fail(f"mixed-precision smoke solve did not converge "
+             f"({res_m.status})")
+    with open(path_m) as f:
+        lines_m = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_m)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"mixed-precision trace: {e}")
+    recs_m = [json.loads(l) for l in lines_m if l.strip()]
+    lv_m = _cost_events(recs_m)
+    if not any(a.get("dtype") == "bfloat16" for a in lv_m):
+        fail(f"bf16-hierarchy trace has no bfloat16 level_cost events: "
+         f"{[a.get('dtype') for a in lv_m]}")
+    if not all(isinstance(a.get("itemsize"), int) for a in lv_m):
+        fail("level_cost events are missing the itemsize field")
+    bf_gauge = [r for r in recs_m if r["kind"] == "gauge"
+                and r["name"] == "amgx_level_spmv_bytes"
+                and r["labels"].get("dtype") == "bfloat16"]
+    if not bf_gauge:
+        fail("no bfloat16-labeled amgx_level_spmv_bytes gauge recorded")
+    diag_m = doctor.diagnose([path_m])
+    if any("hierarchy_dtype=bfloat16" in h
+           for h in diag_m.get("hints", ())):
+        fail("doctor hinted mixed precision for an already-bf16 "
+             "hierarchy")
+    report_m = doctor.render(diag_m)
+    if "dtype" not in report_m or "bfloat16" not in report_m:
+        fail("doctor cost-model table is missing the dtype column / "
+             "bfloat16 levels")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
-          f"serving-obs OK)")
+          f"serving-obs OK, mixed-precision OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -456,6 +557,8 @@ def main():
         os.unlink(path_d)
         os.unlink(path_d2)
         os.unlink(path_o)
+        os.unlink(path_32)
+        os.unlink(path_m)
 
 
 if __name__ == "__main__":

@@ -58,6 +58,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "serve_obs: live serving observability fast tests "
                    "(tier-1; pytest -m serve_obs selects just these)")
+    config.addinivalue_line(
+        "markers", "mixed_precision: bf16-hierarchy / promotion-ladder "
+                   "fast tests (tier-1; pytest -m mixed_precision "
+                   "selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

@@ -41,19 +41,19 @@ def test_success_implies_true_residual_below_tol():
 
 
 def test_fp32_no_false_convergence_claim():
-    """An fp32-only solve asked for 1e-10 must NOT claim SUCCESS unless the
-    true residual actually reaches it (it can't in fp32)."""
+    """An fp32-only solve asked for 1e-10 must NOT claim SUCCESS: with no
+    promotion rung available (fp32 host, nothing wider to refine
+    against) the solve refuses up front with ``BadParametersError``
+    instead of silently stalling through its whole iteration budget
+    (core/precision.py promotion ladder)."""
+    from amgx_tpu.errors import BadParametersError
     A = poisson7pt(10, 10, 10).astype(np.float32)
     b = np.ones(A.shape[0], dtype=np.float32)
     slv = amgx.create_solver(
         amgx.AMGConfig(FGMRES_AMG.format(tol="1e-10")))
     slv.setup(amgx.Matrix(A))   # fp32 host + fp32 device: no refinement
-    res = slv.solve(b)
-    relres = _true_relres(A.astype(np.float64), b.astype(np.float64), res.x)
-    if res.status == SolveStatus.SUCCESS:
-        assert relres <= 1e-10
-    else:
-        assert res.status == SolveStatus.NOT_CONVERGED
+    with pytest.raises(BadParametersError, match="precision floor"):
+        slv.solve(b)
 
 
 def test_mixed_precision_refinement_reaches_deep_tolerance():

@@ -1,0 +1,401 @@
+"""Mixed-precision AMG (ISSUE 10): bf16 hierarchy storage under an f32
+Krylov outer with iterative-refinement promotion.
+
+The contract under test (core/precision.py — the TPU realisation of the
+reference's dDFI mixed modes, ``amgx_config.h:114-123``):
+
+* storage narrows, arithmetic does not — every SpMV over a sub-f32 pack
+  accumulates in f32 and returns the Krylov dtype;
+* the hierarchy policy (``amg:hierarchy_dtype=bfloat16``) narrows level
+  operators, smoother data and transfer packs while setup math (RAP,
+  spectrum estimates) and the coarse dense-LU stay f32+;
+* tolerances below the active precision's floor either promote through
+  the defect-correction ladder (bf16 → f32 → f64) or refuse loudly with
+  ``BadParametersError`` — never a silent stall;
+* precision is part of pack identity: fingerprints (serve/AOT keys) and
+  values-only resetup behave per dtype.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.core import precision
+from amgx_tpu.errors import BadParametersError, SolveStatus
+from amgx_tpu.io import poisson7pt
+
+pytestmark = [pytest.mark.mixed_precision]
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_clean():
+    """The telemetry-labeled tests enable the process-global recorder
+    via config; leave it the way the other suites expect it."""
+    yield
+    telemetry.reset()
+    telemetry.disable()
+
+BF16 = np.dtype("bfloat16")
+
+PCG_AMG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=400, "
+    "out:monitor_residual=1, out:tolerance={tol}, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=12, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def _true_relres(A, b, x):
+    x = np.asarray(x, dtype=np.float64)
+    return float(np.linalg.norm(b - A @ x) / np.linalg.norm(b))
+
+
+def _level_packs(slv):
+    hier = slv.preconditioner.hierarchy
+    packs = []
+    for lvl in hier.levels:
+        packs.append(lvl._Ad if lvl._Ad is not None
+                     else getattr(lvl.A, "_device", None))
+    return hier, packs
+
+
+# --------------------------------------------------------- pack dtype matrix
+def _scattered(n, density, seed):
+    A = sp.random(n, n, density=density, random_state=seed, format="csr")
+    return (A + A.T + 8.0 * sp.identity(n)).tocsr()
+
+
+def _pack_for(kind, dtype):
+    """(device pack, host csr) of one representative matrix per pack
+    kind — the dtype-matrix of satellite 1."""
+    from amgx_tpu.core.matrix import pack_device
+    if kind == "dia":
+        m = amgx.Matrix(poisson7pt(16, 16, 16))
+        m.device_dtype = dtype
+        return m.device(), sp.csr_matrix(m.host)
+    A = _scattered(1500, 0.01, 3)
+    if kind == "csr":
+        # one wide row pushes past ell_max_width into the csr fmt
+        Al = A.tolil()
+        Al[17] = np.random.default_rng(9).standard_normal(1500) * \
+            (np.random.default_rng(9).random(1500) < 0.3)
+        A = sp.csr_matrix(Al)
+        return pack_device(A, 1, dtype, dia_max_diags=0,
+                           ell_max_width=64), A
+    # "ell" / "binned" share the scattered matrix; the binned variant
+    # runs under the interpreter with shift/window disabled (the
+    # test_pallas_csr forcing) so the plane pack attaches at f32
+    return pack_device(A, 1, dtype, dia_max_diags=0), A
+
+
+@pytest.mark.parametrize("kind", ["dia", "ell", "binned", "csr"])
+def test_pack_dtype_matrix_apply_parity(kind, monkeypatch):
+    """Satellite 1: each pack kind builds and applies at f32 AND bf16,
+    with bf16 parity at bf16 tolerance, f32 Krylov vectors staying f32
+    through the apply, and rowsums accumulating f32."""
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.spmv import abs_rowsum, spmv
+    if kind == "binned":
+        from amgx_tpu.ops import pallas_csr, pallas_ell, pallas_shift
+        monkeypatch.setattr(pallas_csr, "_INTERPRET", True)
+        monkeypatch.setattr(pallas_shift, "shift_pack",
+                            lambda *a, **k: None)
+        monkeypatch.setattr(pallas_ell, "ell_window_pack",
+                            lambda *a, **k: None)
+    outs = {}
+    for dt, tol in ((np.float32, 1e-5), (BF16, 3e-2)):
+        Ad, A = _pack_for(kind, dt)
+        assert np.dtype(Ad.dtype) == dt
+        x = np.random.default_rng(0).standard_normal(A.shape[1])
+        y = spmv(Ad, jnp.asarray(x, jnp.float32))
+        # the Krylov contract: an f32 vector through any-pack SpMV
+        # comes back f32 (bf16 storage never narrows the iteration)
+        assert jnp.dtype(y.dtype) == jnp.float32
+        ref = A.astype(np.float64) @ x
+        scale = max(np.abs(ref).max(), 1.0)
+        err = np.abs(np.asarray(y, np.float64) - ref).max() / scale
+        assert err < tol, (kind, dt, err)
+        rs = abs_rowsum(Ad)
+        assert jnp.dtype(rs.dtype) == jnp.float32
+        ref_rs = np.abs(A.astype(np.float64)).sum(axis=1).A1 \
+            if hasattr(np.abs(A).sum(axis=1), "A1") \
+            else np.asarray(np.abs(A.astype(np.float64)).sum(axis=1)
+                            ).ravel()
+        rs_err = np.abs(np.asarray(rs, np.float64) - ref_rs).max() \
+            / max(ref_rs.max(), 1.0)
+        assert rs_err < tol, (kind, dt, rs_err)
+        outs[np.dtype(dt).name] = np.asarray(y, np.float64)
+    # and bf16 really differs from f32 only at rounding level
+    d = np.abs(outs["float32"] - outs["bfloat16"]).max()
+    assert d < 3e-2 * max(np.abs(outs["float32"]).max(), 1.0)
+
+
+def test_pattern_fingerprint_keys_on_dtype():
+    """Serve/AOT cache identity: equal structure at different pack
+    dtypes must NOT share a session hierarchy — the pattern fingerprint
+    is precision-keyed and a device_dtype change invalidates it."""
+    A = poisson7pt(8, 8, 8)
+    m32 = amgx.Matrix(A)
+    m32.device_dtype = np.float32
+    mbf = amgx.Matrix(A)
+    mbf.device_dtype = BF16
+    assert m32.pattern_fingerprint() != mbf.pattern_fingerprint()
+    fp = m32.pattern_fingerprint()
+    m32.device_dtype = BF16
+    assert m32.pattern_fingerprint() != fp
+    assert m32.pattern_fingerprint() == mbf.pattern_fingerprint()
+
+
+# ------------------------------------------------------- floors and promotion
+def test_below_floor_without_rung_raises():
+    """Satellite 2: a bf16 pack under an f32 HOST matrix asked for 1e-8
+    has no honest rung (f32 can't out-resolve the f32 host it would
+    refine against below its own floor) — BadParametersError, not a
+    silent stall."""
+    A = poisson7pt(8, 8, 8).astype(np.float32)
+    b = np.ones(A.shape[0], dtype=np.float32)
+    m = amgx.Matrix(A)
+    m.device_dtype = BF16
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG.format(tol="1e-8")))
+    slv.setup(m)
+    with pytest.raises(BadParametersError, match="precision floor"):
+        slv.solve(b)
+
+
+def test_bf16_pack_promotes_to_f32_rung():
+    """The same bf16-under-f32-host pack at an f32-reachable tolerance
+    promotes through the bf16 → f32 rung and converges honestly."""
+    A = poisson7pt(8, 8, 8).astype(np.float32)
+    b = np.ones(A.shape[0], dtype=np.float32)
+    m = amgx.Matrix(A)
+    m.device_dtype = BF16
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG.format(tol="1e-4")))
+    slv.setup(m)
+    assert np.dtype(slv.Ad.dtype) == BF16
+    refine, wide, _ = slv._promotion_plan()
+    assert refine and wide == np.dtype(np.float32)
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert _true_relres(A.astype(np.float64), b.astype(np.float64),
+                        res.x) <= 2e-4
+
+
+def test_promotion_target_ladder_shape():
+    """The ladder's honesty gates: one rounding-residue plane per
+    promotion (rung ≤ 2× device itemsize), bounded by the host dtype,
+    no promotion above the floor."""
+    f16, f32, f64 = BF16, np.dtype(np.float32), np.dtype(np.float64)
+    assert precision.promotion_target(f16, f64, 1e-5) == f32
+    assert precision.promotion_target(f32, f64, 1e-9) == f64
+    # a bf16 pack cannot honestly claim f64 residuals
+    assert precision.promotion_target(f16, f64, 1e-9) is None
+    # host as narrow as the pack: nothing wider to refine against
+    assert precision.promotion_target(f32, f32, 1e-9) is None
+    # tolerance reachable at the pack dtype: no promotion needed
+    assert precision.promotion_target(f32, f64, 1e-4) is None
+
+
+# ---------------------------------------------------------- promotion ladder
+def test_bf16_hierarchy_iteration_band_poisson32():
+    """Satellite 3a: bf16-preconditioned PCG reaches the f32 tolerance
+    on poisson 32³ with iterations ≤ 1.3× the all-f32 baseline, and the
+    coarse dense-LU stays f32."""
+    A = poisson7pt(32, 32, 32)
+    b = np.ones(A.shape[0])
+    runs = {}
+    for knob in ("", ", amg:hierarchy_dtype=bfloat16"):
+        m = amgx.Matrix(A)
+        m.device_dtype = np.float32
+        slv = amgx.create_solver(
+            amgx.AMGConfig(PCG_AMG.format(tol="1e-6") + knob))
+        slv.setup(m)
+        res = slv.solve(b)
+        assert res.status == SolveStatus.SUCCESS
+        assert _true_relres(A, b, res.x) <= 1e-6
+        runs[knob] = (int(res.iterations), slv)
+    it32, _ = runs[""]
+    itbf, slv_bf = runs[", amg:hierarchy_dtype=bfloat16"]
+    assert itbf <= int(np.ceil(1.3 * it32)), (itbf, it32)
+    hier, packs = _level_packs(slv_bf)
+    assert all(np.dtype(p.dtype) == BF16 for p in packs if p is not None)
+    coarse = getattr(hier.coarsest, "_device", None)
+    if coarse is not None:
+        assert np.dtype(coarse.dtype) == np.dtype(np.float32)
+    # smoother data rides the level dtype — no silent upcast
+    sm = hier.levels[0].smoother
+    dinv = getattr(sm, "dinv", None)
+    if dinv is not None:
+        assert np.dtype(str(dinv.dtype)) == BF16
+
+
+def test_full_ladder_reaches_1e12():
+    """Satellite 3b: the full bf16 → f32 → f64 ladder — bf16 hierarchy
+    preconditioner, f32 Krylov pack, f64 refinement — hits 1e-12 on an
+    SPD case."""
+    A = poisson7pt(12, 12, 12)                     # f64 SPD host
+    b = np.random.default_rng(11).standard_normal(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(
+        PCG_AMG.format(tol="1e-12")
+        + ", krylov_dtype=float32, amg:hierarchy_dtype=bfloat16"))
+    m = amgx.Matrix(A)
+    slv.setup(m)
+    assert np.dtype(slv.Ad.dtype) == np.dtype(np.float32)   # Krylov rung
+    _, packs = _level_packs(slv)
+    assert any(np.dtype(p.dtype) == BF16 for p in packs
+               if p is not None)                            # bf16 rung
+    refine, wide, _ = slv._promotion_plan()
+    assert refine and wide == np.dtype(np.float64)          # f64 rung
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert _true_relres(A, b, res.x) <= 1e-12
+
+
+def test_krylov_dtype_knob_sets_toplevel_pack():
+    """``krylov_dtype`` is the top-level solver's device/monitoring
+    precision; it never forces the nested hierarchy wider."""
+    A = poisson7pt(8, 8, 8)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        PCG_AMG.format(tol="1e-5") + ", krylov_dtype=float32"))
+    slv.setup(amgx.Matrix(A))
+    assert np.dtype(slv.Ad.dtype) == np.dtype(np.float32)
+    res = slv.solve(np.ones(A.shape[0]))
+    assert res.status == SolveStatus.SUCCESS
+
+
+# ------------------------------------------------------------ multi-RHS rung
+def test_multi_rhs_bf16_rung_stays_batched():
+    """Satellite 6: a bf16-pack multi-RHS batch rides the vmapped
+    refined executable (per-lane ladders, one device call) instead of
+    the sequential fallback; every lane converges honestly."""
+    A = poisson7pt(12, 12, 12)
+    m = amgx.Matrix(A)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        PCG_AMG.format(tol="1e-5") + ", krylov_dtype=bfloat16"))
+    slv.setup(m)
+    assert np.dtype(slv.Ad.dtype) == BF16
+    rng = np.random.default_rng(3)
+    B = [rng.standard_normal(A.shape[0]) for _ in range(4)]
+    results = slv.solve_multi(B)
+    assert slv._solve_multi_refined is not None     # batched rung bound
+    assert slv._solve_multi is None                 # not the plain path
+    for bj, r in zip(B, results):
+        assert r.status == SolveStatus.SUCCESS
+        assert _true_relres(A, bj, r.x) <= 1.5e-5
+        assert int(r.iterations) > 0
+
+
+def test_multi_rhs_f64_rung_keeps_sequential_fallback():
+    """The f32 → f64 rung keeps the sequential fallback (emulated-f64
+    SpMVs under vmap blow past sane executable sizes)."""
+    A = poisson7pt(8, 8, 8)
+    m = amgx.Matrix(A)
+    m.device_dtype = np.float32
+    slv = amgx.create_solver(amgx.AMGConfig(PCG_AMG.format(tol="1e-9")))
+    slv.setup(m)
+    refine, wide, _ = slv._promotion_plan()
+    assert refine and wide == np.dtype(np.float64)
+    B = [np.ones(A.shape[0]), np.arange(A.shape[0], dtype=np.float64)]
+    results = slv.solve_multi(B)
+    assert slv._solve_multi_refined is None
+    for bj, r in zip(B, results):
+        assert r.status == SolveStatus.SUCCESS
+        assert _true_relres(A, bj, r.x) <= 1e-9
+
+
+# --------------------------------------------------------------- resetup
+def test_bf16_resetup_values_only_zero_retrace():
+    """Acceptance: values-only resetup of a bf16 hierarchy stays
+    zero-retrace/zero-recompile (jax.monitoring counters) and the
+    refreshed values actually land in the narrowed packs."""
+    A = sp.csr_matrix(poisson7pt(10, 10, 10))
+    m = amgx.Matrix(A)
+    m.device_dtype = np.float32
+    cfg = amgx.AMGConfig(
+        PCG_AMG.format(tol="1e-5")
+        + ", amg:hierarchy_dtype=bfloat16, amg:structure_reuse_levels=-1")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    x0 = np.asarray(slv.solve(b).x, np.float64)
+
+    def refreshed(scale):
+        m2 = amgx.Matrix(A)
+        m2.device_dtype = np.float32
+        m2.replace_coefficients(A.data * scale)
+        return m2
+
+    slv.resetup(refreshed(2.0))       # warm: refresh fns trace once
+    slv.solve(b)
+    with telemetry.capture() as cap:
+        slv.resetup(refreshed(3.0))
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    _, packs = _level_packs(slv)
+    assert all(np.dtype(p.dtype) == BF16 for p in packs if p is not None)
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    x = np.asarray(res.x, np.float64)
+    np.testing.assert_allclose(x, x0 / 3.0, rtol=1e-4, atol=1e-8)
+
+
+# --------------------------------------------------------------- telemetry
+def test_level_cost_events_carry_dtype(tmp_path):
+    """The cost-model events are dtype-labeled (the doctor's
+    bf16-vs-f32 bandwidth accounting input) and schema-valid."""
+    from amgx_tpu.telemetry.export import validate_record
+    path = str(tmp_path / "t.jsonl")
+    A = poisson7pt(10, 10, 10)
+    m = amgx.Matrix(A)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        PCG_AMG.format(tol="1e-5")
+        + ", amg:hierarchy_dtype=bfloat16, out:telemetry=1, "
+        f"out:telemetry_path={path}"))
+    slv.setup(m)
+    slv.solve(np.ones(A.shape[0]))
+    telemetry.flush_jsonl(path)
+    import json
+    levels = {}
+    with open(path) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") == "event" and \
+                    rec.get("name") in ("level_cost", "op_cost",
+                                        "operator_cost"):
+                validate_record(rec)
+                if rec["name"] == "level_cost":
+                    levels[rec["attrs"]["level"]] = rec["attrs"]
+    assert levels, "no level_cost events captured"
+    assert any(a.get("dtype") == "bfloat16" for a in levels.values())
+    assert all(isinstance(a.get("itemsize"), int) for a in levels.values())
+
+
+def test_doctor_mixed_precision_hint(tmp_path):
+    """An all-f32 multi-level hierarchy on bandwidth-class packs earns
+    the 'try mixed_precision' hint; a bf16 one does not."""
+    from amgx_tpu.telemetry import doctor
+
+    def trace_with(dtype, path):
+        with telemetry.capture() as cap:
+            for lvl in range(3):
+                telemetry.event(
+                    "level_cost", level=lvl, pack="dia", fmt="dia",
+                    dtype=dtype, itemsize=4 if dtype == "float32" else 2,
+                    estimated=False, rows=1000 >> lvl, nnz=7000 >> lvl,
+                    bytes_per_apply=int(56000 >> lvl),
+                    flops_per_apply=int(14000 >> lvl),
+                    padding_waste=1.0)
+        telemetry.dump_jsonl(path, cap.records)
+
+    f32 = str(tmp_path / "f32.jsonl")
+    trace_with("float32", f32)
+    d = doctor.diagnose([f32])
+    assert any("hierarchy_dtype=bfloat16" in h for h in d["hints"])
+
+    bf = str(tmp_path / "bf16.jsonl")
+    trace_with("bfloat16", bf)
+    d2 = doctor.diagnose([bf])
+    assert not any("hierarchy_dtype=bfloat16" in h for h in d2["hints"])
