@@ -1019,12 +1019,44 @@ def AMGX_serve_endpoint(srv: ServiceHandle, port: int = None):
     return srv.service.endpoint
 
 
+@_catches(1)
+def AMGX_serve_health(srv: ServiceHandle):
+    """The lane-aware liveness snapshot ``/healthz`` serves: aggregate
+    queue/SLO state, ``overloaded`` (true only when EVERY executor
+    lane is saturated — the LB eviction trip wire), and per-lane
+    health entries naming the saturated subset."""
+    return srv.service.health()
+
+
 @_catches()
 def AMGX_serve_drain(srv: ServiceHandle, timeout: float = None):
-    """Stop admission and flush every queued request (new submissions
-    reject with ``RC.REJECTED`` until re-created)."""
+    """Stop admission and flush every queued request on every lane
+    CONCURRENTLY (new submissions reject with ``RC.REJECTED`` until
+    re-created).  On timeout the error message names the wedged
+    lane(s); the per-lane report stays readable via
+    ``AMGX_serve_stats()['last_drain']``."""
     if not srv.service.drain(timeout):
-        raise AMGXError("serve drain timed out", RC.UNKNOWN)
+        stuck = [str(r["lane"]) for r
+                 in (srv.service.last_drain or {}).get("lanes", [])
+                 if not r.get("ok")]
+        raise AMGXError("serve drain timed out on lane(s) "
+                        + (",".join(stuck) or "?"), RC.UNKNOWN)
+
+
+@_catches(1)
+def AMGX_serve_drain_lane(srv: ServiceHandle, lane: int,
+                          timeout: float = None):
+    """Drain ONE executor lane while the service keeps serving (the
+    chip-eviction path: the router re-routes the lane's patterns).
+    Returns the lane's drain report; ``AMGX_serve_resume_lane``
+    reopens it."""
+    return srv.service.drain_lane(int(lane), timeout)
+
+
+@_catches()
+def AMGX_serve_resume_lane(srv: ServiceHandle, lane: int):
+    """Reopen a drained executor lane for admission."""
+    srv.service.resume_lane(int(lane))
 
 
 @_catches()

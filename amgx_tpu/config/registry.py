@@ -356,6 +356,23 @@ def register_default_parameters():
       "setup-cache byte budget bounding resident hierarchies")
     R("serve_deadline_ms", float, 0.0,
       "default per-request deadline in ms; 0 disables deadlines")
+    # multi-device scale-out (serve/router.py): per-device executor
+    # lanes with pattern-affinity routing, hot-pattern replication and
+    # cold-pattern work stealing.  serve_lanes=1 keeps the single-lane
+    # service; queue_depth/workers knobs above apply PER LANE, the
+    # cache byte budget is sliced evenly across lanes
+    R("serve_lanes", int, 1,
+      "executor lanes (one bounded queue + dispatcher + worker pool + "
+      "setup-cache slice per lane, lane i pinned to visible device i); "
+      "0 = one lane per visible device")
+    R("serve_replicate_frac", float, 0.75,
+      "home-lane queue fraction at which a hot pattern replicates onto "
+      "an idle lane (its session is rebuilt there; the shared AOT/"
+      "compile caches keep the replica's compile cost at zero)")
+    R("serve_steal_frac", float, 0.5,
+      "queue fraction under which a lane counts as idle (replication "
+      "target) and over which a cold pattern's hash-home is skipped "
+      "for the least-loaded lane (the work steal)")
     # zero cold-start (utils/jaxcompat.py + serve/aot.py): persistent
     # XLA compile cache + AOT executable store, so a fresh process
     # serves its first request without paying compilation.  Both knobs

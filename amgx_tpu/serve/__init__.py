@@ -16,9 +16,15 @@ inference server uses:
   micro-batch assembly: same-operator requests stack into one
   multi-RHS ``Solver.solve_multi`` executable, per-request convergence
   split back out;
-* :mod:`.service` — :class:`SolveService`: bounded-queue admission
-  (full ⇒ :data:`~amgx_tpu.errors.RC.REJECTED`), a batching dispatcher,
-  ``ThreadManager`` workers, per-request deadlines, graceful drain, and
+* :mod:`.router` — multi-device scale-out: one :class:`ExecutorLane`
+  per visible device (own queue, dispatcher, worker pool, setup-cache
+  slice, SLO window) behind a :class:`PatternRouter` doing
+  pattern-affinity routing, hot-pattern replication and cold-pattern
+  work stealing;
+* :mod:`.service` — :class:`SolveService`: bounded per-lane admission
+  (full ⇒ :data:`~amgx_tpu.errors.RC.REJECTED`), per-lane batching
+  dispatchers, ``ThreadManager`` workers, per-request deadlines,
+  concurrent graceful drain (whole service or one chip), and
   :meth:`SolveService.warmup` — the bucket-ladder prefetch that makes a
   fresh process request-ready off the request path;
 * :mod:`.aot` — :class:`AOTStore`: serialized XLA executables shared
@@ -40,11 +46,14 @@ from . import aot
 from .aot import AOTStore
 from .batch import PendingSolve, SolveRequest, split_batches
 from .cache import SetupCache
+from .router import ExecutorLane, PatternRouter
 from .service import SolveService
-from .session import SessionKey, SolverSession, config_hash, session_key
+from .session import (SessionKey, SolverSession, config_hash,
+                      placement_view, session_key)
 
 __all__ = [
     "SolveService", "SetupCache", "SolverSession", "SessionKey",
     "SolveRequest", "PendingSolve", "split_batches", "config_hash",
-    "session_key", "aot", "AOTStore",
+    "session_key", "placement_view", "aot", "AOTStore",
+    "ExecutorLane", "PatternRouter",
 ]

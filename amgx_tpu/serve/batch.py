@@ -91,6 +91,11 @@ class SolveRequest:
     #: expired-vs-rejected distinction must not hang off the free-text
     #: error message (outcome() classifies on this flag)
     deadline_shed: bool = False
+    #: executor lane index the router assigned (multi-device serving;
+    #: None for requests rejected before routing) and the routing
+    #: decision that placed it (affinity|cold|steal|replicate|overflow)
+    lane: Optional[int] = None
+    route: Optional[str] = None
 
     def __post_init__(self):
         if not self.marks:

@@ -45,8 +45,12 @@ def cache_totals() -> dict:
 
 
 class SetupCache:
-    def __init__(self, max_bytes: int = 1 << 30):
+    def __init__(self, max_bytes: int = 1 << 30, placement=None):
         self.max_bytes = int(max_bytes)
+        #: jax.Device sessions created by this cache pin to (multi-lane
+        #: serving: each lane's cache slice builds lane-resident
+        #: hierarchies); None = process default device
+        self.placement = placement
         self._lock = threading.Lock()
         self._sessions: "collections.OrderedDict[SessionKey, SolverSession]" \
             = collections.OrderedDict()
@@ -74,7 +78,7 @@ class SetupCache:
             self.misses += 1
             _totals_inc("misses")
             telemetry.counter_inc("amgx_serve_cache_misses_total")
-            s = SolverSession(key, cfg)
+            s = SolverSession(key, cfg, placement=self.placement)
             self._sessions[key] = s
             return s, True
 

@@ -7,11 +7,18 @@ the offered rate regardless of completions (the standard SLO
 methodology), so queueing delay, deadline sheds and admission
 rejections show up exactly as a production client would see them.
 
-Traffic shape: each arrival picks one of the given operator patterns
-(uniformly) and, with ``multi_rhs_frac`` probability, carries a burst
-of 2..``max_rhs`` same-operator right-hand sides submitted
-back-to-back — the shape the micro-batcher
-(:func:`~amgx_tpu.serve.batch.split_batches`) exists to exploit.
+Traffic shape: each arrival picks one of the given operator patterns —
+uniformly by default, or Zipf-skewed by rank with ``skew`` > 0
+(weight ∝ 1/(rank+1)^skew, first pattern hottest), the hot-key
+distribution real fleets see and the shape that actually exercises the
+multi-lane router's affinity/replication policy (uniform traffic never
+saturates one lane while another idles) — and, with
+``multi_rhs_frac`` probability, carries a burst of 2..``max_rhs``
+same-operator right-hand sides submitted back-to-back — the shape the
+micro-batcher (:func:`~amgx_tpu.serve.batch.split_batches`) exists to
+exploit.  The output JSON reports the per-pattern hit distribution
+(offered requests per pattern) so a skewed run is verifiable, plus the
+per-lane/router picture when the service runs more than one lane.
 
 Reported numbers: offered/accepted/rejected/completed counts, the
 rejection rate, p50/p95/p99 of request latency (submit → result,
@@ -37,6 +44,7 @@ from .service import SolveService
 def run_load(service: SolveService, patterns: Sequence, *,
              rps: float = 20.0, duration_s: float = 2.0,
              multi_rhs_frac: float = 0.25, max_rhs: int = 4,
+             skew: float = 0.0,
              seed: int = 0, wait_timeout_s: float = 300.0) -> dict:
     """Drive ``service`` with open-loop Poisson arrivals over
     ``patterns`` (prepared :class:`~amgx_tpu.core.matrix.Matrix`
@@ -58,9 +66,18 @@ def run_load(service: SolveService, patterns: Sequence, *,
         t += float(rng.exponential(1.0 / max(rps, 1e-9)))
         if t < duration_s:
             arrivals.append(t)
+    # pattern popularity: uniform at skew=0, Zipf-by-rank otherwise
+    # (weight of the i-th given pattern ∝ 1/(i+1)^skew) — hot-key
+    # traffic is what drives one lane to saturation while another
+    # idles, i.e. what the router's replication threshold is FOR
+    w = np.power(np.arange(1, len(patterns) + 1, dtype=float),
+                 -max(float(skew), 0.0))
+    w /= w.sum()
     plan = []
+    hits = np.zeros(len(patterns), dtype=int)
     for _ in arrivals:
-        pi = int(rng.integers(len(patterns)))
+        pi = int(rng.choice(len(patterns), p=w))
+        hits[pi] += 1
         k = int(rng.integers(2, max_rhs + 1)) \
             if max_rhs >= 2 and rng.random() < multi_rhs_frac else 1
         plan.append((pi, rng.standard_normal((k, sizes[pi]))))
@@ -106,12 +123,43 @@ def run_load(service: SolveService, patterns: Sequence, *,
     def ms(v):
         return round(v * 1e3, 2) if isinstance(v, (int, float)) else None
 
+    total_hits = max(int(hits.sum()), 1)
+    # the per-lane/router picture of a multi-lane service: aggregate
+    # throughput in lane count, the steal/replication traffic, and
+    # each lane's completed/stolen split — the scale-out proof numbers
+    lanes_block = None
+    if len(service.lanes) > 1:
+        lane_stats = [lane.stats() for lane in service.lanes]
+        rt = service.router.stats()
+        routed = sum(rt["decisions"].values()) or 1
+        lanes_block = {
+            "lanes": len(service.lanes),
+            "per_lane": [{k: s[k] for k in
+                          ("lane", "completed", "rejected",
+                           "stolen_in", "sessions", "overloaded")}
+                         for s in lane_stats],
+            "steals": rt["steals"],
+            "replications": rt["replications"],
+            "steal_frac_of_routed": round(rt["steals"] / routed, 4),
+            "replicated_patterns": rt["replicated_patterns"],
+            "sessions_by_lane": rt["sessions_by_lane"],
+        }
+
     return {
         "offered": offered,
         "offered_rps": round(offered / duration_s, 1),
         "duration_s": round(duration_s, 3),
         "patterns": len(patterns),
         "multi_rhs_frac": multi_rhs_frac,
+        "skew": float(skew),
+        #: arrivals per given pattern (a multi-RHS burst counts once)
+        #: — the verifiable popularity distribution
+        "pattern_hits": [
+            {"pattern": m.pattern_fingerprint()[:12],
+             "requests": int(h),
+             "frac": round(int(h) / total_hits, 4)}
+            for m, h in zip(patterns, hits)],
+        "lanes": lanes_block,
         "completed": completed,
         "rejected": rejected,
         "failed": failed,

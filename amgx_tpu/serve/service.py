@@ -1,25 +1,37 @@
-"""The concurrent solve service: admission → batching → execution.
+"""The concurrent solve service: admission → routing → lane execution.
 
 :class:`SolveService` accepts many ``(matrix, b)`` requests and executes
-them efficiently on one device, the same playbook an inference server
-uses:
+them efficiently across every visible device, the same playbook an
+inference server uses:
 
-* **admission**: a bounded queue; a full queue sheds load immediately
-  with :data:`RC.REJECTED` (the documented backpressure contract) —
-  queueing unboundedly would trade a fast "no" for a slow timeout.
-  Optional per-request deadlines reject work whose answer nobody is
-  waiting for anymore.
-* **batching**: a dispatcher thread drains the queue, groups requests
-  by (config, pattern, values) within ``serve_batch_window_ms``, and
-  hands micro-batches to the worker pool
-  (:func:`~amgx_tpu.serve.batch.split_batches`).
-* **execution**: ``utils.thread_manager.ThreadManager`` workers run
-  each batch — session prepare (full setup / resetup / reuse via the
-  pattern-keyed :class:`~amgx_tpu.serve.cache.SetupCache`) then the
-  stacked multi-RHS solve.  Distinct sessions solve concurrently;
-  one session's requests serialise on its lock.
+* **admission**: per-lane bounded queues; a full lane sheds load
+  immediately with :data:`RC.REJECTED` (the documented backpressure
+  contract) — queueing unboundedly would trade a fast "no" for a slow
+  timeout.  Optional per-request deadlines reject work whose answer
+  nobody is waiting for anymore.
+* **routing** (multi-device scale-out, :mod:`~amgx_tpu.serve.router`):
+  one :class:`~amgx_tpu.serve.router.ExecutorLane` per visible device
+  (own queue, dispatcher, worker pool, setup-cache slice, SLO window),
+  fronted by a :class:`~amgx_tpu.serve.router.PatternRouter` that
+  (a) routes repeat traffic by pattern fingerprint to the lane holding
+  that session's hierarchy, (b) replicates hot patterns onto idle lanes
+  when the home lane saturates, and (c) work-steals cold patterns to
+  the least-loaded lane.  ``serve_lanes=1`` (the default) is the
+  single-device service of PRs 4–9, unchanged.
+* **batching**: each lane's dispatcher drains its queue, groups
+  requests by (config, pattern, values) within
+  ``serve_batch_window_ms``, and hands micro-batches to the lane's
+  worker pool (:func:`~amgx_tpu.serve.batch.split_batches`).
+* **execution**: lane workers run each batch — session prepare (full
+  setup / resetup / reuse via the lane's pattern-keyed
+  :class:`~amgx_tpu.serve.cache.SetupCache` slice) then the stacked
+  multi-RHS solve, pinned to the lane's device.  Distinct sessions
+  solve concurrently; one session's requests serialise on its lock.
 * **drain/shutdown**: :meth:`drain` stops admission and flushes every
-  queued request; :meth:`shutdown` additionally joins the pool.
+  lane CONCURRENTLY, surfacing per-lane timeouts (one wedged chip
+  must not hide the others' clean drain); :meth:`drain_lane` drains a
+  single chip while the service keeps serving (the router re-routes
+  its homed patterns); :meth:`shutdown` additionally joins the pools.
 
 All knobs come from the config (``serve_*`` parameters,
 config/registry.py) so C-shaped drivers configure the service exactly
@@ -36,10 +48,8 @@ from ..config import AMGConfig
 from ..core.matrix import Matrix
 from ..errors import RC
 from ..telemetry import slo as _slo
-from ..utils.thread_manager import ThreadManager
-from .batch import (PendingSolve, SolveRequest, execute_batch,
-                    split_batches)
-from .cache import SetupCache
+from .batch import PendingSolve, SolveRequest
+from .router import PatternRouter, build_lanes
 from .session import SessionKey, config_hash
 
 
@@ -56,24 +66,22 @@ class SolveService:
         #: the service's config never changes — hash it once, not per
         #: submit (the pattern fingerprint side is cached on the Matrix)
         self._cfg_hash = config_hash(cfg)
-        self.cache = SetupCache(int(g("serve_cache_bytes")))
-        self._tm = ThreadManager(max_workers=int(g("serve_workers")))
-        self._cond = threading.Condition()
-        self._queue: List[SolveRequest] = []
-        #: requests drained from the queue whose batch has not finished
-        #: (drain() must wait these out too — a request between queue
-        #: and worker would otherwise be invisible to it)
-        self._inflight = 0
+        #: per-device executor lanes + the affinity router in front of
+        #: them; serve_lanes=1 (default) is the single-device service
+        self.lanes = build_lanes(self, int(g("serve_lanes")),
+                                 int(g("serve_cache_bytes")))
+        self.router = PatternRouter(
+            self.lanes,
+            replicate_frac=float(g("serve_replicate_frac")),
+            steal_frac=float(g("serve_steal_frac")))
         self._accepting = False
-        self._running = False
-        self._dispatcher: Optional[threading.Thread] = None
         self._lat_lock = threading.Lock()
         self.submitted = 0
         self.rejected = 0
         self.completed = 0
-        #: the SLO reservoir replaces the old OK-only latency list:
-        #: EVERY terminal outcome lands here with its label, so shed
-        #: load can no longer flatter the percentiles (slo_* knobs)
+        #: the service-level SLO reservoir (every lane's terminal
+        #: outcomes land here AND in the owning lane's window): shed
+        #: load can never flatter the aggregate percentiles (slo_* knobs)
         self.slo = _slo.from_config(cfg)
         #: running per-phase sums (queue-wait vs solve split in
         #: stats()), keyed by the PHASE_OF_MARK vocabulary
@@ -83,6 +91,9 @@ class SolveService:
         self.profile_every = int(g("serve_profile_every"))
         self._batch_seq = 0
         self._profile: dict = {}         # pattern -> capture summary
+        #: per-lane report of the last drain()/drain_lane() —
+        #: {"ok": bool, "lanes": [{lane, ok, queued, inflight, ...}]}
+        self.last_drain: Optional[dict] = None
         #: observability endpoint (telemetry/httpd.py), started with
         #: the service when metrics_port > 0
         self.metrics_port = int(g("metrics_port"))
@@ -93,20 +104,45 @@ class SolveService:
         if start:
             self.start()
 
+    # --------------------------------------------- single-lane compat views
+    # The pre-scale-out service WAS its one lane; tests and embedders
+    # that reached into the queue/cache internals keep working against
+    # the primary lane (multi-lane callers use .lanes / stats()).
+    @property
+    def _cond(self):
+        return self.lanes[0]._cond
+
+    @property
+    def _queue(self):
+        return self.lanes[0]._queue
+
+    @property
+    def _inflight(self):
+        return self.lanes[0]._inflight
+
+    @_inflight.setter
+    def _inflight(self, v):
+        self.lanes[0]._inflight = v
+
+    @property
+    def cache(self):
+        """The primary lane's setup cache (single-lane compatibility
+        view; per-lane slices live on ``self.lanes[i].cache``)."""
+        return self.lanes[0].cache
+
     # ------------------------------------------------------------ lifecycle
     def start(self):
-        """Spawn the dispatcher + worker pool and open admission
-        (idempotent)."""
-        with self._cond:
+        """Spawn every lane's dispatcher + worker pool and open
+        admission.  Idempotent while running, and restartable after
+        :meth:`shutdown` — ``lane.start()`` guards on its own running
+        flag, so a stopped lane re-spawns while a live one is left
+        alone (the pre-scale-out service was restartable; queued
+        requests admitted between shutdown and restart must find a
+        dispatcher, not wait forever)."""
+        with self._lat_lock:
             self._accepting = True
-            if self._running:
-                return self
-            self._running = True
-        self._tm.spawn_threads()
-        self._dispatcher = threading.Thread(target=self._dispatch_loop,
-                                            name="amgx-serve-dispatch",
-                                            daemon=True)
-        self._dispatcher.start()
+        for lane in self.lanes:
+            lane.start()
         if self.metrics_port > 0 and self._endpoint is None:
             try:
                 self.start_endpoint(self.metrics_port)
@@ -138,31 +174,86 @@ class SolveService:
         return self._endpoint.url if self._endpoint is not None else None
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Stop admitting, flush every queued request, finish in-flight
-        batches.  Returns True when everything completed in time."""
-        with self._cond:
+        """Stop admitting, then flush every lane CONCURRENTLY — queued
+        requests and in-flight batches.  Returns True when every lane
+        completed in time; the per-lane breakdown (which chip timed
+        out, with how much stuck work) lands in :attr:`last_drain` and
+        a ``serve_drain`` telemetry event.  Draining lanes in sequence
+        would serialize the whole service on the first slow chip — a
+        wedged batch on lane 2 must not delay lane 5's clean drain by
+        its full timeout."""
+        with self._lat_lock:
             self._accepting = False
-            self._cond.notify_all()
-        t_end = None if timeout is None else time.monotonic() + timeout
-        with self._cond:
-            while self._queue or self._inflight:
-                left = None if t_end is None else t_end - time.monotonic()
-                if left is not None and left <= 0:
-                    return False
-                self._cond.wait(timeout=min(left or 0.05, 0.05))
-        self._tm.wait_threads()
-        return True
+        for lane in self.lanes:
+            with lane._cond:
+                lane._cond.notify_all()
+        reports: List[Optional[dict]] = [None] * len(self.lanes)
+
+        def _drain_one(i):
+            reports[i] = self.lanes[i].drain(timeout)
+
+        if len(self.lanes) == 1:
+            _drain_one(0)
+        else:
+            threads = [threading.Thread(target=_drain_one, args=(i,),
+                                        name=f"amgx-drain-lane{i}",
+                                        daemon=True)
+                       for i in range(len(self.lanes))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                # lane.drain() bounds itself by `timeout`; the extra
+                # join slack only covers scheduler lag, so a wedged
+                # lane reports a timeout instead of hanging the caller
+                t.join(timeout=None if timeout is None
+                       else timeout + 5.0)
+        ok = all(r is not None and r["ok"] for r in reports)
+        self.last_drain = {
+            "ok": ok,
+            "lanes": [r or {"lane": i, "ok": False, "queued": None,
+                            "inflight": None, "seconds": None}
+                      for i, r in enumerate(reports)],
+        }
+        if telemetry.is_enabled():
+            telemetry.event("serve_drain", ok=bool(ok),
+                            lanes=self.last_drain["lanes"])
+        if not ok:
+            import warnings
+            stuck = [f"lane {r['lane']} (queued={r['queued']}, "
+                     f"inflight={r['inflight']})"
+                     for r in self.last_drain["lanes"] if not r["ok"]]
+            warnings.warn("amgx serve: drain timed out on "
+                          + ", ".join(stuck))
+        return ok
+
+    def drain_lane(self, index: int,
+                   timeout: Optional[float] = None) -> dict:
+        """Drain ONE lane while the service keeps serving (the
+        chip-eviction path a load balancer's per-lane health view
+        enables): the lane stops accepting, the router re-routes its
+        homed patterns (a non-accepting lane reads as saturated, so
+        repeat traffic replicates or steals elsewhere), and its queued
+        work flushes.  Returns the lane's drain report.  Note:
+        mesh-sharded (dist) operators always execute on lane 0, so
+        draining lane 0 sheds dist traffic (reason ``draining``) until
+        :meth:`resume_lane`."""
+        lane = self.lanes[int(index)]
+        lane.accepting = False
+        with lane._cond:
+            lane._cond.notify_all()
+        report = lane.drain(timeout)
+        self.last_drain = {"ok": report["ok"], "lanes": [report]}
+        return report
+
+    def resume_lane(self, index: int):
+        """Reopen a drained lane for admission."""
+        self.lanes[int(index)].accepting = True
 
     def shutdown(self, timeout: Optional[float] = None) -> bool:
-        """Graceful stop: drain, stop the dispatcher, join workers."""
+        """Graceful stop: drain, stop every lane, join workers."""
         ok = self.drain(timeout)
-        with self._cond:
-            self._running = False
-            self._cond.notify_all()
-        if self._dispatcher is not None:
-            self._dispatcher.join(timeout=5.0)
-            self._dispatcher = None
-        self._tm.join_threads()
+        for lane in self.lanes:
+            lane.stop()
         with self._endpoint_lock:
             if self._endpoint is not None:
                 self._endpoint.stop()
@@ -179,18 +270,19 @@ class SolveService:
     # ------------------------------------------------------------ admission
     def submit(self, matrix: Matrix, b, x0=None,
                deadline_s: Optional[float] = None) -> PendingSolve:
-        """Queue one solve.  Never blocks: over capacity (or after
-        drain/shutdown) the returned handle is already completed with
-        ``rc == RC.REJECTED`` — the backpressure signal callers must
-        check before waiting."""
+        """Queue one solve.  Never blocks: with the routed lane over
+        capacity (or after drain/shutdown) the returned handle is
+        already completed with ``rc == RC.REJECTED`` — the backpressure
+        signal callers must check before waiting."""
         ddl = deadline_s if deadline_s is not None \
             else (self.default_deadline_s or None)
         now = time.monotonic()
+        values_fp = matrix.values_fingerprint()
         req = SolveRequest(
             matrix=matrix, b=b, x0=x0,
             key=SessionKey(config=self._cfg_hash,
                            pattern=matrix.pattern_fingerprint()),
-            values_fp=matrix.values_fingerprint(),
+            values_fp=values_fp,
             submitted_t=now,
             deadline_t=(now + ddl) if ddl else None,
             # terminal accounting (SLO window, phase fold, trace event)
@@ -198,44 +290,71 @@ class SolveService:
             # that wakes from wait() and immediately snapshots the SLO
             # window must see this request counted
             on_terminal=self._finalize)
-        with self._cond:
-            # admission counts OUTSTANDING work — queued AND drained-but-
-            # unfinished — against the capacity: the dispatcher empties
-            # the queue every window, so len(queue) alone would let a
-            # sustained overload pile unbounded work into the pool
-            outstanding = len(self._queue) + self._inflight
-            accepting = self._accepting
-            reject = not accepting or outstanding >= self.queue_depth
-            if not reject:
-                req.mark("admitted")
-                self._queue.append(req)
-                telemetry.gauge_set("amgx_serve_queue_depth",
-                                    len(self._queue))
-                self._cond.notify_all()
+        reject_reason = None
+        if not self._accepting:
+            reject_reason = "draining"
+        else:
+            if matrix.dist is not None and len(self.lanes) > 1:
+                # a mesh-sharded operator owns EVERY device already —
+                # lane placement is meaningless, so it always executes
+                # on the primary lane (note: drain_lane(0) therefore
+                # drains dist traffic too)
+                lane_idx, decision = 0, "affinity"
+            else:
+                lane_idx, decision = self.router.route(req.key.pattern,
+                                                       values_fp)
+            req.lane, req.route = lane_idx, decision
+            if not self.lanes[lane_idx].try_admit(req):
+                # a non-accepting lane is DRAINING, not full — the two
+                # shed reasons steer different operator responses
+                # (add capacity vs finish the eviction)
+                reject_reason = "queue_full" \
+                    if self.lanes[lane_idx].accepting else "draining"
         # counters live under ONE lock (_lat_lock, shared with the
         # worker-side completion/deadline accounting) so concurrent
         # admission and deadline sheds never lose an increment
-        if reject:
-            reason = "queue_full" if accepting else "draining"
+        if reject_reason is not None:
             with self._lat_lock:
                 self.rejected += 1
             telemetry.counter_inc("amgx_serve_rejected_total",
-                                  reason=reason)
+                                  reason=reject_reason)
             telemetry.counter_inc("amgx_serve_requests_total",
                                   status="REJECTED")
             req.complete(None, rc=RC.REJECTED,
-                         error=f"admission rejected: {reason}")
+                         error=f"admission rejected: {reject_reason}")
             return PendingSolve(req)
         with self._lat_lock:
             self.submitted += 1
         return PendingSolve(req)
 
+    def _refresh_queue_gauges(self):
+        """Service-wide queue/inflight gauges = sums over lanes (the
+        per-lane series carry the split).  Called from lane dispatch/
+        completion transitions — NOT per submit: the submit hot path
+        already pays one lane-lock sweep in the router's load read, and
+        a second sweep per accepted request would contend with every
+        dispatcher for the locks the per-lane design exists to keep
+        apart."""
+        if not telemetry.is_enabled():
+            return
+        depth, inflight = self._totals()
+        telemetry.gauge_set("amgx_serve_queue_depth", depth)
+        telemetry.gauge_set("amgx_serve_inflight", inflight)
+
+    def _take_profile_slot(self) -> bool:
+        """One shared sampling sequence across lanes: every Nth served
+        batch, whichever lane runs it (serve_profile_every)."""
+        with self._lat_lock:
+            self._batch_seq += 1
+            return self.profile_every > 0 and \
+                self._batch_seq % self.profile_every == 0
+
     # ------------------------------------------------- request finalization
     def _finalize(self, req: SolveRequest):
         """Terminal accounting of ONE request, whatever its outcome:
-        feed the SLO window, fold the phase split, and emit the
-        schema-validated ``request_trace`` event + per-phase
-        histograms.  Runs exactly once per request, inside
+        feed the service AND lane SLO windows, fold the phase split,
+        and emit the schema-validated ``request_trace`` event +
+        per-phase histograms.  Runs exactly once per request, inside
         ``SolveRequest.complete`` (the ``on_terminal`` hook) BEFORE the
         waiter event is set — a client that wakes from ``wait()`` and
         immediately snapshots the SLO window sees every finished
@@ -246,6 +365,9 @@ class SolveService:
             req.completed_mono is not None
             and req.completed_mono <= req.deadline_t)
         self.slo.record(latency, outcome, deadline_met=deadline_met)
+        if req.lane is not None and req.lane < len(self.lanes):
+            self.lanes[req.lane].slo.record(latency, outcome,
+                                            deadline_met=deadline_met)
         # admission rejections never entered the lifecycle — their only
         # post-submit mark is "done", and folding that micro-gap into
         # the finalize phase would corrupt the split exactly when it
@@ -267,6 +389,10 @@ class SolveService:
                 latency_s=round(latency, 6),
                 deadline_met=bool(deadline_met),
                 pattern=req.key.pattern[:12],
+                # the executor lane that served it + the router's
+                # decision (affinity|cold|steal|replicate|overflow) —
+                # the multi-lane trace dimension
+                lane=req.lane, route=req.route,
                 # "phases" speaks the DOCUMENTED phase vocabulary
                 # (admit|queue_wait|...|finalize — what the histogram
                 # labels and README teach); "marks" keeps the raw
@@ -289,22 +415,32 @@ class SolveService:
         return res
 
     # -------------------------------------------------------------- warmup
-    def warmup(self, patterns, max_batch: Optional[int] = None) -> dict:
+    def warmup(self, patterns, max_batch: Optional[int] = None,
+               all_lanes: bool = False) -> dict:
         """Prefetch the executables a request wave would otherwise pay
-        for, OFF the request path: for each operator pattern, prepare
-        its session (full setup — hierarchy, packs, setup-plan
-        executables) and compile the solve bodies for the power-of-two
-        batch-bucket ladder (1, 2, 4, … ``serve_warmup_max_batch`` or
-        ``serve_max_batch``).  With ``compile_cache_dir`` /
-        ``aot_store_dir`` configured this both *loads* whatever a
-        previous process persisted and *persists* whatever it still had
-        to compile — the first warmed process pays the compiles once,
-        every later process starts in milliseconds.
+        for, OFF the request path: each operator pattern is ROUTED
+        (assigning its home lane — a warmup over the expected pattern
+        set pre-distributes the fleet across lanes), its session
+        prepared on that lane (full setup — hierarchy, packs,
+        setup-plan executables) and the solve bodies compiled for the
+        power-of-two batch-bucket ladder (1, 2, 4, …
+        ``serve_warmup_max_batch`` or ``serve_max_batch``).  With
+        ``compile_cache_dir`` / ``aot_store_dir`` configured this both
+        *loads* whatever a previous process persisted and *persists*
+        whatever it still had to compile — the first warmed process
+        pays the compiles once, every later process starts in
+        milliseconds.
 
         ``patterns``: one :class:`~amgx_tpu.core.matrix.Matrix` or an
         iterable of them (one per distinct sparsity pattern the service
-        expects).  Returns a summary dict; also emitted as a
-        ``serve_warmup`` telemetry event."""
+        expects).  ``all_lanes=True`` additionally warms every pattern
+        on EVERY lane (not just its routed home) — the pre-replication
+        mode for fleets that expect hot-key traffic: a later
+        replication decision finds the replica session already
+        resident, so shifting a hot pattern onto an idle chip costs a
+        routing-table append instead of a mid-wave setup+compile.
+        Returns a summary dict; also emitted as a ``serve_warmup``
+        telemetry event."""
         import numpy as np
         if isinstance(patterns, Matrix):
             patterns = [patterns]
@@ -320,18 +456,32 @@ class SolveService:
         t0 = time.monotonic()
         details = []
         for m in patterns:
-            sess, _created = self.cache.get_or_create(self.cfg, m)
-            with sess.lock:
-                kind = sess.prepare(m)
-                n = int(m.shape[0])
-                for w in ladder:
-                    # zero RHS converge at iteration 0 — the while_loop
-                    # body still traces/compiles for this bucket width
-                    # (w == 1 compiles the single-RHS solve body)
-                    sess.solver.solve_multi(np.zeros((w, n)))
-            self.cache.account(sess)
-            details.append({"pattern": sess.key.pattern,
-                            "prepare": kind})
+            pattern = m.pattern_fingerprint()
+            if m.dist is not None and len(self.lanes) > 1:
+                lane_idx = 0
+            else:
+                # routing first assigns the HOME lane — a warmup over
+                # the expected pattern set pre-distributes the fleet
+                lane_idx, _ = self.router.route(
+                    pattern, m.values_fingerprint())
+            key = SessionKey(config=self._cfg_hash, pattern=pattern)
+            lane_set = self.lanes if (all_lanes and m.dist is None) \
+                else [self.lanes[lane_idx]]
+            for lane in lane_set:
+                sess, _created = lane.cache.get_or_create(self.cfg, m,
+                                                          key=key)
+                with sess.lock:
+                    kind = sess.prepare(m)
+                    n = int(m.shape[0])
+                    for w in ladder:
+                        # zero RHS converge at iteration 0 — the
+                        # while_loop body still traces/compiles for
+                        # this bucket width (w == 1 compiles the
+                        # single-RHS solve body)
+                        sess.solve_batch(np.zeros((w, n)))
+                lane.cache.account(sess)
+                details.append({"pattern": sess.key.pattern,
+                                "lane": lane.index, "prepare": kind})
         wall = time.monotonic() - t0
         from . import aot
         summary = {"patterns": len(details), "buckets": ladder,
@@ -341,73 +491,6 @@ class SolveService:
                         buckets=len(ladder), seconds=wall)
         telemetry.hist_observe("amgx_serve_warmup_seconds", wall)
         return summary
-
-    # ------------------------------------------------------------- dispatch
-    def _dispatch_loop(self):
-        while True:
-            with self._cond:
-                while self._running and not self._queue:
-                    self._cond.wait(timeout=0.05)
-                if not self._running and not self._queue:
-                    return
-                if not self._queue:
-                    continue
-                # batching window: once work exists, wait a beat for
-                # same-operator companions to arrive (skipped when the
-                # queue already holds a full batch)
-                if self.batch_window_s > 0 and \
-                        len(self._queue) < self.max_batch:
-                    self._cond.wait(timeout=self.batch_window_s)
-                drained, self._queue = self._queue, []
-                self._inflight += len(drained)
-                telemetry.gauge_set("amgx_serve_queue_depth", 0)
-                telemetry.gauge_set("amgx_serve_inflight",
-                                    self._inflight)
-            for batch in split_batches(drained, self.max_batch):
-                self._tm.push_work(self._batch_task(batch))
-
-    def _batch_task(self, batch: List[SolveRequest]):
-        with self._lat_lock:
-            self._batch_seq += 1
-            profile = self.profile_every > 0 and \
-                self._batch_seq % self.profile_every == 0
-
-        def run():
-            session = None
-            try:
-                session, _created = self.cache.get_or_create(
-                    self.cfg, batch[0].matrix, key=batch[0].key)
-                execute_batch(session, batch, cache=self.cache)
-                with self._lat_lock:
-                    self.completed += sum(1 for r in batch
-                                          if r.rc == RC.OK)
-                    # deadline sheds happen here, past admission — they
-                    # must show in stats() like any other rejection
-                    self.rejected += sum(1 for r in batch
-                                         if r.rc == RC.REJECTED)
-                if profile:
-                    self._profile_batch(session, batch)
-            except Exception as e:    # noqa: BLE001 — swallowed ON PURPOSE:
-                # the failure is delivered through the request handles
-                # below; letting it reach the future would make a later
-                # drain()'s wait_threads() re-raise it mid-shutdown
-                msg = f"{type(e).__name__}: {e}"
-                for r in batch:
-                    if not r.done():
-                        r.mark("errored")
-                        r.complete(None, rc=RC.UNKNOWN, error=msg)
-            finally:
-                for r in batch:
-                    if not r.done():     # belt-and-braces: no waiter hangs
-                        r.mark("errored")
-                        r.complete(None, rc=RC.UNKNOWN,
-                                   error="batch task failed")
-                with self._cond:
-                    self._inflight -= len(batch)
-                    telemetry.gauge_set("amgx_serve_inflight",
-                                        self._inflight)
-                    self._cond.notify_all()
-        return run
 
     def _profile_batch(self, session, batch: List[SolveRequest]):
         """Sampled solve-path profiling (``serve_profile_every``): the
@@ -465,10 +548,12 @@ class SolveService:
         return self.slo.percentiles()
 
     def reset_latency_stats(self):
-        """Drop the SLO window + phase split (benchmark warm-up:
-        separate the compile-heavy first requests from steady-state
-        numbers)."""
+        """Drop the SLO windows (service + lanes) + phase split
+        (benchmark warm-up: separate the compile-heavy first requests
+        from steady-state numbers)."""
         self.slo.reset()
+        for lane in self.lanes:
+            lane.slo.reset()
         with self._lat_lock:
             self._phase_totals.clear()
 
@@ -483,42 +568,79 @@ class SolveService:
                     for phase, (n, tot)
                     in sorted(self._phase_totals.items())}
 
+    def _totals(self):
+        depth = inflight = 0
+        for lane in self.lanes:
+            with lane._cond:
+                depth += len(lane._queue)
+                inflight += lane._inflight
+        return depth, inflight
+
     def health(self) -> dict:
-        """The liveness surface ``/healthz`` serves: queue +
-        in-flight + SLO overload state, one window pass per poll.
-        The trip wire's capacity leg counts OUTSTANDING work (queued +
-        in-flight) — the dispatcher drains the queue every batch
-        window, so under overload the backlog lives in-flight and the
-        raw queue depth alone would never trip.  Calling this also
-        refreshes the ``amgx_slo_*`` gauges (the /metrics scrape
-        path)."""
-        with self._cond:
-            depth = len(self._queue)
-            inflight = self._inflight
-            accepting = self._accepting
+        """The liveness surface ``/healthz`` serves, lane-aware: the
+        aggregate queue/in-flight/SLO state plus EVERY lane's own
+        health leg.  ``overloaded`` — the 503 trip wire — is true only
+        when **all** lanes are saturated: with a healthy lane left, the
+        router still has somewhere to steal/replicate to, so evicting
+        the whole instance would throw away working capacity.  The
+        per-lane entries name the saturated subset so a load balancer
+        (or an operator via :meth:`drain_lane`) can drain one chip.
+        Calling this also refreshes the ``amgx_slo_*`` and per-lane
+        gauges (the /metrics scrape path)."""
+        lane_health = [lane.health() for lane in self.lanes]
+        depth = sum(h["queue_depth"] for h in lane_health)
+        inflight = sum(h["inflight"] for h in lane_health)
         # emit_event=False: health/scrape polls refresh the gauges but
         # must not append slo_window events to the bounded ring at the
         # poller's rate (stats() keeps emitting them)
         snap = self.slo.snapshot(queue_depth=depth + inflight,
-                                 queue_capacity=self.queue_depth,
+                                 queue_capacity=self.queue_depth
+                                 * len(self.lanes),
                                  emit_event=False,
                                  include_percentiles=False)
+        saturated = [h["lane"] for h in lane_health if h["overloaded"]]
         return {
             "ok": True,
-            "accepting": accepting,
+            "accepting": self._accepting,
             "queue_depth": depth,
-            "queue_capacity": self.queue_depth,
+            "queue_capacity": self.queue_depth * len(self.lanes),
             "inflight": inflight,
-            "workers": self._tm._max_workers,
-            "overloaded": snap["overloaded"],
+            "workers": sum(lane._tm._max_workers or 0
+                           for lane in self.lanes),
+            # every lane saturated = nowhere left to route = evict me
+            "overloaded": bool(saturated)
+            and len(saturated) == len(self.lanes),
+            "lanes_total": len(self.lanes),
+            "lanes_overloaded": len(saturated),
+            "saturated_lanes": saturated,
+            "lanes": lane_health,
             "slo_attainment": snap["attainment"],
             "slo_burn_rate": snap["burn_rate"],
         }
 
+    def _cache_stats(self) -> dict:
+        """Aggregate setup-cache picture: the single-lane shape (PR 4's
+        stats contract) with per-lane sums; ``by_session`` entries gain
+        a ``lane`` field in multi-lane services."""
+        if len(self.lanes) == 1:
+            return self.lanes[0].cache.stats()
+        per = [lane.cache.stats() for lane in self.lanes]
+        by_session = []
+        for lane, st in zip(self.lanes, per):
+            for s in st["by_session"]:
+                by_session.append(dict(s, lane=lane.index))
+        return {
+            "sessions": sum(st["sessions"] for st in per),
+            "hits": sum(st["hits"] for st in per),
+            "misses": sum(st["misses"] for st in per),
+            "evictions": sum(st["evictions"] for st in per),
+            "resident_bytes": sum(st["resident_bytes"] for st in per),
+            "max_bytes": sum(st["max_bytes"] for st in per),
+            "by_session": by_session,
+        }
+
     def stats(self) -> dict:
-        with self._cond:
-            depth = len(self._queue)
-            inflight = self._inflight
+        depth, inflight = self._totals()
         with self._lat_lock:
             submitted, completed, rejected = \
                 self.submitted, self.completed, self.rejected
@@ -537,15 +659,18 @@ class SolveService:
         # gauges + slo_window event when telemetry is on; the capacity
         # leg counts outstanding = queued + in-flight
         snap = self.slo.snapshot(queue_depth=depth + inflight,
-                                 queue_capacity=self.queue_depth)
+                                 queue_capacity=self.queue_depth
+                                 * len(self.lanes))
         return {
             "submitted": submitted,
             "completed": completed,
             "rejected": rejected,
             "queue_depth": depth,
-            "queue_capacity": self.queue_depth,
-            "workers": self._tm._max_workers,
-            "worker_task_failures": self._tm.failed_tasks,
+            "queue_capacity": self.queue_depth * len(self.lanes),
+            "workers": sum(lane._tm._max_workers or 0
+                           for lane in self.lanes),
+            "worker_task_failures": sum(lane._tm.failed_tasks
+                                        for lane in self.lanes),
             "latency_s": snap["latency_s"],
             "slo": snap,
             # queue-wait vs solve split of the request lifecycle
@@ -554,7 +679,12 @@ class SolveService:
             # per-pattern fenced device seconds vs the cost model
             "profile": profile or None,
             "endpoint": self.endpoint,
-            "cache": self.cache.stats(),
+            "cache": self._cache_stats(),
+            # multi-device scale-out: per-lane queue/SLO/cache state +
+            # the router's affinity/replication/steal picture
+            "lanes": [lane.stats() for lane in self.lanes],
+            "router": self.router.stats(),
+            "last_drain": self.last_drain,
             "device_setup": engine_stats(),
             # warm-start layer: AOT executable store traffic (None when
             # unconfigured) — the cold-start twin of the session cache

@@ -20,6 +20,8 @@ worker pool.
 """
 from __future__ import annotations
 
+import contextlib
+import copy
 import dataclasses
 import threading
 import time
@@ -27,6 +29,23 @@ from typing import List, Optional
 
 from ..config import AMGConfig
 from ..core.matrix import Matrix
+
+
+def placement_view(matrix: Matrix, device) -> Matrix:
+    """A shallow Matrix view of ``matrix`` whose DEVICE pack uploads to
+    ``device`` — the multi-lane serving layer's placement trick (the
+    precision sibling is ``core.precision.precision_view``).  Host-side
+    structures (scipy CSR, DIA caches, fingerprints) stay shared, so
+    two lanes replicating one hot pattern pay the value upload twice
+    but the host symbolic work once; the device pack cache is CLEARED,
+    not shared — a pack already resident on another lane's chip must
+    not leak into this lane's jit (mixed device sets are rejected)."""
+    v = copy.copy(matrix)
+    v._device = None
+    v._device_dtype = None
+    v._dinv_dev = None          # device-resident diag-inverse cache —
+    v.placement = device        # another lane's chip must not leak in
+    return v
 
 
 def config_hash(cfg: AMGConfig) -> str:
@@ -55,10 +74,15 @@ class SolverSession:
     """One configured solver + its setup state, reusable across
     same-pattern requests."""
 
-    def __init__(self, key: SessionKey, cfg: AMGConfig):
+    def __init__(self, key: SessionKey, cfg: AMGConfig,
+                 placement=None):
         from ..solvers import SolverFactory
         self.key = key
         self.lock = threading.RLock()
+        #: jax.Device this session's hierarchy and solves are pinned to
+        #: (multi-lane serving: one lane per device); None keeps the
+        #: process default device
+        self.placement = placement
         self.solver = SolverFactory.allocate(cfg, "default", "solver")
         self.solver._toplevel = True
         #: values fingerprint the solver is currently prepared for
@@ -71,22 +95,42 @@ class SolverSession:
         #: refreshed by the cache after each prepare)
         self.bytes = 0
 
+    def _device_ctx(self):
+        """Thread-local default-device context for placement-pinned
+        sessions: EVERY array the prepare/solve path creates without an
+        explicit device (smoother scratch, scalar operands, uploads)
+        must land on the lane's chip — one stray default-device array
+        inside the jitted call would be rejected as a mixed device
+        set."""
+        if self.placement is None:
+            return contextlib.nullcontext()
+        import jax
+        return jax.default_device(self.placement)
+
+    def _placed(self, matrix: Matrix) -> Matrix:
+        if self.placement is None or matrix.placement is self.placement:
+            return matrix
+        return placement_view(matrix, self.placement)
+
     # ------------------------------------------------------------- prepare
     def prepare(self, matrix: Matrix) -> str:
         """Make the solver ready for ``matrix``'s values; returns the
-        work actually done: ``"full"`` | ``"resetup"`` | ``"reuse"``."""
+        work actually done: ``"full"`` | ``"resetup"`` | ``"reuse"``.
+        Placement-pinned sessions setup through a placement VIEW of the
+        matrix so the device pack (and the hierarchy built from it)
+        lives on the lane's chip while host structures stay shared."""
         vfp = matrix.values_fingerprint()
-        with self.lock:
+        with self.lock, self._device_ctx():
             self.last_used = time.monotonic()
             if self.solver.Ad is None:
-                self.solver.setup(matrix)
+                self.solver.setup(self._placed(matrix))
                 self.full_setups += 1
                 self.values_fp = vfp
                 return "full"
             if vfp == self.values_fp:
                 self.value_hits += 1
                 return "reuse"
-            self.solver.resetup(matrix)
+            self.solver.resetup(self._placed(matrix))
             self.resetups += 1
             self.values_fp = vfp
             return "resetup"
@@ -96,7 +140,7 @@ class SolverSession:
                     ) -> List:
         """Multi-RHS solve under the session lock (one session's solver
         state is not reentrant; distinct sessions overlap freely)."""
-        with self.lock:
+        with self.lock, self._device_ctx():
             self.last_used = time.monotonic()
             return self.solver.solve_multi(B, X0=X0,
                                            pad_to_bucket=pad_to_bucket)
@@ -111,7 +155,7 @@ class SolverSession:
         against the wrong coefficients).  ``on_prepared(kind)``, when
         given, fires between the two steps (still under the lock) —
         the request tracer's prepare/solve phase boundary."""
-        with self.lock:
+        with self.lock, self._device_ctx():
             kind = self.prepare(matrix)
             if on_prepared is not None:
                 on_prepared(kind)

@@ -723,12 +723,17 @@ class Solver:
                                  jnp.asarray(self.tolerance, rdt),
                                  jnp.asarray(self.max_iters, jnp.int32))
                     fn = self._solve_fn
-                    if pin is None and not dist:
+                    if not dist:
                         # warm-start layer: load/compile-and-save the
                         # AOT executable for these shapes (no-op
-                        # without a configured store); pinned/sharded
-                        # packs keep jit
-                        fn = self._maybe_aot("solve", fn, call_args)
+                        # without a configured store); sharded packs
+                        # keep jit.  Pinned packs (multi-lane serving:
+                        # one executor lane per device) participate
+                        # with a device-qualified key — a serialized
+                        # executable bakes in its device assignment,
+                        # so lane 3's entry must never load on lane 0
+                        fn = self._maybe_aot("solve", fn, call_args,
+                                             device=pin)
                     x, stats, history = fn(*call_args)
                 # ONE small host fetch for (iters, norms) — per-transfer
                 # cost dominates on remote-attached TPUs
@@ -785,21 +790,27 @@ class Solver:
                            residual_norm=nrm, residual_history=history_np,
                            setup_time=self.setup_time, solve_time=solve_time)
 
-    def _maybe_aot(self, tag: str, jit_fn: Callable, args: tuple
-                   ) -> Callable:
+    def _maybe_aot(self, tag: str, jit_fn: Callable, args: tuple,
+                   device=None) -> Callable:
         """The AOT-store executable for ``jit_fn(*args)`` when the
         warm-start layer is configured and this solve path serializes
         cleanly; else ``jit_fn`` unchanged.  Serialization gates:
         forensics inserts ``jax.debug.callback``s (host callbacks do
         not survive serialization across processes), so instrumented
         solves keep the plain jit path — the persistent compilation
-        cache still covers their XLA compile."""
+        cache still covers their XLA compile.  ``device``: the pin of a
+        device-pinned solve (host modes; multi-lane serving's per-chip
+        executor lanes) — qualifies the store key, because a serialized
+        executable carries its device assignment and must only ever be
+        reloaded for that same device."""
         if self.forensics:
             return jit_fn
         try:
             from ..serve import aot
             if aot.get_store() is None:
                 return jit_fn
+            if device is not None:
+                tag = f"{tag}@{device.platform}{device.id}"
             # per-solve memo, living ON the bindings object: the full
             # key digests the whole bindings pytree (kilobytes for a
             # deep hierarchy) — too costly per warmed millisecond-class
@@ -902,8 +913,13 @@ class Solver:
                     pin = devs[0]
             except Exception:
                 pin = None
+        # device-pinned packs ride the batched path too (the multi-lane
+        # serving layer pins one executor lane per device — losing
+        # micro-batching there would cap every non-default lane at
+        # single-RHS throughput); only the refinement ladder keeps its
+        # sequential fallback under a pin
         if k == 1 or dist or (refine and not refined_batch) \
-                or pin is not None:
+                or (refine and pin is not None):
             out = []
             for j, bj in enumerate(B):
                 xj = None if X0 is None else X0[j]
@@ -947,29 +963,40 @@ class Solver:
                 X, stats, history = self._solve_multi_refined_call(
                     Bm, X0m, wide)
             else:
-                Bd = jnp.asarray(Bm, dtype)
-                X0d = jnp.zeros_like(Bd) if X0m is None \
-                    else jnp.asarray(X0m, dtype)
-                if self._solve_multi is None:
-                    from ._bind import DeviceBindings, bind_for_trace
-                    if self._bindings is None:
-                        self._bindings = DeviceBindings(self)
-                    bindings = self._bindings
-                    vm = jax.vmap(self._packed_solve_fn(),
-                                  in_axes=(0, 0, None, None))
-                    self._solve_multi = (
-                        bindings, jax.jit(bind_for_trace(bindings, vm)))
-                bindings, fn = self._solve_multi
-                rdt = np.zeros((), dtype).real.dtype
-                call_args = (bindings.collect(), Bd, X0d,
-                             jnp.asarray(self.tolerance, rdt),
-                             jnp.asarray(self.max_iters, jnp.int32))
-                # warm-start layer: each batch bucket (Bd's leading
-                # dim) is its own AOT executable — the serving
-                # micro-batcher's power-of-two padding keeps that set
-                # log2(max_batch)-sized
-                X, stats, history = self._maybe_aot(
-                    "solve_multi", fn, call_args)(*call_args)
+                import contextlib
+                # pinned packs: the batch arrays and scalar operands
+                # are created INSIDE the pin context so the jitted
+                # call never sees a mixed device set (same contract as
+                # the single-RHS pin path above)
+                ctx = jax.default_device(pin) if pin is not None \
+                    else contextlib.nullcontext()
+                with ctx:
+                    Bd = jnp.asarray(Bm, dtype)
+                    X0d = jnp.zeros_like(Bd) if X0m is None \
+                        else jnp.asarray(X0m, dtype)
+                    if self._solve_multi is None:
+                        from ._bind import DeviceBindings, bind_for_trace
+                        if self._bindings is None:
+                            self._bindings = DeviceBindings(self)
+                        bindings = self._bindings
+                        vm = jax.vmap(self._packed_solve_fn(),
+                                      in_axes=(0, 0, None, None))
+                        self._solve_multi = (
+                            bindings,
+                            jax.jit(bind_for_trace(bindings, vm)))
+                    bindings, fn = self._solve_multi
+                    rdt = np.zeros((), dtype).real.dtype
+                    call_args = (bindings.collect(), Bd, X0d,
+                                 jnp.asarray(self.tolerance, rdt),
+                                 jnp.asarray(self.max_iters, jnp.int32))
+                    # warm-start layer: each batch bucket (Bd's leading
+                    # dim) is its own AOT executable — the serving
+                    # micro-batcher's power-of-two padding keeps that
+                    # set log2(max_batch)-sized; pinned lanes key by
+                    # device (see _maybe_aot)
+                    X, stats, history = self._maybe_aot(
+                        "solve_multi", fn, call_args,
+                        device=pin)(*call_args)
             stats = np.asarray(stats)      # ONE host fetch: (k, 1+2m)
         solve_time = time.perf_counter() - t0
         Xh = None

@@ -49,6 +49,10 @@ WASTE_HINT = 2.0
 WASTE_MIN_ROWS = 4096
 #: halo-vs-local byte ratio past which the solve reads comms-bound
 HALO_HINT = 0.5
+#: fraction of a multi-lane service's resident sessions on ONE lane
+#: past which the doctor flags lane imbalance (affinity concentrates
+#: sessions by design; hoarding means replication never triggered)
+LANE_IMBALANCE_SHARE = 0.6
 #: per-component geometric-mean reduction factor past which a cycle
 #: component earns a "weakest link" hint (a healthy V-cycle smoothing
 #: component reduces the residual well below this; 0.85+ means the
@@ -278,6 +282,52 @@ def diagnose(paths: List[str]) -> dict:
                           "p99": _pct(0.99)},
         }
 
+    # ---- serving lanes (serve/router.py: multi-device scale-out) ----
+    # per-lane executor state from the lane-labeled gauges + the
+    # router's steal/replication counters; request_trace events carry
+    # the per-request lane + routing decision
+    lane_map: Dict[str, dict] = {}
+    for gname, key in (("amgx_serve_lane_sessions", "sessions"),
+                       ("amgx_serve_lane_queue_depth", "queue_depth"),
+                       ("amgx_serve_lane_inflight", "inflight"),
+                       ("amgx_serve_lane_attainment", "attainment")):
+        for lk, v in glast(gname).items():
+            ln = str(_label_get(lk, "lane"))
+            lane_map.setdefault(ln, {})[key] = v
+    steals_total, steals_by = csum("amgx_serve_steals_total")
+    reps_total, reps_by = csum("amgx_serve_replications_total")
+    route_counts: Dict[str, int] = {}
+    lane_req_counts: Dict[str, int] = {}
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] == "event" and r["name"] == "request_trace":
+                rt = r["attrs"].get("route")
+                if rt:
+                    route_counts[str(rt)] = \
+                        route_counts.get(str(rt), 0) + 1
+                ln = r["attrs"].get("lane")
+                if ln is not None:
+                    lane_req_counts[str(ln)] = \
+                        lane_req_counts.get(str(ln), 0) + 1
+    for ln, n in lane_req_counts.items():
+        lane_map.setdefault(ln, {})["requests"] = n
+    lanes_diag = None
+    if len(lane_map) > 1 or steals_total or reps_total:
+        total_sessions = sum(int(d.get("sessions") or 0)
+                             for d in lane_map.values())
+        lanes_diag = {
+            "lanes": {k: lane_map[k]
+                      for k in sorted(lane_map, key=str)},
+            "total_sessions": int(total_sessions),
+            "steals": int(steals_total),
+            "steals_by_lane": {k: int(v)
+                               for k, v in sorted(steals_by.items())},
+            "replications": int(reps_total),
+            "replications_by_lane": {
+                k: int(v) for k, v in sorted(reps_by.items())},
+            "routes": dict(sorted(route_counts.items())),
+        }
+
     # ---- SLO (telemetry/slo.py + request-lifecycle tracing) ---------
     slo_snap = None
     outcome_counts: Dict[str, int] = {}
@@ -466,6 +516,26 @@ def diagnose(paths: List[str]) -> dict:
         if fails:
             hints.append(f"{int(fails)} worker task(s) raised — the pool "
                          "survived, but check the service error log")
+    if lanes_diag and len(lanes_diag["lanes"]) > 1:
+        # lane imbalance: affinity routing concentrates sessions by
+        # design, but one lane hoarding most of them means replication
+        # never triggered — the hot patterns' home lane saturates while
+        # the rest of the mesh idles.  Balanced fleets stay silent.
+        tot = lanes_diag["total_sessions"]
+        if tot >= 4:
+            top_ln, top_d = max(
+                lanes_diag["lanes"].items(),
+                key=lambda kv: int(kv[1].get("sessions") or 0))
+            share = int(top_d.get("sessions") or 0) / tot
+            if share >= LANE_IMBALANCE_SHARE:
+                hints.append(
+                    f"lane imbalance: lane {top_ln} holds "
+                    f"{share:.0%} of {tot} resident sessions — the "
+                    "replication threshold is too high for this "
+                    "traffic: lower serve_replicate_frac (replicate "
+                    "hot patterns earlier) or serve_steal_frac (steal "
+                    "cold patterns off busy lanes sooner), or warm "
+                    "the expected pattern set so homes pre-distribute")
     if slo:
         w = slo.get("window") or {}
         burn = w.get("burn_rate")
@@ -516,6 +586,7 @@ def diagnose(paths: List[str]) -> dict:
             "halo_local_ratio": halo_local_ratio,
         },
         "serving": serving,
+        "serving_lanes": lanes_diag,
         "slo": slo,
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
@@ -872,6 +943,29 @@ def render(d: dict) -> str:
         if lat["p50"] is not None:
             L.append(f"  latency p50/p95/p99: {lat['p50']*1e3:.1f}/"
                      f"{lat['p95']*1e3:.1f}/{lat['p99']*1e3:.1f} ms")
+
+    lanes = d.get("serving_lanes")
+    if lanes:
+        L.append("")
+        L.append("serving lanes (multi-device scale-out)")
+        L.append("-" * 40)
+        L.append(f"  {'lane':<6}{'sessions':>9}{'queue':>7}"
+                 f"{'inflight':>9}{'requests':>9}{'attain':>8}")
+        for ln, v in lanes["lanes"].items():
+            att = v.get("attainment")
+            L.append(
+                f"  {ln:<6}{int(v.get('sessions') or 0):>9}"
+                f"{int(v.get('queue_depth') or 0):>7}"
+                f"{int(v.get('inflight') or 0):>9}"
+                f"{int(v.get('requests') or 0):>9}"
+                + (f"{att:>8.1%}" if isinstance(att, (int, float))
+                   else f"{'-':>8}"))
+        L.append(f"  steals: {lanes['steals']}   replications: "
+                 f"{lanes['replications']}   sessions total: "
+                 f"{lanes['total_sessions']}")
+        if lanes.get("routes"):
+            L.append("  routes: " + "  ".join(
+                f"{k}={v}" for k, v in lanes["routes"].items()))
 
     slo = d.get("slo")
     if slo:

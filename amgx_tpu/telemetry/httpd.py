@@ -12,11 +12,17 @@ Routes:
 * ``GET /metrics`` — the Prometheus text snapshot
   (:func:`amgx_tpu.telemetry.export.prometheus_text`), scrapeable by
   any textfile/HTTP collector;
-* ``GET /healthz`` — liveness JSON: queue depth/capacity, in-flight
-  batches, accepting flag, and the SLO overload trip wire.  Returns
-  **503 when overloaded, drained (not accepting), or the health
-  computation itself failed** (the load-balancer eviction contract)
-  and 200 otherwise;
+* ``GET /healthz`` — liveness JSON: aggregate queue depth/capacity,
+  in-flight batches, accepting flag, the SLO overload trip wire, and —
+  for a multi-lane service — a ``lanes`` array with every executor
+  lane's own queue/SLO/saturation state plus ``saturated_lanes``.
+  Returns **503 when overloaded (which for a multi-lane service means
+  EVERY lane is saturated — with a healthy lane left the router can
+  still steal/replicate, so the instance keeps working capacity),
+  drained (not accepting), or the health computation itself failed**
+  (the load-balancer eviction contract) and 200 otherwise; a partial
+  saturation stays 200 with the saturated subset named in the body so
+  an LB — or ``SolveService.drain_lane`` — can drain one chip;
 * ``GET /statusz`` — the solve doctor's machine-readable diagnosis of
   the current telemetry ring (``doctor.diagnose`` over a snapshot) —
   "what would the doctor say right now";

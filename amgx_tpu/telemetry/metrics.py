@@ -212,6 +212,27 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_serve_achieved_gbs":
         ("gauge", "measured device bandwidth of the last profiled "
                   "batch of one pattern {pattern}"),
+    # ---- multi-device scale-out (serve/router.py): per-lane executor
+    # ---- state + the router's replication/steal decisions -----------
+    "amgx_serve_lane_queue_depth":
+        ("gauge", "requests waiting in one executor lane's admission "
+                  "queue {lane}"),
+    "amgx_serve_lane_inflight":
+        ("gauge", "requests drained from one lane's queue whose batch "
+                  "has not finished {lane}"),
+    "amgx_serve_lane_attainment":
+        ("gauge", "SLO attainment over one lane's request window "
+                  "{lane}"),
+    "amgx_serve_lane_sessions":
+        ("gauge", "sessions resident in one lane's setup-cache slice "
+                  "{lane}"),
+    "amgx_serve_steals_total":
+        ("counter", "cold-pattern requests work-stolen to the "
+                    "least-loaded lane instead of their hash-home "
+                    "{lane=receiving lane}"),
+    "amgx_serve_replications_total":
+        ("counter", "hot patterns replicated onto an idle lane "
+                    "{lane=replica lane}"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)

@@ -206,7 +206,8 @@ class SLOWindow:
                  queue_capacity: Optional[int] = None,
                  now: Optional[float] = None,
                  emit_event: bool = True,
-                 include_percentiles: bool = True) -> dict:
+                 include_percentiles: bool = True,
+                 publish_gauges: bool = True) -> dict:
         """The full SLO picture as one dict — computed from ONE pass
         over the window (pollers call this once per scrape; the
         per-metric helpers each copy the reservoir).  Also refreshes
@@ -245,7 +246,11 @@ class SLOWindow:
             "latency_s": pct,
             "overloaded": bool(over),
         }
-        if recorder.is_enabled():
+        # publish_gauges=False: secondary windows (the per-lane SLO
+        # windows of the multi-lane serving layer) must not overwrite
+        # the service-level amgx_slo_* gauges — lanes publish their own
+        # amgx_serve_lane_attainment{lane} series instead
+        if recorder.is_enabled() and publish_gauges:
             gset = (metrics.gauge_set if emit_event
                     else metrics.registry().gauge_set)
             gset("amgx_slo_window_requests", float(total))

@@ -69,6 +69,14 @@ CASES = (
     ("cla64_comp%", lambda d: _pct(_x(
         ("extras", "pcg_classical64", "telemetry", "setup_profile",
          "compile_share"))(d))),
+    # multi-lane scale-out (ISSUE 11): lane count, aggregate achieved
+    # throughput of the multi-lane overload wave, and the fraction of
+    # routed requests that were work-stolen; single-device rounds (the
+    # probe skips itself) and pre-PR-11 rounds render "-"
+    ("lanes", _x(("extras", "serving", "scaling", "lanes"))),
+    ("agg_rps", _x(("extras", "serving", "scaling", "agg_rps"))),
+    ("steal%", lambda d: _pct(_x(
+        ("extras", "serving", "scaling", "multi", "steal_frac"))(d))),
 )
 
 

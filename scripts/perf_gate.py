@@ -64,11 +64,17 @@ FLOOR_SLACK = 0.05
 #: mixed_precision block (ISSUE 10: the bf16 hierarchy must keep its
 #: f32-equivalent per-cycle rate advantage — dropping below the pinned
 #: floor means the precision win regressed)
+#: lane_speedup is a SCALING metric from the bench serving block's
+#: scale-out probe (ISSUE 11: aggregate 4-lane throughput over
+#: single-lane under the same overload wave — falling below the pinned
+#: 3.0× floor means the executor lanes stopped scaling, whatever the
+#: absolute numbers did)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
            ("cold_start_s", "time"), ("warm_start_s", "time"),
            ("serve_p99_s", "time"), ("rejection_rate", "rate"),
-           ("bf16_effective_speedup", "floor"))
+           ("bf16_effective_speedup", "floor"),
+           ("lane_speedup", "scaling"))
 
 
 def _extract_parsed(rec: dict):
@@ -155,6 +161,16 @@ def load_round(path: str) -> dict:
             vals["rejection_rate"] = ol["rejection_rate"]
         if vals:
             cases["serving"] = vals
+    # multi-lane scale-out (ISSUE 11): the serving block's scaling
+    # probe.  Only a 4-lane measurement feeds the gate — the pinned
+    # ≥3.0× floor is a 4-lane contract, and a host with fewer visible
+    # devices measures a different (easier or impossible) ratio
+    sc = (extras.get("serving") or {}).get("scaling") \
+        if isinstance(extras.get("serving"), dict) else None
+    if isinstance(sc, dict) and "error" not in sc \
+            and sc.get("lanes") == 4 \
+            and isinstance(sc.get("speedup"), (int, float)):
+        cases["scaling"] = {"lane_speedup": sc["speedup"]}
     return cases
 
 
@@ -183,10 +199,15 @@ def compare(baseline: dict, cases: dict, time_ratio=None,
                     not isinstance(v, (int, float)):
                 continue
             checked += 1
-            if kind == "floor":
-                # higher-is-better metric (speedup factors): regresses
-                # by FALLING more than FLOOR_SLACK below the baseline
-                limit = b * (1.0 - FLOOR_SLACK)
+            if kind in ("floor", "scaling"):
+                # higher-is-better metrics.  "floor" (measured speedup
+                # factors) regresses by FALLING more than FLOOR_SLACK
+                # below the --update-ratcheted baseline; "scaling"
+                # (the lane-count scaling contract) is an ABSOLUTE
+                # pinned floor — 3.0× means 3.0×, no slack, and
+                # --update never ratchets it (see main())
+                limit = b * (1.0 - FLOOR_SLACK) if kind == "floor" \
+                    else b
                 if v < limit:
                     regressions.append({
                         "case": case, "metric": key, "baseline": b,
@@ -281,11 +302,24 @@ def main(argv=None) -> int:
         new_baseline = make_baseline(cases, round_path)
         try:
             # an operator-tuned thresholds block survives the update —
-            # --update refreshes the NUMBERS, not the policy
+            # --update refreshes the NUMBERS, not the policy.  So do
+            # "scaling"-kind values: they are pinned CONTRACTS (4-lane
+            # ≥ 3.0×), not measurements to ratchet — a lucky 3.8× round
+            # must not turn the floor into 3.8
             with open(baseline_path) as f:
                 prev = json.load(f)
             if isinstance(prev.get("thresholds"), dict):
                 new_baseline["thresholds"] = prev["thresholds"]
+            scaling_keys = {k for k, kind in TRACKED
+                            if kind == "scaling"}
+            for case, vals in (prev.get("cases") or {}).items():
+                if not isinstance(vals, dict):
+                    continue
+                keep = {k: v for k, v in vals.items()
+                        if k in scaling_keys}
+                if keep:
+                    new_baseline["cases"].setdefault(case, {}) \
+                        .update(keep)
         except (OSError, ValueError):
             pass
         with open(baseline_path, "w") as f:

@@ -14,8 +14,12 @@ unbounded queue.
 Usage:
     python scripts/serve_load.py [--rps R] [--duration S]
         [--pattern poisson7pt:N ...] [--config FILE_OR_STRING]
-        [--multi-rhs-frac F] [--max-rhs K] [--seed N]
-        [--cache-dir DIR] [--aot-dir DIR] [--no-warmup]
+        [--multi-rhs-frac F] [--max-rhs K] [--skew Z] [--lanes N]
+        [--seed N] [--cache-dir DIR] [--aot-dir DIR] [--no-warmup]
+
+``--lanes N`` scales the service out to N executor lanes (0 = one per
+visible device); ``--skew Z`` makes the pattern popularity Zipf-skewed
+so hot-key traffic exercises the router's affinity/replication policy.
 
 Exit 0 when the run completed (whatever the SLOs say); 1 when any
 request FAILED outright (rejections are not failures).
@@ -39,7 +43,14 @@ def main(argv=None) -> int:
     ap.add_argument("--config", default=None)
     ap.add_argument("--multi-rhs-frac", type=float, default=0.25)
     ap.add_argument("--max-rhs", type=int, default=4)
+    ap.add_argument("--skew", type=float, default=0.0,
+                    help="Zipf pattern-popularity skew (0 = uniform; "
+                    "1.1 ≈ hot-key web traffic) — exercises the "
+                    "multi-lane router's affinity/replication policy")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="executor lanes (serve_lanes knob; 0 = one "
+                    "per visible device)")
     ap.add_argument("--cache-dir", default=None)
     ap.add_argument("--aot-dir", default=None)
     ap.add_argument("--no-warmup", action="store_true",
@@ -55,6 +66,8 @@ def main(argv=None) -> int:
     cfg = amgx.AMGConfig.from_file(args.config) \
         if args.config and os.path.exists(args.config) \
         else amgx.AMGConfig(src)
+    if args.lanes is not None:
+        cfg.set("serve_lanes", args.lanes)
     if args.cache_dir:
         cfg.set("compile_cache_dir", args.cache_dir)
     if args.aot_dir:
@@ -74,7 +87,8 @@ def main(argv=None) -> int:
         out = run_load(svc, patterns, rps=args.rps,
                        duration_s=args.duration,
                        multi_rhs_frac=args.multi_rhs_frac,
-                       max_rhs=args.max_rhs, seed=args.seed)
+                       max_rhs=args.max_rhs, skew=args.skew,
+                       seed=args.seed)
         st = svc.stats()
     finally:
         svc.shutdown()

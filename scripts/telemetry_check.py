@@ -544,12 +544,131 @@ def main():
         fail("doctor cost-model table is missing the dtype column / "
              "bfloat16 levels")
 
+    # 14. multi-lane serving scale-out (ISSUE 11): lane-labeled gauges
+    # reach /metrics with per-lane rows, /healthz carries the
+    # lane-aware body schema (503 only when every lane saturates), the
+    # request_trace events carry lane + route, and the doctor's
+    # lane-imbalance section fires on a hoarding lane while a balanced
+    # fleet stays silent
+    telemetry.reset()
+    telemetry.disable()
+    path_l = path + ".lanes"
+    if os.path.exists(path_l):
+        os.unlink(path_l)
+    cfg_l = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, amg:max_levels=10, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER, "
+        "serve_workers=2, serve_batch_window_ms=2, serve_lanes=2, "
+        f"out:telemetry=1, out:telemetry_path={path_l}")
+    svc_l = SolveService(cfg_l)
+    try:
+        if len(svc_l.lanes) != 2:
+            fail(f"serve_lanes=2 built {len(svc_l.lanes)} lanes")
+        url_l = svc_l.start_endpoint(0)
+        import scipy.sparse as _sp
+        from amgx_tpu.io import poisson5pt as _p5
+        mo1 = amgx.Matrix(A)
+        mo2 = amgx.Matrix(_sp.csr_matrix(_p5(12, 12)))
+        import numpy as _np
+        pend_l = []
+        for mm in (mo1, mo2):
+            pend_l += [svc_l.submit(mm, _np.ones(mm.shape[0]))
+                       for _ in range(3)]
+        for p in pend_l:
+            if p.wait(timeout=120.0) is None:
+                fail(f"lane smoke request failed: rc={p.rc} {p.error}")
+        st_l = svc_l.stats()
+        if len(st_l["lanes"]) != 2 or "router" not in st_l:
+            fail(f"stats() missing lanes/router: {list(st_l)}")
+        mtxt_l = urllib.request.urlopen(url_l + "/metrics",
+                                        timeout=10).read().decode()
+        for row in ('amgx_serve_lane_sessions{lane="0"}',
+                    'amgx_serve_lane_sessions{lane="1"}',
+                    'amgx_serve_lane_queue_depth{lane='):
+            if row not in mtxt_l:
+                fail(f"/metrics scrape is missing per-lane row "
+                     f"{row!r}")
+        hz_l = json.loads(urllib.request.urlopen(url_l + "/healthz",
+                                                 timeout=10).read())
+        for key in ("lanes", "lanes_total", "lanes_overloaded",
+                    "saturated_lanes", "overloaded"):
+            if key not in hz_l:
+                fail(f"/healthz missing lane-aware key {key!r}: "
+                     f"{sorted(hz_l)}")
+        if hz_l["lanes_total"] != 2 or len(hz_l["lanes"]) != 2:
+            fail(f"/healthz lane count wrong: {hz_l}")
+        for lh in hz_l["lanes"]:
+            for key in ("lane", "accepting", "queue_depth",
+                        "overloaded", "sessions"):
+                if key not in lh:
+                    fail(f"/healthz lane entry missing {key!r}: {lh}")
+        telemetry.flush_jsonl(path_l)
+    finally:
+        svc_l.shutdown()
+    with open(path_l) as f:
+        lines_l = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_l)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"lane trace: {e}")
+    recs_l = [json.loads(l) for l in lines_l if l.strip()]
+    traces_l = [r["attrs"] for r in recs_l if r["kind"] == "event"
+                and r["name"] == "request_trace"]
+    if not traces_l or not all("lane" in a and "route" in a
+                               for a in traces_l):
+        fail("request_trace events are missing lane/route attrs")
+    lane_gauges = {r["labels"].get("lane") for r in recs_l
+                   if r["kind"] == "gauge"
+                   and r["name"] == "amgx_serve_lane_sessions"}
+    if not {"0", "1"} <= {str(v) for v in lane_gauges}:
+        fail(f"lane-labeled session gauges incomplete: {lane_gauges}")
+    diag_l = doctor.diagnose([path_l])
+    if not diag_l.get("serving_lanes"):
+        fail("doctor diagnose has no serving_lanes section for a "
+             "multi-lane trace")
+    if "serving lanes" not in doctor.render(diag_l):
+        fail("doctor report is missing the serving-lanes section")
+    # the imbalance hint, both ways: a hoarding lane fires it …
+    telemetry.reset()
+    telemetry.disable()
+    path_li = path + ".lanes_imb"
+    if os.path.exists(path_li):
+        os.unlink(path_li)
+    telemetry.enable(ring_size=4096)
+    telemetry.gauge_set("amgx_serve_lane_sessions", 8, lane=0)
+    telemetry.gauge_set("amgx_serve_lane_sessions", 1, lane=1)
+    telemetry.flush_jsonl(path_li)
+    telemetry.disable()
+    diag_imb = doctor.diagnose([path_li])
+    if not any("lane imbalance" in h for h in diag_imb.get("hints", ())):
+        fail(f"doctor did not flag an 8-vs-1 session imbalance: "
+             f"{diag_imb.get('hints')}")
+    # … while a balanced fleet stays silent
+    telemetry.reset()
+    path_lb = path + ".lanes_bal"
+    if os.path.exists(path_lb):
+        os.unlink(path_lb)
+    telemetry.enable(ring_size=4096)
+    telemetry.gauge_set("amgx_serve_lane_sessions", 4, lane=0)
+    telemetry.gauge_set("amgx_serve_lane_sessions", 4, lane=1)
+    telemetry.flush_jsonl(path_lb)
+    telemetry.disable()
+    diag_bal = doctor.diagnose([path_lb])
+    if any("lane imbalance" in h for h in diag_bal.get("hints", ())):
+        fail(f"doctor flagged imbalance on a balanced fleet: "
+             f"{diag_bal.get('hints')}")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
-          f"serving-obs OK, mixed-precision OK)")
+          f"serving-obs OK, mixed-precision OK, serving-lanes OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -559,6 +678,9 @@ def main():
         os.unlink(path_o)
         os.unlink(path_32)
         os.unlink(path_m)
+        os.unlink(path_l)
+        os.unlink(path_li)
+        os.unlink(path_lb)
 
 
 if __name__ == "__main__":

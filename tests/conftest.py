@@ -59,6 +59,10 @@ def pytest_configure(config):
         "markers", "serve_obs: live serving observability fast tests "
                    "(tier-1; pytest -m serve_obs selects just these)")
     config.addinivalue_line(
+        "markers", "serve_scale: multi-lane serving scale-out fast "
+                   "tests (tier-1; pytest -m serve_scale selects "
+                   "just these)")
+    config.addinivalue_line(
         "markers", "mixed_precision: bf16-hierarchy / promotion-ladder "
                    "fast tests (tier-1; pytest -m mixed_precision "
                    "selects just these)")

@@ -300,13 +300,23 @@ def test_healthz_503_when_overloaded():
         svc.start()
         assert urllib.request.urlopen(url + "/healthz",
                                       timeout=30).status == 200
+        # feed every window the way _finalize does (service aggregate +
+        # owning lane): the lane-aware 503 rule trips when ALL lanes
+        # are saturated — for a single-lane service that is exactly the
+        # pre-scale-out contract
         for _ in range(20):
             svc.slo.record(0.0, "rejected")
+            for lane in svc.lanes:
+                lane.slo.record(0.0, "rejected")
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(url + "/healthz", timeout=30)
         assert ei.value.code == 503
-        assert json.loads(ei.value.read())["overloaded"] is True
+        body = json.loads(ei.value.read())
+        assert body["overloaded"] is True
+        assert body["lanes_overloaded"] == body["lanes_total"]
         svc.slo.reset()
+        for lane in svc.lanes:
+            lane.slo.reset()
         assert svc.drain(60)
         with pytest.raises(urllib.error.HTTPError) as ei:
             urllib.request.urlopen(url + "/healthz", timeout=30)

@@ -1,0 +1,500 @@
+"""Multi-lane serving scale-out tests (serve/router.py + the lane-aware
+service): per-device executor lanes, pattern-affinity routing,
+hot-pattern replication, cold-pattern work stealing, concurrent drain,
+and the revised lane-aware /healthz contract.
+
+Routing invariants under test (ISSUE 11):
+
+* same-fingerprint requests land on ONE lane until replication
+  triggers;
+* a stolen cold pattern's follow-up burst batches on the stealing lane
+  — a (key, values) micro-batch never splits;
+* a replicated pattern's two lanes return BIT-identical answers;
+* drain() flushes lanes concurrently and reports the wedged lane's
+  timeout while the others drain clean;
+* /healthz 503s only when EVERY lane is saturated, naming the
+  saturated subset in the body.
+
+Runs on the 8-device virtual CPU mesh the whole suite configures
+(conftest.py sets --xla_force_host_platform_device_count=8).
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.errors import RC, SolveStatus
+from amgx_tpu.io import poisson5pt, poisson7pt
+from amgx_tpu.serve import SolveService
+from amgx_tpu.serve.router import _stable_idx
+from amgx_tpu.serve.session import (SessionKey, SolverSession,
+                                    config_hash)
+
+pytestmark = pytest.mark.serve_scale
+
+
+AMG_PCG_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-10, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+    "amg:selector=SIZE_2, amg:max_iters=1, "
+    "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+    "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+
+
+def _cfg(extra: str = ""):
+    return amgx.AMGConfig(
+        AMG_PCG_CFG + ", serve_batch_window_ms=2, serve_workers=2, "
+        "serve_max_batch=8" + extra)
+
+
+# ---------------------------------------------------------------------------
+# lane construction
+# ---------------------------------------------------------------------------
+def test_lanes_one_per_visible_device():
+    """serve_lanes=0 resolves to one lane per visible device; explicit
+    counts are honored; lane 0 rides the default device (device=None),
+    the rest pin to distinct devices."""
+    import jax
+    ndev = len(jax.devices())
+    assert ndev == 8                       # the conftest mesh
+    svc = SolveService(_cfg(", serve_lanes=0"), start=False)
+    assert len(svc.lanes) == ndev
+    assert svc.lanes[0].device is None
+    pinned = [l.device for l in svc.lanes[1:]]
+    assert len(set(pinned)) == ndev - 1
+    svc4 = SolveService(_cfg(", serve_lanes=4"), start=False)
+    assert len(svc4.lanes) == 4
+
+
+def test_cache_budget_sliced_per_lane():
+    svc = SolveService(_cfg(", serve_lanes=4, serve_cache_bytes=1000"),
+                       start=False)
+    assert all(l.cache.max_bytes == 250 for l in svc.lanes)
+    svc1 = SolveService(_cfg(", serve_cache_bytes=1000"), start=False)
+    assert svc1.cache.max_bytes == 1000    # single lane: full budget
+
+
+# ---------------------------------------------------------------------------
+# routing invariants
+# ---------------------------------------------------------------------------
+def test_affinity_same_pattern_stays_on_one_lane(rng):
+    """Repeat same-fingerprint traffic lands on ONE lane (the session
+    holder) until replication triggers — never spread round-robin."""
+    A = poisson7pt(6, 6, 6)
+    m = amgx.Matrix(A)
+    n = A.shape[0]
+    with SolveService(_cfg(", serve_lanes=4")) as svc:
+        pend = [svc.submit(m, rng.standard_normal(n))
+                for _ in range(10)]
+        lanes_used = {p._request.lane for p in pend}
+        for p in pend:
+            assert p.wait(120) is not None and p.rc == RC.OK
+        st = svc.stats()
+    assert len(lanes_used) == 1            # one pattern -> one lane
+    rt = st["router"]
+    assert rt["patterns"] == 1 and rt["replications"] == 0
+    assert rt["decisions"]["affinity"] == 9
+    held = [k for k, v in rt["sessions_by_lane"].items() if v]
+    assert len(held) == 1
+    # exactly one lane built the session
+    assert sum(1 for l in st["lanes"] if l["sessions"]) == 1
+
+
+def test_cold_steal_goes_least_loaded_and_burst_does_not_split(rng):
+    """A cold pattern whose hash-home lane is busy is stolen to the
+    least-loaded lane — and the whole follow-up burst lands THERE (the
+    steal re-homes the pattern; a (key, values) micro-batch must never
+    split across lanes)."""
+    A = sp.csr_matrix(poisson5pt(9, 9))
+    m = amgx.Matrix(A)
+    n = A.shape[0]
+    svc = SolveService(_cfg(", serve_lanes=4"), start=False)
+    try:
+        svc._accepting = True
+        hh = _stable_idx(m.pattern_fingerprint(), 4)
+        # make the hash-home lane read busy (queue fraction > steal
+        # threshold) without blocking its dispatcher
+        with svc.lanes[hh]._cond:
+            svc.lanes[hh]._inflight = svc.lanes[hh].queue_depth
+        b = rng.standard_normal((5, n))
+        pend = [svc.submit(m, row) for row in b]
+        routes = [p._request.route for p in pend]
+        lanes_used = [p._request.lane for p in pend]
+        assert routes[0] == "steal"
+        assert all(r == "affinity" for r in routes[1:])
+        assert len(set(lanes_used)) == 1       # the burst never splits
+        assert lanes_used[0] != hh
+        with svc.lanes[hh]._cond:
+            svc.lanes[hh]._inflight = 0
+        with telemetry.capture() as tel:
+            svc.start()
+            for p in pend:
+                assert p.wait(120) is not None, p.error
+        st = svc.stats()
+        assert st["router"]["steals"] == 1
+        assert svc.lanes[lanes_used[0]].stolen_in == 1
+        # the queued burst executed as ONE stacked micro-batch
+        sizes = [r["value"] for r in tel.metric_records(
+            "amgx_serve_batch_size", kind="hist")]
+        assert sizes and max(sizes) == 5
+    finally:
+        svc.shutdown()
+
+
+def test_replication_on_saturated_home_and_bit_identical(rng):
+    """A hot pattern whose home lane saturates replicates onto an idle
+    lane; the replica's answers are BIT-identical to the home lane's
+    (same operator, same config, same executable, different chip)."""
+    A = poisson7pt(6, 6, 6)
+    m = amgx.Matrix(A)
+    n = A.shape[0]
+    with SolveService(_cfg(", serve_lanes=2")) as svc:
+        r = svc.solve(m, rng.standard_normal(n), timeout=120)
+        assert r.status == SolveStatus.SUCCESS
+        home = svc.router.holders(m.pattern_fingerprint())[0]
+        # saturate the home lane's admission load signal
+        with svc.lanes[home]._cond:
+            svc.lanes[home]._inflight = svc.lanes[home].queue_depth
+        p = svc.submit(m, rng.standard_normal(n))
+        assert p._request.route == "replicate"
+        replica = p._request.lane
+        assert replica != home
+        assert p.wait(120) is not None and p.rc == RC.OK
+        with svc.lanes[home]._cond:
+            svc.lanes[home]._inflight = 0
+        st = svc.stats()
+        assert st["router"]["replications"] == 1
+        assert st["router"]["replicated_patterns"] == 1
+        # both lanes now hold the session: identical batched solves
+        key = SessionKey(config=svc._cfg_hash,
+                         pattern=m.pattern_fingerprint())
+        s_home = svc.lanes[home].cache.get(key)
+        s_rep = svc.lanes[replica].cache.get(key)
+        assert s_home is not None and s_rep is not None
+        B = rng.standard_normal((4, n))
+        res_h = s_home.solve_batch(B.copy(), pad_to_bucket=True)
+        res_r = s_rep.solve_batch(B.copy(), pad_to_bucket=True)
+        for a, b in zip(res_h, res_r):
+            assert a.status == b.status
+            assert a.iterations == b.iterations
+            assert np.array_equal(np.asarray(a.x), np.asarray(b.x))
+
+
+def test_replica_pick_is_values_keyed(rng):
+    """With a pattern replicated on two lanes, the routed lane is a
+    deterministic function of the VALUES fingerprint — one
+    (key, values) group can never split across lanes, while distinct
+    value sets spread."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_cfg(", serve_lanes=2"), start=False)
+    try:
+        pat = m.pattern_fingerprint()
+        svc.router._homes[pat] = [0, 1]       # pre-replicated
+        picks = [svc.router.route(pat, "values-x")[0]
+                 for _ in range(8)]
+        assert len(set(picks)) == 1           # same values: same lane
+        spread = {svc.router.route(pat, f"values-{i}")[0]
+                  for i in range(32)}
+        assert spread == {0, 1}               # distinct values spread
+    finally:
+        svc.shutdown()
+
+
+def test_service_restart_after_shutdown(rng):
+    """start() after shutdown() re-spawns every lane's dispatcher —
+    a request admitted after restart must execute, not queue forever
+    (the pre-scale-out service was restartable)."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_cfg(", serve_lanes=2"))
+    try:
+        svc.solve(m, np.ones(A.shape[0]), timeout=120)
+        svc.shutdown()
+        svc.start()
+        res = svc.solve(m, np.ones(A.shape[0]), timeout=120)
+        assert res.status == SolveStatus.SUCCESS
+    finally:
+        svc.shutdown()
+
+
+def test_overflow_when_no_idle_lane():
+    """Every holder saturated and nobody idle: the request overflows to
+    the least-bad holder (admission backpressure sheds from there) —
+    no replication onto an equally busy lane."""
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    svc = SolveService(_cfg(", serve_lanes=2"), start=False)
+    try:
+        pat = m.pattern_fingerprint()
+        svc.router._homes[pat] = [0]
+        for lane in svc.lanes:      # both lanes past replicate_frac
+            with lane._cond:
+                lane._inflight = lane.queue_depth - 1
+        lane_idx, decision = svc.router.route(pat, "vfp")
+        assert decision == "overflow" and lane_idx == 0
+        assert svc.router.replications == 0
+        for lane in svc.lanes:
+            with lane._cond:
+                lane._inflight = 0
+    finally:
+        svc.shutdown()
+
+
+def test_drain_lane_reroutes_and_service_keeps_serving(rng):
+    """drain_lane evicts one chip: its homed pattern re-routes (steal/
+    replicate away from the non-accepting lane) and the service keeps
+    answering."""
+    A = poisson7pt(5, 5, 5)
+    m = amgx.Matrix(A)
+    n = A.shape[0]
+    with SolveService(_cfg(", serve_lanes=2")) as svc:
+        svc.solve(m, np.ones(n), timeout=120)
+        home = svc.router.holders(m.pattern_fingerprint())[0]
+        rep = svc.drain_lane(home, timeout=30)
+        assert rep["ok"] is True
+        p = svc.submit(m, np.ones(n))
+        assert p._request.lane != home
+        assert p.wait(120) is not None and p.rc == RC.OK
+        svc.resume_lane(home)
+        assert svc.lanes[home].accepting
+
+
+def test_warmup_spreads_homes_and_all_lanes_prereplicates():
+    """Warming N patterns on an idle mesh spreads their homes across
+    lanes (cold placement prefers the lane with fewest homes); the
+    all_lanes mode pre-replicates every pattern on every lane so a
+    later replication decision finds the session already resident."""
+    mats = [amgx.Matrix(poisson7pt(5, 5, 5)),
+            amgx.Matrix(sp.csr_matrix(poisson5pt(8, 8)))]
+    with SolveService(_cfg(", serve_lanes=2")) as svc:
+        svc.warmup(mats)
+        by_lane = svc.router.sessions_by_lane()
+        assert sorted(by_lane.values()) == [1, 1]   # one home per lane
+        assert sum(l["sessions"] for l in svc.stats()["lanes"]) == 2
+    with SolveService(_cfg(", serve_lanes=2")) as svc:
+        w = svc.warmup(mats, all_lanes=True, max_batch=1)
+        assert len(w["details"]) == 4               # 2 patterns × 2 lanes
+        assert all(l["sessions"] == 2 for l in svc.stats()["lanes"])
+
+
+# ---------------------------------------------------------------------------
+# concurrent drain with a wedged lane
+# ---------------------------------------------------------------------------
+def test_drain_concurrent_with_wedged_lane(rng):
+    """One lane wedged mid-batch must not serialize drain(): the others
+    drain clean and fast, the wedged lane reports ITS timeout in the
+    per-lane breakdown."""
+    A1 = poisson7pt(5, 5, 5)
+    A2 = sp.csr_matrix(poisson5pt(10, 10))
+    m1, m2 = amgx.Matrix(A1), amgx.Matrix(A2)
+    svc = SolveService(_cfg(", serve_lanes=2"))
+    try:
+        svc.solve(m1, np.ones(A1.shape[0]), timeout=120)
+        h1 = svc.router.holders(m1.pattern_fingerprint())[0]
+        # make sure m2 homes on the OTHER lane: mark h1 busy so the
+        # cold routing steals m2 away if its hash-home collides
+        with svc.lanes[h1]._cond:
+            svc.lanes[h1]._inflight = svc.lanes[h1].queue_depth
+        svc.solve(m2, np.ones(A2.shape[0]), timeout=120)
+        with svc.lanes[h1]._cond:
+            svc.lanes[h1]._inflight = 0
+        h2 = svc.router.holders(m2.pattern_fingerprint())[0]
+        assert h1 != h2
+        key1 = SessionKey(config=svc._cfg_hash,
+                          pattern=m1.pattern_fingerprint())
+        sess1 = svc.lanes[h1].cache.get(key1)
+        # wedge lane h1 mid-batch: its worker blocks on the session
+        # lock inside prepare_and_solve
+        assert sess1.lock.acquire(timeout=30)
+        try:
+            p_wedged = svc.submit(m1, np.ones(A1.shape[0]))
+            p_clean = svc.submit(m2, np.ones(A2.shape[0]))
+            assert p_clean.wait(120) is not None
+            # wait until the wedged batch is actually in-flight
+            for _ in range(200):
+                if svc.lanes[h1].outstanding():
+                    break
+                threading.Event().wait(0.01)
+            with pytest.warns(UserWarning, match="drain timed out"):
+                ok = svc.drain(timeout=1.5)
+            assert ok is False
+            rep = {r["lane"]: r for r in svc.last_drain["lanes"]}
+            assert rep[h1]["ok"] is False       # the wedged chip
+            assert rep[h2]["ok"] is True        # drained clean
+            # concurrency: the clean lane did not wait out the wedged
+            # lane's timeout
+            assert rep[h2]["seconds"] < 1.0
+        finally:
+            sess1.lock.release()
+        assert p_wedged.wait(120) is not None   # completes after unwedge
+    finally:
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# lane-aware health contract
+# ---------------------------------------------------------------------------
+def test_healthz_503_only_when_all_lanes_saturated():
+    """Partial saturation stays 200 with the saturated subset named;
+    503 fires only when EVERY lane is saturated."""
+    svc = SolveService(_cfg(", serve_lanes=2"))
+    try:
+        url = svc.start_endpoint(0)
+        assert urllib.request.urlopen(url + "/healthz",
+                                      timeout=30).status == 200
+        # saturate lane 0 only (its own windowed shed rate)
+        for _ in range(20):
+            svc.lanes[0].slo.record(0.0, "rejected")
+        body = json.loads(urllib.request.urlopen(
+            url + "/healthz", timeout=30).read())     # still 200
+        assert body["overloaded"] is False
+        assert body["lanes_overloaded"] == 1
+        assert body["saturated_lanes"] == [0]
+        assert [l["overloaded"] for l in body["lanes"]] == [True, False]
+        # saturate the second lane too -> every lane saturated -> 503
+        for _ in range(20):
+            svc.lanes[1].slo.record(0.0, "rejected")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url + "/healthz", timeout=30)
+        assert ei.value.code == 503
+        body = json.loads(ei.value.read())
+        assert body["overloaded"] is True
+        assert body["lanes_overloaded"] == body["lanes_total"] == 2
+    finally:
+        svc.shutdown()
+
+
+def test_lane_metrics_registered_and_emitted(rng):
+    """The lane-labeled metric names are registered contracts and a
+    multi-lane service emits them with lane labels."""
+    from amgx_tpu.telemetry.metrics import METRICS
+    for name in ("amgx_serve_lane_queue_depth",
+                 "amgx_serve_lane_inflight",
+                 "amgx_serve_lane_attainment",
+                 "amgx_serve_lane_sessions",
+                 "amgx_serve_steals_total",
+                 "amgx_serve_replications_total"):
+        assert name in METRICS, name
+    A = poisson7pt(5, 5, 5)
+    m = amgx.Matrix(A)
+    with telemetry.capture() as tel:
+        with SolveService(_cfg(", serve_lanes=2")) as svc:
+            svc.solve(m, np.ones(A.shape[0]), timeout=120)
+            svc.health()                    # publishes per-lane gauges
+    sess = {r["labels"].get("lane") for r in tel.metric_records(
+        "amgx_serve_lane_sessions", kind="gauge")}
+    assert {"0", "1"} <= {str(v) for v in sess}
+    qd = tel.metric_records("amgx_serve_lane_queue_depth", kind="gauge")
+    assert qd and all("lane" in r["labels"] for r in qd)
+
+
+def test_request_trace_carries_lane_and_route(rng):
+    A = poisson7pt(5, 5, 5)
+    m = amgx.Matrix(A)
+    with telemetry.capture() as tel:
+        with SolveService(_cfg(", serve_lanes=2")) as svc:
+            svc.solve(m, np.ones(A.shape[0]), timeout=120)
+    traces = tel.events("request_trace")
+    assert traces
+    for r in traces:
+        assert r["attrs"]["route"] in ("affinity", "cold", "steal",
+                                       "replicate", "overflow")
+        assert isinstance(r["attrs"]["lane"], int)
+
+
+# ---------------------------------------------------------------------------
+# pinned-lane execution correctness
+# ---------------------------------------------------------------------------
+def test_pinned_session_batched_solve_matches_reference(rng):
+    """A session pinned to a non-default device still micro-batches
+    (the vmapped multi-RHS executable, not the sequential fallback) and
+    matches a default-device reference solve."""
+    import jax
+    A = poisson7pt(6, 6, 6)
+    n = A.shape[0]
+    cfg = amgx.AMGConfig(AMG_PCG_CFG)
+    key = SessionKey(config=config_hash(cfg),
+                     pattern=amgx.Matrix(A).pattern_fingerprint())
+    sess = SolverSession(key, cfg, placement=jax.devices()[3])
+    assert sess.prepare(amgx.Matrix(A)) == "full"
+    assert {d.id for d in sess.solver.Ad.diag.devices()} == {3}
+    B = rng.standard_normal((5, n))
+    res = sess.solve_batch(B, pad_to_bucket=True)
+    # the BATCHED executable ran (pinned packs used to fall back to
+    # sequential solves, which never builds _solve_multi)
+    assert sess.solver._solve_multi is not None
+    ref = amgx.create_solver(amgx.AMGConfig(AMG_PCG_CFG))
+    ref.setup(amgx.Matrix(A))
+    for j, r in enumerate(res):
+        assert r.status == SolveStatus.SUCCESS
+        np.testing.assert_allclose(np.asarray(r.x),
+                                   np.asarray(ref.solve(B[j]).x),
+                                   rtol=1e-8, atol=1e-10)
+
+
+def test_pinned_session_resetup_stays_on_lane_device(rng):
+    """Values-only resetup of a pinned session keeps the hierarchy on
+    the lane's device (the placement view re-applies per resetup)."""
+    import jax
+    A = sp.csr_matrix(poisson5pt(10, 10))
+    cfg = amgx.AMGConfig(AMG_PCG_CFG)
+    key = SessionKey(config=config_hash(cfg),
+                     pattern=amgx.Matrix(A).pattern_fingerprint())
+    sess = SolverSession(key, cfg, placement=jax.devices()[2])
+    assert sess.prepare(amgx.Matrix(A)) == "full"
+    m2 = amgx.Matrix(sp.csr_matrix(A * 2.0))
+    assert sess.prepare(m2) == "resetup"
+    assert {d.id for d in sess.solver.Ad.diag.devices()} == {2}
+    b = np.ones(A.shape[0])
+    res = sess.solve_batch(b[None, :])
+    x = np.asarray(res[0].x)
+    relres = np.linalg.norm(b - (A * 2.0) @ x) / np.linalg.norm(b)
+    assert relres < 1e-8
+
+
+# ---------------------------------------------------------------------------
+# loadgen: Zipf skew + hit distribution + lane summary
+# ---------------------------------------------------------------------------
+def test_loadgen_zipf_skew_and_pattern_hits(rng):
+    from amgx_tpu.serve.loadgen import run_load
+    mats = [amgx.Matrix(poisson7pt(5, 5, 5)),
+            amgx.Matrix(sp.csr_matrix(poisson5pt(8, 8))),
+            amgx.Matrix(sp.csr_matrix(poisson5pt(9, 9)))]
+    with SolveService(_cfg()) as svc:
+        out = run_load(svc, mats, rps=60.0, duration_s=0.8,
+                       skew=2.0, multi_rhs_frac=0.0, seed=3)
+    hits = out["pattern_hits"]
+    assert len(hits) == 3 and out["skew"] == 2.0
+    assert abs(sum(h["frac"] for h in hits) - 1.0) < 1e-6
+    # rank-1 Zipf at skew 2: the first pattern dominates
+    assert hits[0]["requests"] > hits[1]["requests"] \
+        >= hits[2]["requests"]
+    assert hits[0]["frac"] > 0.5
+    assert out["lanes"] is None            # single lane: no lane block
+
+
+def test_loadgen_reports_lane_block_multi_lane(rng):
+    from amgx_tpu.serve.loadgen import run_load
+    mats = [amgx.Matrix(poisson7pt(5, 5, 5)),
+            amgx.Matrix(sp.csr_matrix(poisson5pt(8, 8)))]
+    with SolveService(_cfg(", serve_lanes=2")) as svc:
+        svc.warmup(mats)
+        out = run_load(svc, mats, rps=40.0, duration_s=0.6,
+                       skew=1.0, multi_rhs_frac=0.0, seed=5)
+    lanes = out["lanes"]
+    assert lanes and lanes["lanes"] == 2
+    assert len(lanes["per_lane"]) == 2
+    assert set(lanes["per_lane"][0]) >= {"lane", "completed",
+                                         "stolen_in", "sessions"}
+    assert "steal_frac_of_routed" in lanes
+    assert out["completed"] + out["rejected"] + out["failed"] \
+        == out["offered"]
