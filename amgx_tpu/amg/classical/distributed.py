@@ -98,8 +98,10 @@ def strength_distributed(exts: List[RankExtended], strength_objs
                          ) -> List[sp.csr_matrix]:
     """Per-rank strength on the extended systems — row-local formulas
     make local + ring-1 rows exact.  Computed ONCE per level and shared
-    by selection and interpolation."""
-    return [strength_objs[p].compute(exts[p].A_U)
+    by selection and interpolation.  Agglomerated levels leave trailing
+    ranks empty — their strength is the trivial empty graph."""
+    return [strength_objs[p].compute(exts[p].A_U) if exts[p].nU
+            else sp.csr_matrix((0, 0))
             for p in range(len(exts))]
 
 
@@ -286,6 +288,9 @@ def interpolate_distributed(exts: List[RankExtended], interp,
     rank-local universe arrays (``coarse_numbering_distributed``)."""
     P_blocks = []
     for p, e in enumerate(exts):
+        if e.n_local == 0:     # agglomerated-away rank: empty P block
+            P_blocks.append(sp.csr_matrix((0, nc)))
+            continue
         P_U = interp.compute(e.A_U, S_U[p], cf_U[p])
         # universe coarse order -> global coarse ids
         c_slots = np.flatnonzero(cf_U[p])
@@ -298,7 +303,9 @@ def interpolate_distributed(exts: List[RankExtended], interp,
 
 
 def rap_distributed(blocks, P_blocks: List[sp.csr_matrix],
-                    part: Partition, coarse_offsets: np.ndarray
+                    part: Partition, coarse_offsets: np.ndarray,
+                    engine=None, dtype=None, level=None,
+                    min_rows: int = 0, budget_bytes=None
                     ) -> Tuple[List[sp.csr_matrix], List[sp.csr_matrix]]:
     """Distributed Galerkin: per-rank ``Ac`` row blocks and ``R`` row
     blocks from the per-rank ``A`` and ``P`` blocks.
@@ -309,6 +316,13 @@ def rap_distributed(blocks, P_blocks: List[sp.csr_matrix],
     neighbours, and owners sum the incoming partials — the reference's
     ``csr_RAP_sparse_add`` (``csr_multiply.h:100-126``).  R rows (= Pᵀ
     columns) are collected the same neighbour-wise way.
+
+    ``engine``: the device setup engine
+    (:mod:`amgx_tpu.amg.device_setup`) — each rank's partial then runs
+    SHARD-LOCAL on device (``engine.galerkin_dist``: pattern-keyed
+    symbolic plan once, pure numeric contraction on every refresh,
+    ``amgx_device_rap_total{path=dist}``); host scipy stays the per-rank
+    fallback for every gated case.
     """
     offsets = np.asarray(part.offsets)
     n_parts = part.n_parts
@@ -344,8 +358,20 @@ def rap_distributed(blocks, P_blocks: List[sp.csr_matrix],
             shape=(hi - lo, len(keep_cols)))
         P_rows = sp.vstack([sp.csr_matrix(P_blocks[p]),
                             p_rows_for(ring1)]).tocsr()
-        AP = sp.csr_matrix(A_loc @ P_rows)           # (n_local_p, nc)
-        part_contrib = sp.csr_matrix(P_blocks[p].T @ AP)   # (nc, nc)
+        part_contrib = None
+        if engine is not None and A_loc.nnz and P_blocks[p].nnz:
+            # shard-local device Galerkin: P_rows = [P_loc | halo'd P
+            # rows] satisfies the data-prefix contract of the ext plan
+            part_contrib = engine.galerkin_dist(
+                A_loc, P_rows, P_blocks[p],
+                dtype=np.dtype(dtype or A_loc.dtype), level=level,
+                min_rows=min_rows, budget_bytes=budget_bytes)
+            if part_contrib is not None:
+                part_contrib = sp.csr_matrix(
+                    part_contrib.astype(A_loc.dtype))
+        if part_contrib is None:
+            AP = sp.csr_matrix(A_loc @ P_rows)       # (n_local_p, nc)
+            part_contrib = sp.csr_matrix(P_blocks[p].T @ AP)  # (nc, nc)
         part_contrib.sum_duplicates()
         coo = part_contrib.tocoo()
         crow_owner = np.searchsorted(coarse_offsets, coo.row,

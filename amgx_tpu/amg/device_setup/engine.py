@@ -217,10 +217,86 @@ class DeviceSetupEngine:
             Ac.eliminate_zeros()
         return Ac
 
+    # ---------------------------------------- distributed shard-local RAP
+    def galerkin_dist(self, A_loc, P_ext, P_loc, *, dtype, level=None,
+                      min_rows: int = 0,
+                      budget_bytes: Optional[int] = None
+                      ) -> Optional[sp.csr_matrix]:
+        """SHARD-LOCAL distributed Galerkin partial
+        ``P_locᵀ·(A_loc·P_ext)`` — the device half of the per-rank
+        distributed RAP (``RAP_ext``, ``csr_multiply.h:100-126``):
+        ``A_loc`` is one rank's row block over its [local | ring-1]
+        column space, ``P_ext`` its local P rows stacked with the
+        halo'd P rows (one ring exchange), ``P_loc`` the local rows
+        alone (the ``build_galerkin_plan`` ``P_left`` contract).
+
+        Returns the rank's (nc, nc) coarse partial — the caller routes
+        its rows to their owners and sparse-adds — or None for the host
+        scipy fallback.  Counted as ``amgx_device_rap_total{path=dist}``.
+        """
+        self._set_budget(budget_bytes)
+        dtype = np.dtype(dtype)
+        try:
+            A_loc = _canon(A_loc)
+            P_ext = _canon(P_ext)
+            P_loc = _canon(P_loc)
+        except Exception:
+            return self._fallback("non-csr", level, component="dist_rap")
+        if A_loc.shape[0] < int(min_rows):
+            return self._fallback("small", level, component="dist_rap")
+        if A_loc.nnz == 0 or P_ext.nnz == 0 or P_loc.nnz == 0:
+            return self._fallback("empty", level, component="dist_rap")
+        gate = self._dtype_gate(dtype)
+        if gate:
+            return self._fallback(gate, level, component="dist_rap")
+        key = ("rapd", csr_structure_fingerprint(A_loc),
+               csr_structure_fingerprint(P_ext),
+               csr_structure_fingerprint(P_loc), dtype.str)
+        if self._budget_rejected(key):
+            return self._fallback("budget", level, component="dist_rap")
+        try:
+            plan = self._get(key)
+            if plan is None:
+                with setup_profile.phase("spgemm", level=level):
+                    plan = spgemm.build_galerkin_plan(A_loc, P_ext,
+                                                      P_left=P_loc)
+                if plan.nbytes > self.budget_bytes * MAX_PLAN_FRACTION:
+                    self._reject(key)
+                    return self._fallback("budget", level,
+                                          component="dist_rap")
+                plan = self._put(key, plan)
+            import jax.numpy as jnp
+            with setup_profile.phase("device_rap", level=level,
+                                     kind="device"):
+                vA = jnp.asarray(A_loc.data, dtype=dtype)
+                vP = jnp.asarray(P_ext.data, dtype=dtype)
+                vAc = spgemm.galerkin_numeric(plan, vA, vP)
+                data = np.asarray(vAc)[:plan.nnz_Ac]
+        except Exception as e:                  # pragma: no cover
+            return self._fallback(f"error:{type(e).__name__}", level,
+                                  component="dist_rap")
+        with self._lock:
+            self.numeric_runs += 1
+        if telemetry.is_enabled():
+            telemetry.counter_inc("amgx_device_rap_total", path="dist")
+            telemetry.counter_inc("amgx_spgemm_total", op="rap_dist")
+        Ac = sp.csr_matrix(
+            (data.astype(dtype), plan.Ac_indices.copy(),
+             plan.Ac_indptr.copy()), shape=plan.Ac_shape)
+        # keep the FULL symbolic pattern (exact-zero slots included):
+        # pruning would make the coarse pattern VALUE-dependent, and a
+        # values-only resetup whose cancellations shift by one ulp
+        # would then miss every downstream plan cache and retrace —
+        # the same keep-pattern contract as the single-device resetup
+        Ac.sort_indices()
+        return Ac
+
     # ------------------------------------------------ aggregation RAP
     def galerkin_agg(self, A_host, agg: np.ndarray, block_dim: int = 1,
                      *, dtype, level=None, min_rows: int = 0,
-                     budget_bytes: Optional[int] = None):
+                     budget_bytes: Optional[int] = None,
+                     agg_cols: Optional[np.ndarray] = None,
+                     shape: Optional[tuple] = None):
         """Device Galerkin for unsmoothed aggregation (R = Sᵀ, P = S):
         one segment-sum over (agg[row], agg[col]) pairs — scalar CSR or
         block BSR.  Returns csr/bsr (host, data device-computed) or
@@ -246,9 +322,24 @@ class DeviceSetupEngine:
         if M.nnz == 0 or len(agg) == 0:
             return self._fallback("empty", level, component="agg_rap")
         agg = np.asarray(agg)
-        nc = int(agg.max()) + 1
+        # rectangular shard-local variant (distributed aggregation RAP:
+        # one rank's row block, LOCAL coarse rows × GLOBAL coarse
+        # columns — the halo-aggregate resolution rides ``agg_cols``)
+        rect = agg_cols is not None
+        if rect and block_dim != 1:
+            return self._fallback("block-dist", level,
+                                  component="agg_rap")
+        if rect:
+            agg_cols = np.asarray(agg_cols)
+            nc, nc_cols = int(shape[0]), int(shape[1])
+        else:
+            nc = nc_cols = int(agg.max()) + 1
         ah = hashlib.blake2b(np.ascontiguousarray(agg).tobytes(),
-                             digest_size=16).hexdigest()
+                             digest_size=16)
+        if rect:
+            ah.update(np.ascontiguousarray(agg_cols).tobytes())
+            ah.update(repr((nc, nc_cols)).encode())
+        ah = ah.hexdigest()
         key = ("agg", csr_structure_fingerprint(M), ah, block_dim,
                dtype.str)
         if self._budget_rejected(key):
@@ -257,7 +348,9 @@ class DeviceSetupEngine:
             plan = self._get(key)
             if plan is None:
                 with setup_profile.phase("spgemm", level=level):
-                    plan = _build_agg_plan(M, agg, nc, block_dim)
+                    plan = _build_agg_plan(M, agg, nc, block_dim,
+                                           agg_cols=agg_cols,
+                                           nc_cols=nc_cols)
                 if plan.nbytes > self.budget_bytes * MAX_PLAN_FRACTION:
                     self._reject(key)
                     return self._fallback("budget", level,
@@ -280,13 +373,21 @@ class DeviceSetupEngine:
         with self._lock:
             self.numeric_runs += 1
         if telemetry.is_enabled():
-            telemetry.counter_inc("amgx_device_rap_total", path="device")
-            telemetry.counter_inc("amgx_spgemm_total", op="agg")
+            telemetry.counter_inc("amgx_device_rap_total",
+                                  path="dist" if rect else "device")
+            telemetry.counter_inc("amgx_spgemm_total",
+                                  op="agg_dist" if rect else "agg")
         if block_dim == 1:
             Ac = sp.csr_matrix(
                 (data.astype(dtype), plan.C_indices.copy(),
-                 plan.C_indptr.copy()), shape=(nc, nc))
-            Ac.eliminate_zeros()
+                 plan.C_indptr.copy()), shape=(nc, nc_cols))
+            if not rect:
+                # the rect/dist partial keeps its FULL pattern (exact
+                # zeros included): pruning would make the distributed
+                # coarse pattern value-dependent and retrace values-only
+                # resetups (see galerkin_dist) — and the host fallback's
+                # coo remap keeps explicit zeros too
+                Ac.eliminate_zeros()
             Ac.sort_indices()
             return Ac
         b = block_dim
@@ -347,20 +448,25 @@ def _agg_numeric_fn(nnz_A: int, nA_b: int, nC_b: int, b: int):
     return go
 
 
-def _build_agg_plan(M, agg: np.ndarray, nc: int,
-                    block_dim: int) -> _AggPlan:
+def _build_agg_plan(M, agg: np.ndarray, nc: int, block_dim: int,
+                    agg_cols: Optional[np.ndarray] = None,
+                    nc_cols: Optional[int] = None) -> _AggPlan:
     """Host symbolic pass of the aggregation Galerkin: the coarse
     pattern and the entry→coarse-slot rank map, from the structure and
-    aggregate ids alone."""
+    aggregate ids alone.  ``agg_cols``/``nc_cols`` split the row/column
+    aggregate maps for the rectangular shard-local (distributed)
+    variant; square when omitted."""
     b = block_dim
     n = M.shape[0] // b
+    ncc = nc if nc_cols is None else int(nc_cols)
     rows = np.repeat(np.arange(n, dtype=np.int64), np.diff(M.indptr))
     ci = agg[rows].astype(np.int64)
-    cj = agg[M.indices].astype(np.int64)
-    key = ci * nc + cj
+    cj = (agg if agg_cols is None else agg_cols)[M.indices] \
+        .astype(np.int64)
+    key = ci * ncc + cj
     ukey, inv = np.unique(key, return_inverse=True)
-    C_rows = (ukey // nc).astype(np.int64)
-    C_indices = (ukey % nc).astype(np.int32)
+    C_rows = (ukey // ncc).astype(np.int64)
+    C_indices = (ukey % ncc).astype(np.int32)
     C_indptr = np.concatenate(
         [[0], np.cumsum(np.bincount(C_rows, minlength=nc))]
     ).astype(np.int64)

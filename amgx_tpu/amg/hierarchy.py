@@ -188,6 +188,13 @@ class AMGHierarchy:
         self.device_setup = int(g("device_setup"))
         self.device_setup_min_rows = int(g("device_setup_min_rows"))
         self.device_setup_cache_mb = int(g("device_setup_cache_mb"))
+        #: coarse-level agglomeration (distributed/agglomerate.py —
+        #: AmgX's shrinking-communicator consolidation, amg.cu:328-390):
+        #: below this many rows per ACTIVE rank a distributed coarse
+        #: level migrates onto a P/factor sub-mesh (0 disables)
+        self.dist_agglomerate_min_rows = int(
+            g("dist_agglomerate_min_rows"))
+        self.dist_agglomerate_factor = int(g("dist_agglomerate_factor"))
         self.levels: List[AMGLevel] = []
         self.coarse_solver = None
         self.coarse_solver_is_smoother = False
@@ -1157,8 +1164,31 @@ class AMGHierarchy:
                                            S_U, nc)
         dtype = np.dtype(blocks[0].dtype)
         P_blocks = [sp.csr_matrix(Pb.astype(dtype)) for Pb in P_blocks]
-        c_blocks, r_blocks = rap_distributed(blocks, P_blocks, part,
-                                             c_off)
+        # shard-local device Galerkin (amg/device_setup/): each rank's
+        # RAP partial runs through the pattern-keyed engine — host scipy
+        # stays the per-rank fallback
+        eng = self._device_setup_engine()
+        c_blocks, r_blocks = rap_distributed(
+            blocks, P_blocks, part, c_off, engine=eng,
+            dtype=self._galerkin_dtype(dtype), level=idx,
+            min_rows=self.device_setup_min_rows,
+            budget_bytes=self.device_setup_cache_mb << 20)
+        c_blocks = [sp.csr_matrix(cb.astype(dtype)) for cb in c_blocks]
+        # coarse-level agglomeration (distributed/agglomerate.py): below
+        # dist_agglomerate_min_rows rows per active rank, migrate the
+        # coarse level onto a shrinking sub-mesh — the redistribution
+        # packs are cached so resetup replays them
+        submesh = None
+        if self.dist_agglomerate_min_rows > 0:
+            from ..distributed.agglomerate import (plan_for,
+                                                   redistribute_blocks)
+            plan = plan_for(c_off, self.dist_agglomerate_min_rows,
+                            self.dist_agglomerate_factor, level=idx)
+            if plan is not None:
+                c_blocks = redistribute_blocks(c_blocks, plan)
+                r_blocks = redistribute_blocks(r_blocks, plan)
+                c_off = np.asarray(plan.dst_offsets)
+                submesh = plan.p_active
         nc_loc = max(int(np.max(np.diff(c_off))), 1)
         Ac = Matrix()
         Ac.set_distributed_blocks(c_blocks, c_off, mesh, axis=axis)
@@ -1173,6 +1203,11 @@ class AMGHierarchy:
             r_blocks, c_off, mesh, axis=axis, dtype=ddtype,
             n_loc=nc_loc, col_offsets=offsets, n_loc_cols=curd.n_loc)
         level = ClassicalLevel(cur, idx, Pd, Rd, None)
+        # the sub-mesh rides the level so cycles, doctor and grid stats
+        # can see which communicator slice a level lives on
+        from ..distributed.agglomerate import active_parts
+        level.submesh_parts = submesh if submesh is not None else \
+            active_parts(c_off)
         return level, Ac, ("classical-dist", (nc,))
 
     def _coarsen_pairwise(self, cur: Matrix, idx: int,
@@ -1345,9 +1380,22 @@ class AMGHierarchy:
 
         # per-rank Galerkin: rank p's coarse rows from rank p's row block;
         # agg_real[halo cols] is the halo-aggregate resolution (multi-host:
-        # one neighbour-wise int exchange)
+        # one neighbour-wise int exchange).  The shard-local device path
+        # (engine.galerkin_agg with split row/column aggregate maps) owns
+        # the hot path; the host coo remap stays the fallback
+        eng = self._device_setup_engine()
+
         def coarse_block(p):
             lo, hi = offsets[p], offsets[p + 1]
+            if eng is not None and hi > lo and blocks[p].nnz:
+                C = eng.galerkin_agg(
+                    blocks[p], agg_real[lo:hi] - coarse_offsets[p],
+                    dtype=self._galerkin_dtype(blocks[p].dtype),
+                    level=idx, min_rows=self.device_setup_min_rows,
+                    budget_bytes=self.device_setup_cache_mb << 20,
+                    agg_cols=agg_real, shape=(counts[p], nc))
+                if C is not None:
+                    return sp.csr_matrix(C.astype(blocks[p].dtype))
             coo = blocks[p].tocoo()
             rows_c = agg_real[coo.row + lo] - coarse_offsets[p]
             cols_c = agg_real[coo.col]
@@ -1362,13 +1410,31 @@ class AMGHierarchy:
         # consolidation ("glue", distributed/glue.h + amg.cu:328-390):
         # when the coarse grid is too small per rank, migrate it onto a
         # SUB-mesh (fewer active ranks) or — when even one rank's worth —
-        # off the mesh entirely (replicated)
+        # off the mesh entirely (replicated).  Two triggers share the
+        # machinery: the legacy matrix_consolidation thresholds, and the
+        # dist_agglomerate_min_rows planner (factor-halving sub-meshes,
+        # distributed/agglomerate.py)
         lower = int(self.cfg.get("matrix_consolidation_lower_threshold"))
+        agg_min = self.dist_agglomerate_min_rows
         n_loc_f = curd.n_loc
+        p_active = None
+        plan = None
         if lower > 0 and nc // n_parts < lower:
+            # legacy consolidation thresholds: pre-planner policy, no
+            # dist_agglomerate lifecycle events
             upper = max(int(self.cfg.get(
                 "matrix_consolidation_upper_threshold")), 1)
             p_active = min(n_parts, max(1, -(-nc // upper)))
+        elif agg_min > 0 and nc // max(n_parts, 1) < agg_min:
+            # the PR-12 planner: cached plans (a values-only resetup
+            # replays the SAME packs — its dist_agglomerate event then
+            # carries reused=1, exactly like the classical path)
+            from ..distributed.agglomerate import plan_for
+            plan = plan_for(coarse_offsets, agg_min,
+                            self.dist_agglomerate_factor, level=idx)
+            if plan is not None:
+                p_active = plan.p_active
+        if p_active is not None:
             if p_active <= 1:
                 # fully consolidated: replicated coarse level
                 Ac_host = sp.csr_matrix(sp.vstack(c_blocks))
@@ -1380,15 +1446,22 @@ class AMGHierarchy:
                         agg_real[lo:hi]
                 level = AggregationLevel(cur, idx, agg_pad, n_coarse=nc,
                                          trash_segment=True)
+                level.submesh_parts = 1
                 return level, Ac, ("aggregation-consolidated",
                                    (agg_real, nc))
             # sub-mesh: re-bucket coarse rows onto the first p_active
             # ranks (equal split); the other ranks hold only padding
-            nc_act = -(-nc // p_active)
-            coarse_offsets = np.concatenate([
-                np.minimum(np.arange(p_active + 1) * nc_act, nc),
-                np.full(n_parts - p_active, nc, dtype=np.int64)])
-            c_blocks = _rebucket_blocks(c_blocks, coarse_offsets)
+            if plan is not None:
+                from ..distributed.agglomerate import \
+                    redistribute_blocks
+                coarse_offsets = np.asarray(plan.dst_offsets)
+                c_blocks = redistribute_blocks(c_blocks, plan)
+            else:
+                nc_act = -(-nc // p_active)
+                coarse_offsets = np.concatenate([
+                    np.minimum(np.arange(p_active + 1) * nc_act, nc),
+                    np.full(n_parts - p_active, nc, dtype=np.int64)])
+                c_blocks = _rebucket_blocks(c_blocks, coarse_offsets)
 
         nc_loc = int(np.max(np.diff(coarse_offsets))) + 1  # ≥1 pad slot
         Ac = Matrix()
@@ -1411,6 +1484,8 @@ class AMGHierarchy:
             agg_pad[p * n_loc_f:(p + 1) * n_loc_f] = row
         level = AggregationLevel(cur, idx, agg_pad,
                                  n_coarse=n_parts * nc_loc)
+        from ..distributed.agglomerate import active_parts
+        level.submesh_parts = active_parts(coarse_offsets)
         return level, Ac, ("aggregation-dist", (agg_real, nc))
 
     def _effective_hierarchy_dtype(self):
@@ -1571,6 +1646,16 @@ class AMGHierarchy:
                         grid_complexity=round(grid_cmpl, 6),
                         setup_s=round(self.setup_time, 6))
 
+    def _materialized_packs(self) -> list:
+        """Per-level device packs WHERE THEY ALREADY EXIST (never
+        triggers an upload as a side effect — ``.Ad`` would), fine to
+        coarsest — the single pack walk behind the cost gauges and the
+        distributed overlap audit."""
+        packs = [l._Ad if l._Ad is not None
+                 else getattr(l.A, "_device", None) for l in self.levels]
+        packs.append(getattr(self.coarsest, "_device", None))
+        return packs
+
     def level_costs(self, sizes=None) -> List[tuple]:
         """(level index, spmv cost dict) per level whose device pack
         already exists, fine to coarsest — the single pack walk behind
@@ -1580,9 +1665,7 @@ class AMGHierarchy:
         from ..telemetry import costmodel
         if sizes is None:
             sizes = self.level_sizes()
-        packs = [l._Ad if l._Ad is not None
-                 else getattr(l.A, "_device", None) for l in self.levels]
-        packs.append(getattr(self.coarsest, "_device", None))
+        packs = self._materialized_packs()
         out = []
         for i, Ad in enumerate(packs):
             if Ad is None:
@@ -1604,6 +1687,7 @@ class AMGHierarchy:
         for name in ("amgx_level_spmv_bytes", "amgx_level_spmv_flops",
                      "amgx_level_padding_waste"):
             reg.gauge_clear(name)
+        self._emit_dist_telemetry(sizes)
         for i, cost in self.level_costs(sizes):
             if cost.get("bytes_per_apply") is not None:
                 # dtype-labeled (mixed precision): a Prometheus consumer
@@ -1619,6 +1703,37 @@ class AMGHierarchy:
                                     cost["padding_waste"], level=i,
                                     dtype=dt)
             telemetry.event("level_cost", level=i, **cost)
+
+    def _emit_dist_telemetry(self, sizes):
+        """Distributed-level overlap audit (telemetry/costmodel.py
+        ``dist_overlap``): one event + gauges per SHARDED level —
+        modelled interior-vs-halo seconds, overlap fraction, and the
+        sub-mesh each level lives on — the doctor's "distributed
+        levels" input.  Silent on single-device hierarchies."""
+        from ..telemetry import costmodel
+        reg = telemetry.registry()
+        reg.gauge_clear("amgx_dist_overlap_fraction")
+        reg.gauge_clear("amgx_dist_submesh_parts")
+        for i, Ad in enumerate(self._materialized_packs()):
+            if Ad is None or getattr(Ad, "fmt", "") != "sharded-ell":
+                continue
+            try:
+                d = costmodel.dist_overlap(
+                    Ad, nnz=sizes[i][1] if i < len(sizes) else None,
+                    level=i)
+            except Exception:
+                continue     # a cost-model gap must never break setup
+            if d is None:
+                continue
+            # the level's layout IS its sub-mesh: active_parts derives
+            # from the (possibly agglomerated) offsets the level's
+            # packs were built against
+            d["submesh_parts"] = d["active_parts"]
+            telemetry.gauge_set("amgx_dist_overlap_fraction",
+                                d["overlap_fraction"], level=i)
+            telemetry.gauge_set("amgx_dist_submesh_parts",
+                                d["submesh_parts"], level=i)
+            telemetry.event("dist_overlap", **d)
 
     def grid_stats(self) -> str:
         """Grid-stats table mirroring the reference README sample output."""

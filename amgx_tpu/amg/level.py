@@ -38,6 +38,14 @@ class AMGLevel:
         self._Ad = None
         self.level_index = level_index
         self.smoother = None
+        #: DISTRIBUTED levels: active ranks of the sub-mesh this level's
+        #: COARSE grid lives on after agglomeration
+        #: (distributed/agglomerate.py — the shrinking-communicator
+        #: consolidation).  Cycles route correction transfers through
+        #: the level's transfer packs, which are built against the
+        #: agglomerated offsets, so recording the sub-mesh here is
+        #: enough for routing; None on single-device levels.
+        self.submesh_parts = None
 
     @property
     def Ad(self):

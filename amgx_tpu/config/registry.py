@@ -341,6 +341,18 @@ def register_default_parameters():
     R("device_setup_cache_mb", int, 256,
       "schedule-byte budget of the pattern-keyed setup-plan cache "
       "(LRU evicts past it; an over-budget single plan falls back)")
+    # pod-scale distributed AMG (distributed/agglomerate.py): coarse
+    # levels below the per-rank row threshold agglomerate onto a
+    # shrinking sub-mesh (P -> P/factor -> ... -> 1) instead of paying
+    # P-way collectives on a few hundred rows per chip — AmgX's
+    # shrinking-communicator consolidation (amg.cu:328-390, glue.h)
+    R("dist_agglomerate_min_rows", int, 0,
+      "rows per ACTIVE rank below which a distributed coarse level "
+      "agglomerates onto a smaller sub-mesh (0 disables; redistribution "
+      "packs are cached and replayed across resetups)")
+    R("dist_agglomerate_factor", int, 2,
+      "sub-mesh shrink factor per agglomeration step "
+      "(P -> P/factor -> ... -> 1)", None, (2, 1 << 16))
     # serving subsystem (amgx_tpu/serve/): request-level concurrency —
     # sessions with a pattern-keyed setup cache, micro-batched multi-RHS
     # solves, bounded-queue admission control

@@ -1,3 +1,5 @@
+from .agglomerate import (AgglomPlan, build_agglomeration, plan_for,
+                          plan_submesh, redistribute_blocks)
 from .matrix import (ShardedMatrix, shard_matrix, dist_spmv, shard_vector,
                      unshard_vector, make_mesh, embed_padded, pad_map)
 from .partition import (Partition, build_partition,
@@ -5,4 +7,6 @@ from .partition import (Partition, build_partition,
 
 __all__ = ["ShardedMatrix", "shard_matrix", "dist_spmv", "shard_vector",
            "unshard_vector", "make_mesh", "embed_padded", "pad_map",
-           "Partition", "build_partition", "partition_offsets_from_vector"]
+           "Partition", "build_partition", "partition_offsets_from_vector",
+           "AgglomPlan", "build_agglomeration", "plan_for",
+           "plan_submesh", "redistribute_blocks"]

@@ -34,6 +34,7 @@ import scipy.sparse as sp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import BadParametersError
+from ..utils.jaxcompat import shard_map as _shard_map
 from .partition import Partition, build_partition
 
 
@@ -153,20 +154,26 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "p") -> Mesh:
     """Build a 1D device mesh in Auto (GSPMD) mode — collectives for the
     Krylov-level algebra are inserted by the partitioner; only the SpMV
     halo exchange is hand-scheduled via shard_map."""
+    from ..utils.jaxcompat import axis_type_auto
     devs = jax.devices()
     n = n_devices or len(devs)
-    return Mesh(np.array(devs[:n]), (axis,),
-                axis_types=(jax.sharding.AxisType.Auto,))
+    auto = axis_type_auto()
+    if auto is None:           # pre-sharding-in-types jax: always GSPMD
+        return Mesh(np.array(devs[:n]), (axis,))
+    return Mesh(np.array(devs[:n]), (axis,), axis_types=(auto,))
 
 
 def _auto_mesh(mesh: Mesh) -> Mesh:
     """Coerce a mesh to Auto axis types (GSPMD) — explicit sharding-in-types
     meshes would demand out_sharding annotations on every contraction."""
-    if all(t == jax.sharding.AxisType.Auto for t in mesh.axis_types):
+    from ..utils.jaxcompat import axis_type_auto
+    auto = axis_type_auto()
+    if auto is None or getattr(mesh, "axis_types", None) is None:
+        return mesh            # pre-sharding-in-types jax: already auto
+    if all(t == auto for t in mesh.axis_types):
         return mesh
     return Mesh(mesh.devices, mesh.axis_names,
-                axis_types=(jax.sharding.AxisType.Auto,) * len(
-                    mesh.axis_names))
+                axis_types=(auto,) * len(mesh.axis_names))
 
 
 def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
@@ -460,17 +467,22 @@ def _tel_exchange(A: "ShardedMatrix", ring: int, op: str):
     wire = _tcost.halo_wire_bytes(A, ring)
     entries = _tcost.halo_entries(A, ring)
     send_idx = A.send_idx if ring == 1 else A.send_idx2
+    # the ACTUAL collective count XLA executes: the all_gather fallback
+    # collapses the whole distance schedule into ONE collective —
+    # reporting len(dists) there overstated what is on the wire program
+    # (the wire BYTES still count every (P-1)-buffer the gather moves)
+    n_coll = 1 if path == "all_gather" else len(dists)
     _tmetrics.counter_inc("amgx_halo_exchange_total", ring=ring, op=op,
                           path=path)
     _tmetrics.counter_inc("amgx_halo_bytes_total", wire, ring=ring,
                           op=op)
     _tmetrics.counter_inc("amgx_halo_entries_total", entries, ring=ring,
                           op=op)
-    _tmetrics.gauge_set("amgx_dist_ring_hops", len(dists), ring=ring)
+    _tmetrics.gauge_set("amgx_dist_ring_hops", n_coll, ring=ring)
     counts = A.halo_counts if ring == 1 else A.halo_counts2
     _trecorder.event(
         "halo_exchange", op=op, ring=ring, path=path,
-        n_parts=A.n_parts, hops=len(dists),
+        n_parts=A.n_parts, hops=n_coll,
         send_buf=int(send_idx.shape[1]),
         wire_bytes=int(wire), entries=int(entries),
         per_rank_entries=None if counts is None else list(counts))
@@ -552,7 +564,7 @@ def exchange_halo(A: ShardedMatrix, x: jax.Array, ring: int = 1
             got = _exchange(buf, dists, axis, A.n_parts)
             return got[hs[0]][None]
 
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh,
             in_specs=(P(axis, None), P(axis, None), P(axis)),
             out_specs=P(axis, None),
@@ -637,7 +649,7 @@ def dist_spmv(A: ShardedMatrix, x: jax.Array) -> jax.Array:
     wv = A.win_vals if A.win_vals is not None else \
         jnp.zeros((n_parts, 1), A.vals.dtype)
     try:
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh,
             in_specs=(P(axis, None, None), P(axis, None, None),
                       P(axis, None), P(axis, None), P(axis, None),
@@ -688,7 +700,7 @@ def _dist_spmv_block(A: ShardedMatrix, x: jax.Array) -> jax.Array:
         return (y0 + yext[:n_loc]).reshape(-1)
 
     try:
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh,
             in_specs=(P(axis, None, None),
                       P(axis, None, None, None, None),

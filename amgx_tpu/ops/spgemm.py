@@ -227,16 +227,27 @@ class GalerkinPlan:
         return self._dev
 
 
-def build_galerkin_plan(A: sp.csr_matrix,
-                        P: sp.csr_matrix) -> GalerkinPlan:
+def build_galerkin_plan(A: sp.csr_matrix, P: sp.csr_matrix,
+                        P_left: Optional[sp.csr_matrix] = None
+                        ) -> GalerkinPlan:
     """Host symbolic pass of the fused ``R·(A·P)`` product.  ``A`` and
     ``P`` must have sorted indices (callers hold CSR in canonical
-    order); only the patterns are read."""
-    n, nc = P.shape
+    order); only the patterns are read.
+
+    ``P_left``: the transpose (left) factor when it differs from ``P``
+    — the DISTRIBUTED shard-local partial ``P_locᵀ·(A_loc·P_ext)``,
+    where ``A_loc`` is one rank's rectangular row block over its
+    [local | halo] column space and ``P_ext = vstack([P_loc, halo'd P
+    rows])``.  Contract: ``P_left``'s rows must be exactly the leading
+    rows of ``P`` (so ``P_left.data`` is a prefix of ``P.data`` and the
+    recorded transpose permutation indexes the shared value buffer) and
+    ``P_left.shape[0] == A.shape[0]``."""
+    n_out = A.shape[0]
+    nc = P.shape[1]
     tA, tP, to1, APptr, APind = spgemm_symbolic(
-        A.indptr, A.indices, P.indptr, P.indices, n, nc)
+        A.indptr, A.indices, P.indptr, P.indices, n_out, nc)
     nnz_AP = len(APind)
-    perm_RP, R = transpose_perm(P)
+    perm_RP, R = transpose_perm(P if P_left is None else P_left)
     tR, tAP, to2, Acptr, Acind = spgemm_symbolic(
         R.indptr, R.indices, APptr, APind, nc, nc)
     nnz_Ac = len(Acind)

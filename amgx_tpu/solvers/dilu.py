@@ -30,6 +30,7 @@ from ..coloring import color_matrix
 from ..core.matrix import Matrix, pack_device
 from ..errors import BadConfigurationError
 from ..ops.spmv import spmv
+from ..utils.jaxcompat import shard_map as _shard_map
 from .base import Solver, register_solver
 from .jacobi import _apply_dinv
 
@@ -342,7 +343,7 @@ class MulticolorDILUSolver(Solver):
         in_specs = (jax.tree_util.tree_map(spec, self._dist_L),
                     jax.tree_util.tree_map(spec, self._dist_U),
                     P(axis), P(axis))
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh, in_specs=in_specs, out_specs=P(axis),
             check_vma=False,
         )(self._dist_L, self._dist_U, self.Einv, r)
@@ -379,7 +380,7 @@ class MulticolorDILUSolver(Solver):
         in_specs = (jax.tree_util.tree_map(spec, self._dist_L),
                     jax.tree_util.tree_map(spec, self._dist_U),
                     P(axis), P(axis))
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh, in_specs=in_specs, out_specs=P(axis),
             check_vma=False,
         )(self._dist_L, self._dist_U, self.Einv, r)

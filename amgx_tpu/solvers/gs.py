@@ -20,6 +20,7 @@ import numpy as np
 
 from ..coloring import color_matrix
 from ..ops.spmv import spmv
+from ..utils.jaxcompat import shard_map as _shard_map
 from .base import Solver, register_solver
 from .jacobi import _apply_dinv, setup_dinv
 
@@ -333,7 +334,7 @@ class MulticolorGSSolver(_ColoredSmootherBase):
             return xe[:n_loc]
 
         spec2 = P(axis, None)
-        return jax.shard_map(
+        return _shard_map(
             local, mesh=A.mesh,
             in_specs=(P(axis, None, None), P(axis, None, None),
                       spec2, spec2, [spec2] * len(self.dist_slab_rows),

@@ -176,6 +176,57 @@ def halo_entries(A, ring: int = 1) -> int:
     return A.n_parts * halo_src.shape[1]
 
 
+# ------------------------------------------------- distributed overlap
+def dist_overlap(Ad, nnz: Optional[int] = None,
+                 level: Optional[int] = None) -> Optional[dict]:
+    """Static interior-vs-halo audit of one sharded level — the
+    ``dist_overlap`` cost-model event.
+
+    Models what the interior/boundary split (``multiply.cu:75-196``)
+    can actually hide: per-device interior-SpMV seconds (local bytes ÷
+    HBM peak, shards stream concurrently) vs per-device halo seconds
+    (this shard's wire bytes ÷ ICI peak).  ``overlap_fraction`` is the
+    fraction of the halo exchange hideable under the interior compute
+    (1.0 = fully hidden); ``halo_bound`` flags levels where the halo
+    DOMINATES even with perfect overlap — exactly the levels the
+    agglomeration threshold (``dist_agglomerate_min_rows``) exists for.
+    Host-side shape arithmetic only; None for non-sharded packs.
+    """
+    if getattr(Ad, "fmt", "") != "sharded-ell":
+        return None
+    from ..distributed.agglomerate import active_parts
+    c = spmv_cost(Ad, nnz=nnz)
+    P = int(Ad.n_parts)
+    offs = np.asarray(Ad.offsets) if Ad.offsets is not None else None
+    active = active_parts(offs) if offs is not None else P
+    active = max(active, 1)
+    rows = int(offs[-1]) if offs is not None else P * Ad.n_loc
+    local_bytes = int(c.get("bytes_per_apply") or 0)
+    wire = int(c.get("halo_bytes_per_apply") or 0)
+    # per-device: shards run concurrently, so one device's time is its
+    # 1/P share of the mesh-wide byte totals
+    est_interior_s = local_bytes / P / (HBM_PEAK_GBS * 1e9)
+    est_halo_s = wire / P / (ICI_PEAK_GBS * 1e9)
+    if est_halo_s <= 0:
+        overlap = 1.0
+    else:
+        overlap = min(est_interior_s / est_halo_s, 1.0)
+    out = {
+        "n_parts": P, "active_parts": active,
+        "rows": rows, "rows_per_part": rows // active,
+        "interior_bytes": local_bytes, "halo_wire_bytes": wire,
+        "halo_local_ratio": (round(wire / local_bytes, 4)
+                             if local_bytes else None),
+        "est_interior_s": round(est_interior_s, 9),
+        "est_halo_s": round(est_halo_s, 9),
+        "overlap_fraction": round(overlap, 4),
+        "halo_bound": bool(est_halo_s > est_interior_s),
+    }
+    if level is not None:
+        out["level"] = int(level)
+    return out
+
+
 # ------------------------------------------------------------- rollups
 def hierarchy_cost(levels_costs) -> dict:
     """Roll per-level descriptors (one :func:`spmv_cost` dict per

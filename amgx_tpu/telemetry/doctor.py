@@ -226,6 +226,19 @@ def diagnose(paths: List[str]) -> dict:
     exchanges, _ = csum("amgx_halo_exchange_total")
     bnd = {str(_label_get(lk, "device")): v
            for lk, v in glast("amgx_dist_boundary_fraction").items()}
+    # per-level overlap audit + agglomeration lifecycle (PR 12:
+    # costmodel.dist_overlap events + distributed/agglomerate.py)
+    dist_levels: Dict[str, dict] = {}
+    agglomerations: List[dict] = []
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] != "event":
+                continue
+            if r["name"] == "dist_overlap":
+                dist_levels[str(r["attrs"].get("level"))] = \
+                    dict(r["attrs"])
+            elif r["name"] == "dist_agglomerate":
+                agglomerations.append(dict(r["attrs"]))
     local_bytes = sum(float(d.get("bytes_per_apply") or 0)
                       for d in levels.values())
     if not local_bytes and op_cost:
@@ -472,6 +485,25 @@ def diagnose(paths: List[str]) -> dict:
             f"halo exchange moves {halo_local_ratio:.2f}× the local "
             "SpMV bytes — the solve is communication-bound; consider "
             "fewer, fatter shards or overlapping more work")
+    halo_bound = [d for d in dist_levels.values()
+                  if d.get("halo_bound")]
+    if halo_bound:
+        worst = max(int(d.get("rows_per_part") or 0)
+                    for d in halo_bound)
+        if agglomerations:
+            hints.append(
+                f"{len(halo_bound)} distributed level(s) remain "
+                "halo-bound after agglomeration — raise "
+                f"dist_agglomerate_min_rows above {worst} rows/device "
+                "so they land on a smaller sub-mesh")
+        else:
+            hints.append(
+                f"{len(halo_bound)} distributed level(s) are "
+                "halo-bound (modelled halo time exceeds the interior "
+                "SpMV even with perfect overlap) — set "
+                f"dist_agglomerate_min_rows above {worst} rows/device "
+                "to agglomerate those levels onto a shrinking "
+                "sub-mesh")
     if plateau:
         hints.append(
             f"residual plateaued for {plateau['iterations']} iterations "
@@ -584,6 +616,8 @@ def diagnose(paths: List[str]) -> dict:
                                     for k, v in sorted(halo_by.items())},
             "boundary_fraction": bnd,
             "halo_local_ratio": halo_local_ratio,
+            "levels": dist_levels,
+            "agglomerations": agglomerations,
         },
         "serving": serving,
         "serving_lanes": lanes_diag,
@@ -920,6 +954,32 @@ def render(d: dict) -> str:
                      f"{dist['halo_local_ratio']:.3f}")
         for dev, f in sorted(dist["boundary_fraction"].items()):
             L.append(f"  boundary fraction [device {dev}]: {f:.3f}")
+
+    if dist.get("levels"):
+        L.append("")
+        L.append("distributed levels (sub-mesh + overlap audit)")
+        L.append("-" * 40)
+        L.append(f"  {'lvl':<4}{'parts':>6}{'rows/part':>11}"
+                 f"{'halo:local':>11}{'overlap':>9}  flag")
+        for lvl, x in sorted(dist["levels"].items(),
+                             key=lambda kv: int(kv[0])
+                             if str(kv[0]).isdigit() else 99):
+            ratio = x.get("halo_local_ratio")
+            L.append(
+                f"  {lvl:<4}"
+                f"{int(x.get('submesh_parts') or 0):>6}"
+                f"{int(x.get('rows_per_part') or 0):>11}"
+                + (f"{ratio:>11.3f}" if isinstance(ratio, (int, float))
+                   else f"{'?':>11}")
+                + f"{x.get('overlap_fraction', 0):>9.2f}"
+                + ("  HALO-BOUND" if x.get("halo_bound") else ""))
+        for a in dist.get("agglomerations", []):
+            L.append(
+                f"  agglomerated level {a.get('level')}: "
+                f"{a.get('from_parts')} -> {a.get('to_parts')} rank(s)"
+                f" ({a.get('rows')} rows"
+                + (", replicated" if a.get("replicated") else "")
+                + (", pack reused" if a.get("reused") else "") + ")")
 
     srv = d.get("serving")
     if srv:

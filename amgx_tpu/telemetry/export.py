@@ -206,6 +206,32 @@ def validate_record(rec: dict):
             if rec["name"] == "level_cost":
                 need(isinstance(a.get("level"), int),
                      "level_cost event missing integer level")
+        if rec["name"] == "dist_overlap":
+            # the distributed-level overlap audit is the doctor's
+            # "distributed levels" input (costmodel.dist_overlap)
+            a = rec["attrs"]
+            need(isinstance(a.get("level"), int),
+                 "dist_overlap event missing integer level")
+            need(isinstance(a.get("n_parts"), int) and a["n_parts"] >= 1,
+                 "dist_overlap event missing n_parts")
+            need(isinstance(a.get("submesh_parts"), int),
+                 "dist_overlap event missing submesh_parts")
+            for k in ("est_interior_s", "est_halo_s",
+                      "overlap_fraction"):
+                need(isinstance(a.get(k), (int, float)),
+                     f"dist_overlap event missing numeric {k}")
+            need(isinstance(a.get("halo_bound"), bool),
+                 "dist_overlap event missing halo_bound bool")
+        if rec["name"] == "dist_agglomerate":
+            # agglomeration decisions (distributed/agglomerate.py):
+            # the doctor's sub-mesh lifecycle input
+            a = rec["attrs"]
+            for k in ("from_parts", "to_parts", "rows"):
+                need(isinstance(a.get(k), int),
+                     f"dist_agglomerate event missing integer {k}")
+            need(a["to_parts"] >= 1
+                 and a["to_parts"] <= a["from_parts"],
+                 "dist_agglomerate event has non-shrinking parts")
         if rec["name"] == "device_setup_fallback":
             # fallback events are the doctor's per-level "why did rap
             # run host-side" input (amg/device_setup/)

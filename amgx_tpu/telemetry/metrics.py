@@ -70,7 +70,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_dist_halo_entries":
         ("gauge", "ring-1 halo width of one shard {device}"),
     "amgx_dist_ring_hops":
-        ("gauge", "ppermute hop count of the ring schedule {ring}"),
+        ("gauge", "collectives one halo exchange executes: ppermute "
+                  "hops of the ring schedule, or 1 on the all_gather "
+                  "fallback {ring}"),
+    # ---- pod-scale distributed AMG (distributed/agglomerate.py +
+    # costmodel.dist_overlap; PR 12) ---------------------------------
+    "amgx_dist_agglomerate_total":
+        ("counter", "coarse-level agglomerations planned onto a "
+                    "shrinking sub-mesh {reused=0|1}"),
+    "amgx_dist_submesh_parts":
+        ("gauge", "active ranks of the sub-mesh one distributed "
+                  "hierarchy level lives on {level}"),
+    "amgx_dist_overlap_fraction":
+        ("gauge", "modelled fraction of one level's halo exchange "
+                  "hideable under its interior SpMV (1 = fully "
+                  "hidden) {level}"),
     # ---- convergence forensics (telemetry/forensics.py) ------------
     "amgx_forensics_nullspace":
         ("gauge", "near-nullspace preservation |A*1|inf/|A|inf of one "

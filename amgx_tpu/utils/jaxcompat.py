@@ -41,6 +41,38 @@ except ImportError:      # pragma: no cover - depends on the jax version
         return False
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax releases: the public symbol (with
+    its ``check_vma`` knob) when present, else the older
+    ``jax.experimental.shard_map.shard_map`` (whose equivalent knob is
+    ``check_rep``; disabled — the callers that need the escape hatch
+    wrap pallas_calls whose out_shapes carry no rep/vma annotation).
+    The distributed tier (sharded SpMV, halo exchange, slab smoothers)
+    routes every shard_map through here so one jax upgrade or downgrade
+    never strands the whole tier."""
+    import jax
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs,
+                                 check_vma=check_vma)
+        except TypeError:      # releases where the knob is check_rep
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
+
+
+def axis_type_auto():
+    """``jax.sharding.AxisType.Auto`` on modern jax; None on releases
+    without sharding-in-types (their meshes are implicitly GSPMD/auto,
+    which is exactly the mode the distributed layer wants)."""
+    import jax
+    at = getattr(jax.sharding, "AxisType", None)
+    return None if at is None else at.Auto
+
+
 _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 _CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"

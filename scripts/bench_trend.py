@@ -77,6 +77,13 @@ CASES = (
     ("agg_rps", _x(("extras", "serving", "scaling", "agg_rps"))),
     ("steal%", lambda d: _pct(_x(
         ("extras", "serving", "scaling", "multi", "steal_frac"))(d))),
+    # pod-scale distributed weak scaling (ISSUE 12): part count,
+    # 8-part weak-scaling efficiency, and the 8-part fine level's
+    # halo-vs-local byte fraction; pre-PR-12 rounds render "-"
+    ("parts", _x(("extras", "distributed", "parts_max"))),
+    ("weak_eff", _x(("extras", "distributed", "weak_eff_8"))),
+    ("halo%", lambda d: _pct(_x(
+        ("extras", "distributed", "halo_frac_8"))(d))),
 )
 
 
@@ -131,7 +138,13 @@ def _extract_parsed(rec: dict):
 
 def _error_kind(rec: dict, parsed) -> str:
     if isinstance(parsed, dict) and parsed.get("error_kind"):
-        return str(parsed["error_kind"])
+        kind = str(parsed["error_kind"])
+        # bench retried the backend init once before giving up: the
+        # round is FLAKY (worker briefly down twice) rather than a
+        # dead environment that never answered
+        if parsed.get("retried"):
+            kind += " (retried once)"
+        return kind
     tail = str(rec.get("tail", ""))
     if "UNAVAILABLE" in tail or "Unable to initialize backend" in tail:
         return "device_unavailable"
@@ -169,6 +182,7 @@ def load_rounds(repo_dir: str):
             continue
         out.append({"round": rnd, "usable": True,
                     "metric": parsed.get("metric"),
+                    "retried": bool(parsed.get("retried")),
                     "values": {label: fn(parsed)
                                for label, fn in CASES},
                     "setup_profile": _setup_detail(parsed),
@@ -209,7 +223,8 @@ def render(rounds) -> str:
             v = r["values"].get(label)
             cells.append((f"{v:.4g}" if isinstance(v, (int, float))
                           else "-").rjust(widths[label]))
-        L.append(f"r{r['round']:<6} " + "  ".join(cells))
+        L.append(f"r{r['round']:<6} " + "  ".join(cells)
+                 + ("  [init retried]" if r.get("retried") else ""))
         # setup-attribution annotation (rounds run with
         # AMGX_BENCH_SETUP_PROFILE=1): top phases + compile share per
         # profiled case; older rounds simply have no line

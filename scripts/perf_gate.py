@@ -69,12 +69,18 @@ FLOOR_SLACK = 0.05
 #: single-lane under the same overload wave — falling below the pinned
 #: 3.0× floor means the executor lanes stopped scaling, whatever the
 #: absolute numbers did)
+#: weak_eff is a SCALING metric from the bench ``distributed`` block
+#: (ISSUE 12: 8-part weak-scaling efficiency of the classical
+#: distributed stack at fixed rows/device on the forced 8-device CPU
+#: mesh — a pinned floor, not a ratcheted measurement: falling below
+#: it means the pod-scale path stopped scaling)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
            ("cold_start_s", "time"), ("warm_start_s", "time"),
            ("serve_p99_s", "time"), ("rejection_rate", "rate"),
            ("bf16_effective_speedup", "floor"),
-           ("lane_speedup", "scaling"))
+           ("lane_speedup", "scaling"),
+           ("weak_eff", "scaling"))
 
 
 def _extract_parsed(rec: dict):
@@ -138,10 +144,12 @@ def load_round(path: str) -> dict:
                           "solve_s": parsed.get("value"),
                           "iterations": extras.get("iterations")}}
     for name, d in extras.items():
-        # telemetry/serving are per-round observability blocks, not
-        # solve cases — their numeric fields must not become baselines
+        # telemetry/serving/distributed are per-round observability
+        # blocks, not solve cases — their numeric fields must not
+        # become baselines (distributed feeds the gate through its
+        # weak_eff floor below)
         if not isinstance(d, dict) or "error" in d or \
-                name in ("telemetry", "serving",
+                name in ("telemetry", "serving", "distributed",
                          "spmv_gflops_by_format"):
             continue
         vals = {k: d.get(k) for k, _ in TRACKED
@@ -171,6 +179,14 @@ def load_round(path: str) -> dict:
             and sc.get("lanes") == 4 \
             and isinstance(sc.get("speedup"), (int, float)):
         cases["scaling"] = {"lane_speedup": sc["speedup"]}
+    # pod-scale distributed weak scaling (ISSUE 12): only a full
+    # 8-part measurement feeds the gate — the pinned floor is an
+    # 8-part contract
+    ds = extras.get("distributed")
+    if isinstance(ds, dict) and "error" not in ds \
+            and ds.get("parts_max") == 8 \
+            and isinstance(ds.get("weak_eff_8"), (int, float)):
+        cases["distributed"] = {"weak_eff": ds["weak_eff_8"]}
     return cases
 
 

@@ -663,12 +663,113 @@ def main():
         fail(f"doctor flagged imbalance on a balanced fleet: "
              f"{diag_bal.get('hints')}")
 
+    # 15. pod-scale distributed AMG (ISSUE 12): a real distributed
+    # classical solve (child process on the forced 8-device CPU mesh —
+    # the parent's jax backend is already initialised single-device)
+    # emits schema-valid dist_overlap / dist_agglomerate /
+    # halo_exchange events, and the doctor renders the "distributed
+    # levels" section; then the halo-bound hint BOTH WAYS on synthetic
+    # traces (bound trace fires it, balanced trace stays silent)
+    import subprocess
+    path_dd = path + ".dist"
+    if os.path.exists(path_dd):
+        os.unlink(path_dd)
+    env_d = dict(os.environ, JAX_PLATFORMS="cpu")
+    env_d["XLA_FLAGS"] = (env_d.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+    r_d = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--dist-child",
+         path_dd], env=env_d, capture_output=True, text=True,
+        timeout=900)
+    if r_d.returncode != 0:
+        fail(f"distributed child failed rc={r_d.returncode}:\n"
+             f"{r_d.stderr[-2000:]}")
+    with open(path_dd) as f:
+        lines_dd = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_dd)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"distributed trace: {e}")
+    recs_dd = [json.loads(l) for l in lines_dd if l.strip()]
+    ov_dd = [r["attrs"] for r in recs_dd if r["kind"] == "event"
+             and r["name"] == "dist_overlap"]
+    ag_dd = [r["attrs"] for r in recs_dd if r["kind"] == "event"
+             and r["name"] == "dist_agglomerate"]
+    if not ov_dd:
+        fail("distributed trace has no dist_overlap events")
+    if not ag_dd:
+        fail("distributed trace has no dist_agglomerate events "
+             "(the child's threshold should have triggered)")
+    if not any(a["to_parts"] < a["from_parts"] for a in ag_dd):
+        fail(f"dist_agglomerate events never shrink the mesh: {ag_dd}")
+    if not any(a.get("submesh_parts", 99) < a.get("n_parts", 0)
+               for a in ov_dd):
+        fail(f"no dist_overlap event shows an agglomerated sub-mesh: "
+             f"{[(a.get('level'), a.get('submesh_parts')) for a in ov_dd]}")
+    if not any(r["kind"] == "counter"
+               and r["name"] == "amgx_device_rap_total"
+               and r["labels"].get("path") == "dist"
+               for r in recs_dd):
+        fail("distributed trace never counted "
+             "amgx_device_rap_total{path=dist} — the shard-local "
+             "device Galerkin did not run")
+    diag_dd = doctor.diagnose([path_dd])
+    if not diag_dd["distributed"].get("levels"):
+        fail("doctor diagnose has no distributed levels for the "
+             "distributed trace")
+    if not diag_dd["distributed"].get("agglomerations"):
+        fail("doctor diagnose lost the dist_agglomerate events")
+    rep_dd = doctor.render(diag_dd)
+    if "distributed levels" not in rep_dd or \
+            "agglomerated level" not in rep_dd:
+        fail("doctor report is missing the distributed-levels section")
+    # the halo-bound hint, both ways: a bound level fires it …
+    telemetry.reset()
+    telemetry.disable()
+    path_db = path + ".dist_bound"
+    if os.path.exists(path_db):
+        os.unlink(path_db)
+    telemetry.enable(ring_size=4096)
+    telemetry.event("dist_overlap", level=2, n_parts=8,
+                    active_parts=8, submesh_parts=8, rows=256,
+                    rows_per_part=32, interior_bytes=10000,
+                    halo_wire_bytes=90000, halo_local_ratio=9.0,
+                    est_interior_s=1e-8, est_halo_s=6e-8,
+                    overlap_fraction=0.17, halo_bound=True)
+    telemetry.flush_jsonl(path_db)
+    telemetry.disable()
+    diag_db = doctor.diagnose([path_db])
+    if not any("dist_agglomerate_min_rows" in h
+               for h in diag_db.get("hints", ())):
+        fail(f"doctor did not recommend the agglomeration threshold "
+             f"for a halo-bound level: {diag_db.get('hints')}")
+    # … while a balanced trace stays silent
+    telemetry.reset()
+    path_dbal = path + ".dist_bal"
+    if os.path.exists(path_dbal):
+        os.unlink(path_dbal)
+    telemetry.enable(ring_size=4096)
+    telemetry.event("dist_overlap", level=0, n_parts=8,
+                    active_parts=8, submesh_parts=8, rows=200000,
+                    rows_per_part=25000, interior_bytes=9000000,
+                    halo_wire_bytes=90000, halo_local_ratio=0.01,
+                    est_interior_s=1e-5, est_halo_s=6e-8,
+                    overlap_fraction=1.0, halo_bound=False)
+    telemetry.flush_jsonl(path_dbal)
+    telemetry.disable()
+    diag_dbal = doctor.diagnose([path_dbal])
+    if any("dist_agglomerate_min_rows" in h
+           for h in diag_dbal.get("hints", ())):
+        fail(f"doctor recommended agglomeration for a balanced trace: "
+             f"{diag_dbal.get('hints')}")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
-          f"serving-obs OK, mixed-precision OK, serving-lanes OK)")
+          f"serving-obs OK, mixed-precision OK, serving-lanes OK, "
+          f"distributed OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -681,7 +782,43 @@ def main():
         os.unlink(path_l)
         os.unlink(path_li)
         os.unlink(path_lb)
+        os.unlink(path_dd)
+        os.unlink(path_db)
+        os.unlink(path_dbal)
+
+
+def dist_child(trace_path: str) -> int:
+    """Section-15 child: one distributed classical solve on the forced
+    8-device CPU mesh with agglomeration + shard-local device Galerkin
+    active, streaming its trace to ``trace_path``."""
+    import numpy as np
+
+    import amgx_tpu as amgx
+    from amgx_tpu.distributed.matrix import make_mesh, shard_vector
+    from amgx_tpu.io import poisson7pt
+
+    mesh = make_mesh(8)
+    A = poisson7pt(10, 10, 10)
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=60, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+        "amg:interpolator=D1, amg:max_iters=1, amg:max_row_sum=0.9, "
+        "amg:max_levels=6, amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+        "amg:presweeps=1, amg:postsweeps=1, amg:min_coarse_rows=8, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, determinism_flag=1, "
+        "device_setup_min_rows=0, dist_agglomerate_min_rows=64, "
+        f"out:telemetry=1, out:telemetry_path={trace_path}")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    res = slv.solve(shard_vector(m.device(), np.ones(A.shape[0])))
+    return 0 if int(res.status) == 0 else 3
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--dist-child":
+        sys.exit(dist_child(sys.argv[2]))
     main()

@@ -1,0 +1,260 @@
+"""Pod-scale distributed AMG (ISSUE 12) on the 8-device virtual CPU
+mesh: coarse-level agglomeration onto shrinking sub-meshes
+(distributed/agglomerate.py), shard-local device Galerkin
+(engine.galerkin_dist — ``amgx_device_rap_total{path=dist}``), the
+dist_overlap audit, and the all_gather collective-count fix."""
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.distributed.agglomerate import (agglomeration_stats,
+                                              build_agglomeration,
+                                              plan_for, plan_submesh,
+                                              redistribute_blocks,
+                                              reset_plans)
+from amgx_tpu.distributed.matrix import (make_mesh, shard_matrix,
+                                         shard_vector, unshard_vector)
+from amgx_tpu.io import poisson5pt, poisson7pt
+
+_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=60, "
+    "out:monitor_residual=1, out:tolerance=1e-10, "
+    "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+    "amg:algorithm=CLASSICAL, amg:selector=PMIS, amg:interpolator=D1, "
+    "amg:max_iters=1, amg:max_row_sum=0.9, amg:max_levels=6, "
+    "amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, amg:presweeps=1, "
+    "amg:postsweeps=1, amg:min_coarse_rows=8, "
+    "amg:coarse_solver=DENSE_LU_SOLVER, determinism_flag=1, "
+    "device_setup_min_rows=0")
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_caches():
+    reset_plans()
+    yield
+
+
+# ------------------------------------------------------------- planner
+def test_plan_submesh_thresholds():
+    # shrink by factor until every active rank holds >= min_rows
+    assert plan_submesh(1000, 8, 64) == 8          # 125/rank: fine
+    assert plan_submesh(400, 8, 64) == 4           # 50 -> 100/rank
+    assert plan_submesh(100, 8, 64) == 1           # collapses to one
+    assert plan_submesh(100, 8, 64, factor=4) == 1
+    assert plan_submesh(500, 8, 64, factor=4) == 2  # 8 -> 2: 250/rank
+    assert plan_submesh(7, 8, 64) == 1
+
+
+def test_build_agglomeration_packs_and_redistribute(rng):
+    src = np.array([0, 25, 50, 75, 100, 100, 100, 100, 100])
+    plan = build_agglomeration(src, min_rows=60, factor=2)
+    assert plan is not None and plan.p_active == 1
+    assert plan.replicated
+    assert plan.dst_offsets[:2] == (0, 100)
+    # no-op cases: satisfied threshold / already one rank / disabled
+    assert build_agglomeration([0, 100, 200], min_rows=50) is None
+    assert build_agglomeration([0, 100, 100], min_rows=500) is None
+    assert build_agglomeration([0, 80, 160], min_rows=0) is None
+
+    # redistribution packs reproduce a plain row re-split exactly
+    M = sp.random(100, 40, density=0.2,
+                  random_state=np.random.RandomState(3), format="csr")
+    blocks = [sp.csr_matrix(M[src[p]:src[p + 1]]) for p in range(8)]
+    plan2 = build_agglomeration(src, min_rows=30, factor=2)
+    assert plan2 is not None and plan2.p_active == 2
+    out = redistribute_blocks(blocks, plan2)
+    assert len(out) == 8
+    dst = np.asarray(plan2.dst_offsets)
+    for q in range(8):
+        want = M[dst[q]:dst[q + 1]]
+        assert abs(out[q] - want).max() == 0 if want.shape[0] else \
+            out[q].shape[0] == 0
+
+
+def test_plan_cache_replays_packs():
+    src = tuple(np.arange(9) * 40)     # 320 rows over 8 ranks
+    p1 = plan_for(src, min_rows=128, factor=2)
+    st = agglomeration_stats()
+    assert p1 is not None and st["misses"] == 1 and st["hits"] == 0
+    p2 = plan_for(src, min_rows=128, factor=2)
+    st = agglomeration_stats()
+    assert p2 is p1                    # the SAME packs, replayed
+    assert st["hits"] == 1
+
+
+# -------------------------------------------- end-to-end agglomeration
+def test_classical_agglomerates_below_threshold(mesh):
+    """A classical hierarchy that previously kept all 8 parts to the
+    coarsest level now agglomerates below dist_agglomerate_min_rows;
+    the shard-local device Galerkin owns every distributed RAP."""
+    A = poisson7pt(10, 10, 10)
+    n = A.shape[0]
+    b = np.ones(n)
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        _CFG + ", dist_agglomerate_min_rows=64"))
+    with telemetry.capture() as cap:
+        slv.setup(m)
+    h = slv.preconditioner.hierarchy
+    # each level records the sub-mesh its COARSE grid landed on
+    subs = [lvl.submesh_parts for lvl in h.levels]
+    assert any(s is not None and s < 8 for s in subs), subs
+    # the coarsest level collapsed off the 8-way communicator
+    c_off = np.asarray(h.coarsest.dist[2])
+    assert int(np.sum(np.diff(c_off) > 0)) == 1, c_off
+    evs = [e["attrs"] for e in cap.events("dist_agglomerate")]
+    assert evs and all(e["to_parts"] < e["from_parts"] for e in evs)
+    # shard-local device Galerkin replaced host scipy RAP everywhere
+    paths = cap.counter_totals("amgx_device_rap_total", label="path")
+    assert paths.get("dist", 0) > 0, paths
+    assert paths.get("host", 0) == 0, paths
+    # per-level sub-mesh sizes ride the dist_overlap audit: the fine
+    # level keeps the full mesh, agglomerated levels show the shrink
+    ov = [e["attrs"] for e in cap.events("dist_overlap")]
+    assert ov and ov[0]["submesh_parts"] == 8
+    assert any(d["submesh_parts"] < d["n_parts"] for d in ov)
+    res = slv.solve(shard_vector(m.device(), b))
+    x = unshard_vector(m.device(), np.asarray(res.x))
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-8
+
+
+def test_agglomerated_matches_non_agglomerated(mesh):
+    """Agglomeration moves rows, not values: the agglomerated solve
+    reproduces the full-mesh 8-part solve (same iteration count, same
+    answer to fp-roundoff of the re-grouped row sums)."""
+    A = poisson7pt(10, 10, 10)
+    n = A.shape[0]
+    b = np.sin(np.arange(n))
+
+    def run(extra):
+        m = amgx.Matrix(A)
+        m.set_distribution(mesh)
+        slv = amgx.create_solver(amgx.AMGConfig(_CFG + extra))
+        slv.setup(m)
+        res = slv.solve(shard_vector(m.device(), b))
+        return res, unshard_vector(m.device(), np.asarray(res.x))
+
+    r1, x1 = run("")
+    r2, x2 = run(", dist_agglomerate_min_rows=64")
+    assert int(r1.iterations) == int(r2.iterations)
+    np.testing.assert_allclose(x1, x2, rtol=1e-12, atol=1e-12)
+
+
+def test_values_only_resetup_reuses_packs_zero_retraces(mesh):
+    """Values-only ``replace_coefficients`` on a sharded hierarchy
+    replays the cached redistribution packs and shard-local Galerkin
+    plans with ZERO retraces (the jax.monitoring counter — same
+    contract as test_device_setup).  Power-of-two scalings keep f64
+    arithmetic exact, so every recomputed pattern is bit-identical and
+    the plan caches must hit across the board."""
+    from amgx_tpu.amg.device_setup.engine import engine
+    A = poisson7pt(10, 10, 10)
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        _CFG + ", dist_agglomerate_min_rows=64"))
+    slv.setup(m)
+
+    def refreshed(scale):
+        m2 = amgx.Matrix(A)
+        m2.replace_coefficients(A.data * scale)
+        m2.set_distribution(mesh)
+        return m2
+
+    slv.resetup(refreshed(2.0))      # warm: pad/numeric fns trace once
+    eng = engine()
+    st0 = eng.stats()
+    agg0 = agglomeration_stats()
+    with telemetry.capture() as cap:
+        slv.resetup(refreshed(4.0))
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    st1 = eng.stats()
+    agg1 = agglomeration_stats()
+    assert st1["misses"] == st0["misses"]        # no new symbolic plans
+    assert st1["hits"] > st0["hits"]             # ... only replays
+    assert agg1["hits"] > agg0["hits"]           # packs replayed
+    assert agg1["misses"] == agg0["misses"]
+    b = np.ones(A.shape[0])
+    res = slv.solve(shard_vector(m.device(), b))
+    x = unshard_vector(m.device(), np.asarray(res.x))
+    rr = np.linalg.norm(b - 4.0 * (A @ x)) / np.linalg.norm(b)
+    assert rr < 1e-8
+
+
+# ------------------------------------------------ telemetry satellites
+def test_all_gather_reports_one_collective(rng):
+    """Satellite fix: on the all_gather fallback the exchange executes
+    ONE collective — amgx_dist_ring_hops and the event's hops must say
+    1, not the collapsed ppermute distance count."""
+    from amgx_tpu.distributed.matrix import dist_spmv, uses_all_gather
+    mesh2 = make_mesh(2)
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    sm = shard_matrix(A, mesh2)
+    assert uses_all_gather(sm.dists, sm.n_parts)
+    x = shard_vector(sm, rng.standard_normal(A.shape[0]))
+    with telemetry.capture() as cap:
+        y = jax.jit(lambda v: dist_spmv(sm, v))(x)
+        y.block_until_ready()
+    assert cap.gauge_last("amgx_dist_ring_hops", ring=1) == 1
+    (ev,) = cap.events("halo_exchange")
+    assert ev["attrs"]["path"] == "all_gather"
+    assert ev["attrs"]["hops"] == 1
+    # wire bytes still count every buffer the gather moves: P-1 per shard
+    B = sm.send_idx.shape[1]
+    assert cap.counter_total("amgx_halo_bytes_total", ring=1) == \
+        sm.n_parts * (sm.n_parts - 1) * B * 8
+
+
+def test_ppermute_reports_distance_count(mesh, rng):
+    from amgx_tpu.distributed.matrix import dist_spmv, uses_all_gather
+    A = sp.csr_matrix(poisson7pt(8, 8, 8))
+    sm = shard_matrix(A, mesh)
+    assert not uses_all_gather(sm.dists, sm.n_parts)
+    x = shard_vector(sm, rng.standard_normal(A.shape[0]))
+    with telemetry.capture() as cap:
+        y = jax.jit(lambda v: dist_spmv(sm, v))(x)
+        y.block_until_ready()
+    assert cap.gauge_last("amgx_dist_ring_hops", ring=1) == \
+        len(sm.dists)
+
+
+def test_dist_overlap_doctor_section(mesh, tmp_path):
+    """The dist_overlap audit reaches the trace file schema-valid and
+    the doctor renders the distributed-levels section with sub-mesh
+    sizes and the agglomeration lifecycle."""
+    from amgx_tpu.telemetry import doctor
+    path = str(tmp_path / "dist.jsonl")
+    A = poisson7pt(10, 10, 10)
+    m = amgx.Matrix(A)
+    m.set_distribution(mesh)
+    prev = telemetry.is_enabled()
+    try:
+        slv = amgx.create_solver(amgx.AMGConfig(
+            _CFG + ", dist_agglomerate_min_rows=64, out:telemetry=1, "
+            f"out:telemetry_path={path}"))
+        slv.setup(m)
+        slv.solve(shard_vector(m.device(), np.ones(A.shape[0])))
+    finally:
+        if not prev:
+            telemetry.disable()
+    lines = open(path).readlines()
+    assert telemetry.validate_jsonl(lines) == len(lines)
+    d = doctor.diagnose([path])
+    dist = d["distributed"]
+    assert dist["levels"], "no dist_overlap events in the diagnosis"
+    assert dist["agglomerations"], "no dist_agglomerate events"
+    assert any(v["submesh_parts"] < v["n_parts"]
+               for v in dist["levels"].values())
+    rep = doctor.render(d)
+    assert "distributed levels" in rep
+    assert "agglomerated level" in rep

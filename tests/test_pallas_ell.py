@@ -111,16 +111,14 @@ def test_distributed_windowed_interior():
     # the interior term of dist_spmv rides the windowed kernel when the
     # per-shard packs exist (8-shard virtual mesh, interpret mode)
     import jax
-    from jax.sharding import Mesh
 
-    from amgx_tpu.distributed.matrix import (dist_spmv, shard_matrix,
-                                             shard_vector)
+    from amgx_tpu.distributed.matrix import (dist_spmv, make_mesh,
+                                             shard_matrix, shard_vector)
     A = poisson7pt(16, 16, 8)
     devs = jax.devices("cpu")
     if len(devs) < 8:
         pytest.skip("needs 8 virtual devices")
-    mesh = Mesh(np.array(devs[:8]), ("p",),
-                axis_types=(jax.sharding.AxisType.Auto,))
+    mesh = make_mesh(8)     # version-portable Auto/GSPMD mesh
     Ad = shard_matrix(A, mesh, dtype=np.float32)
     assert Ad.win_blocks is not None
     x = np.random.default_rng(0).standard_normal(A.shape[0]) \

@@ -12,15 +12,23 @@ from amgx_tpu import telemetry
 from amgx_tpu.distributed.partition import build_partition
 from amgx_tpu.io import poisson5pt, poisson7pt
 
+def _has_shard_map() -> bool:
+    # utils/jaxcompat.shard_map bridges the public jax.shard_map and the
+    # older jax.experimental.shard_map — only a jax with NEITHER loses
+    # the distributed tier
+    if hasattr(jax, "shard_map"):
+        return True
+    try:
+        from jax.experimental.shard_map import shard_map  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
 pytestmark = [
     pytest.mark.telemetry,
-    # the sharded pack needs the modern mesh/shard_map API — on an
-    # older jax the WHOLE distributed tier is unavailable (matching
-    # tests/test_distributed.py behaviour), so skip rather than error
-    pytest.mark.skipif(
-        not hasattr(jax.sharding, "AxisType")
-        or not hasattr(jax, "shard_map"),
-        reason="jax too old for mesh AxisType/shard_map"),
+    pytest.mark.skipif(not _has_shard_map(),
+                       reason="jax too old for shard_map"),
 ]
 
 
