@@ -418,6 +418,45 @@ def register_default_parameters():
       "fence + profile every Nth served batch, feeding measured device "
       "seconds into the cost model (achieved-vs-roofline per pattern; "
       "0 disables)")
+    # breakdown-aware solving (errors.FailureKind + solvers/recovery.py
+    # + utils/faultinject.py): early in-loop breakdown detection is
+    # always on; the RECOVERY ladder and fault injection are opt-in
+    R("recovery_policy", str, "NONE",
+      "automatic recovery ladder for failed solves: AUTO walks "
+      "restart -> promote precision -> conservative smoother -> full "
+      "re-setup, each attempt telemetry-audited; NONE returns the "
+      "failure to the caller", ("NONE", "AUTO"))
+    R("recovery_max_attempts", int, 4,
+      "ladder attempt budget per failed solve (executed rungs only; "
+      "inapplicable rungs are audited as skipped and burn nothing)",
+      None, (0, 16))
+    R("fault_inject", str, "",
+      "fault-injection plan (utils/faultinject.py): "
+      "'point[:key:val]*' entries separated by spaces (e.g. "
+      "'values_nan:iter:3:count:1 worker_death:count:2') over the "
+      "named injection points (values_nan, krylov_zero, setup_error, "
+      "upload_error, oom, worker_death, aot_corrupt, halo_exchange) "
+      "with count/prob/seed/iter triggers; empty (default) disarms — "
+      "zero overhead and a byte-identical solve trace")
+    # serve hardening (ISSUE 13): per-request execution retries, the
+    # poison-pill pattern quarantine, and the per-lane circuit breaker
+    R("serve_retry_max", int, 0,
+      "per-request execution retry budget: a batch whose prepare/solve "
+      "RAISED re-queues its requests up to this many times each, "
+      "deadline permitting (0 disables; convergence failures are "
+      "deterministic and never retried)")
+    R("serve_quarantine_threshold", int, 3,
+      "consecutive error-outcome requests of one pattern after which "
+      "the pattern is quarantined — rejected at admission with "
+      "RC.REJECTED instead of re-running its failing setup forever "
+      "(0 disables; SolveService.unquarantine() lifts it)")
+    R("serve_breaker_threshold", int, 0,
+      "consecutive failed batches after which one executor lane's "
+      "circuit breaker opens and the router routes around it "
+      "(0 disables)")
+    R("serve_breaker_cooldown_s", float, 5.0,
+      "seconds a tripped lane breaker stays open before traffic is "
+      "routed back (half-open probe)")
 
 
 register_default_parameters()

@@ -722,6 +722,16 @@ def shard_vector(A: ShardedMatrix, v) -> jax.Array:
     The padded layout is rank-major: rank p's real (block) rows land at
     [p·n_loc, p·n_loc + count_p), ×b scalar entries each.
     """
+    # chaos harness (utils/faultinject.py): the halo_exchange point
+    # fails the distributed solve at its host seam — the sharded
+    # placement every halo'd SpMV depends on — with the device-error RC
+    # the reference's communicator failures map to
+    from ..utils import faultinject
+    if faultinject.active():
+        from ..errors import RC, AMGXError
+        faultinject.maybe_raise(
+            "halo_exchange",
+            AMGXError("injected halo-exchange failure", RC.CUDA_FAILURE))
     v = np.asarray(v)
     n = A.n_parts * A.n_loc * A.block_dim
     if v.shape[0] == n:

@@ -1,13 +1,24 @@
-"""Error codes and exception model.
+"""Error codes, exception model, and the failure taxonomy.
 
 TPU-native re-implementation of the reference error model
 (``base/include/error.h``, ``base/include/amgx_c.h:74-92``): exceptions raised
 internally are caught at the API boundary and translated into ``AMGX_RC``
 return codes.
+
+On top of the RC surface this module owns the **failure taxonomy**
+(:class:`FailureKind`): the structured vocabulary every breakdown
+detector, recovery-ladder attempt (:mod:`amgx_tpu.solvers.recovery`),
+fault-injection point (:mod:`amgx_tpu.utils.faultinject`) and telemetry
+event speaks.  The in-loop breakdown guards run ON DEVICE inside the
+traced solve loop, so the taxonomy also defines the small integer
+breakdown codes (:data:`BREAKDOWN_KRYLOV` ...) the solve state carries —
+:func:`breakdown_kind` maps a fetched code back to its kind.
 """
 from __future__ import annotations
 
+import dataclasses
 import enum
+from typing import Optional
 
 
 class RC(enum.IntEnum):
@@ -42,6 +53,80 @@ class SolveStatus(enum.IntEnum):
     FAILED = 1
     DIVERGED = 2
     NOT_CONVERGED = 2  # alias, as in the reference header
+
+
+class FailureKind(str, enum.Enum):
+    """Structured failure taxonomy (the reference scatters this across
+    ``AMGX_RC`` codes, solve statuses and signal handlers,
+    ``amg_signal.cu:28-120``; here it is one vocabulary shared by the
+    in-loop breakdown guards, the recovery ladder, the fault-injection
+    harness and the telemetry schema)."""
+
+    #: a Krylov scalar collapsed (CG/PCG ``rho == 0`` with a nonzero
+    #: residual; BiCGStab ``<r*, r> == 0``) — the basis cannot extend
+    KRYLOV_BREAKDOWN = "krylov_breakdown"
+    #: CG's ``pAp < 0``: the operator (or preconditioner) is not SPD
+    INDEFINITE_OPERATOR = "indefinite_operator"
+    #: a NaN entered the iteration state (poisoned values, 0/0, ...)
+    NAN_POISON = "nan_poison"
+    #: the solve burned its budget without converging or diverging
+    STAGNATION = "stagnation"
+    #: the monitored residual grew without bound (overflow to inf)
+    DIVERGENCE = "divergence"
+    #: setup/resetup raised (hierarchy build, coloring, pack, ...)
+    SETUP_ERROR = "setup_error"
+    #: device-side failure (transfer/upload error, OOM, halo exchange)
+    DEVICE_ERROR = "device_error"
+    #: the serving deadline expired before/while executing
+    DEADLINE = "deadline"
+
+
+#: device-side breakdown codes carried by the traced solve state
+#: (int32 scalars; 0 = healthy).  The codes are part of the packed
+#: stats wire layout — renumbering is a schema change.
+BREAKDOWN_NONE = 0
+BREAKDOWN_KRYLOV = 1
+BREAKDOWN_INDEFINITE = 2
+BREAKDOWN_NAN = 3
+BREAKDOWN_DIVERGENCE = 4
+
+_BREAKDOWN_KINDS = {
+    BREAKDOWN_KRYLOV: FailureKind.KRYLOV_BREAKDOWN,
+    BREAKDOWN_INDEFINITE: FailureKind.INDEFINITE_OPERATOR,
+    BREAKDOWN_NAN: FailureKind.NAN_POISON,
+    BREAKDOWN_DIVERGENCE: FailureKind.DIVERGENCE,
+}
+
+
+def breakdown_kind(code: int) -> Optional[FailureKind]:
+    """The :class:`FailureKind` of a device breakdown code (None for 0
+    or an unknown code — forward compatibility over a crash)."""
+    return _BREAKDOWN_KINDS.get(int(code))
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureInfo:
+    """What went wrong, attached to a terminal
+    :class:`~amgx_tpu.solvers.base.SolveResult`: the taxonomy kind plus
+    the first iteration the breakdown was observed at (None when the
+    failure has no iteration anchor — setup errors, stagnation-at-
+    budget reports the final count)."""
+
+    kind: FailureKind
+    iteration: Optional[int] = None
+    detail: str = ""
+
+
+def classify_exception(exc: BaseException,
+                       during_setup: bool = False) -> FailureKind:
+    """Map a raised exception onto the taxonomy: RC-carrying errors
+    classify by their code (device/memory codes → ``device_error``),
+    everything else by the phase it was raised in."""
+    if isinstance(exc, AMGXError):
+        if exc.rc in (RC.CUDA_FAILURE, RC.THRUST_FAILURE, RC.NO_MEMORY):
+            return FailureKind.DEVICE_ERROR
+    return FailureKind.SETUP_ERROR if during_setup \
+        else FailureKind.DEVICE_ERROR
 
 
 class AMGXError(Exception):

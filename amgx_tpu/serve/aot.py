@@ -54,13 +54,21 @@ ENV_STORE = "AMGX_TPU_AOT_STORE"
 
 _SUFFIX = ".aotx"
 
+#: OUTPUT-layout version mixed into the key: the solve executables'
+#: packed stats vector is an output, invisible to the input aval
+#: signature — widening it (the breakdown code + first-bad fields of
+#: ISSUE 13) must MISS on entries serialized under the old layout, not
+#: load them and mis-decode
+_LAYOUT_VERSION = "stats3"
+
 
 def aot_key(tag: str, cfg_hash: str, args) -> str:
     """Content key of one executable: tag + config hash + aval
-    signature + backend fingerprint, digested (the raw signature can be
-    kilobytes for a deep hierarchy's binding pytree)."""
+    signature + backend fingerprint + output-layout version, digested
+    (the raw signature can be kilobytes for a deep hierarchy's binding
+    pytree)."""
     raw = "|".join((tag, cfg_hash, jaxcompat.aval_signature(args),
-                    jaxcompat.backend_fingerprint()))
+                    jaxcompat.backend_fingerprint(), _LAYOUT_VERSION))
     return f"{tag}-{hashlib.blake2b(raw.encode(), digest_size=16).hexdigest()}"
 
 
@@ -119,9 +127,32 @@ class AOTStore:
                 self.misses += 1
             self._count("miss")
             return None
+        from ..utils import faultinject
+        if faultinject.should_fire("aot_corrupt"):
+            # chaos harness: exercise the corruption fallback WITHOUT
+            # destroying the (healthy) on-disk entry — the caller
+            # compiles normally, exactly like a real corrupt read
+            with self._lock:
+                self.fallbacks += 1
+                self.last_fallback = (key, "corrupt:injected")
+            _fallback("corrupt:injected", key)
+            return None
         try:
-            with open(path, "rb") as f:
-                entry = pickle.loads(f.read())
+            from ..utils.retry import retry_call
+
+            def _read():
+                with open(path, "rb") as f:
+                    return f.read()
+
+            # transient I/O on a possibly-networked cache filesystem
+            # gets a short bounded retry; a missing file (concurrent
+            # eviction) is not transient and falls through immediately
+            raw = retry_call(
+                _read, max_attempts=3, base_delay_s=0.02,
+                retryable=lambda e: isinstance(e, OSError)
+                and not isinstance(e, FileNotFoundError),
+                label="aot_load")
+            entry = pickle.loads(raw)
             meta = entry["meta"]
             blob = entry["blob"]
         except Exception as e:      # truncated / unpicklable entry

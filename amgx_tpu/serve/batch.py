@@ -49,6 +49,10 @@ PHASE_OF_MARK = {
     "errored": "errored",       # prepare/solve raised (failure path —
                                 # keeps failed device time out of the
                                 # other phases' split)
+    "requeued": "errored",      # a failed execution attempt re-queued
+                                # by the per-request retry budget; its
+                                # wasted time folds into the error
+                                # phase, then queue_wait re-opens
     "done": "finalize",         # result split-out + completion
 }
 
@@ -96,6 +100,10 @@ class SolveRequest:
     #: decision that placed it (affinity|cold|steal|replicate|overflow)
     lane: Optional[int] = None
     route: Optional[str] = None
+    #: execution retries consumed (serve_retry_max budget): a batch
+    #: whose prepare/solve RAISED re-queues its requests instead of
+    #: completing them, deadline permitting
+    retries: int = 0
 
     def __post_init__(self):
         if not self.marks:
@@ -230,13 +238,17 @@ def split_batches(requests: List[SolveRequest], max_batch: int
 
 
 def execute_batch(session: SolverSession, requests: List[SolveRequest],
-                  cache=None):
+                  cache=None, retry=None):
     """Prepare the session for the batch's operator, run the stacked
     multi-RHS solve (padded to a power-of-two bucket inside
     ``solve_multi`` so ragged batch sizes don't recompile), and split
     per-request results back out.  Failures complete every request in
     the batch with an error rc instead of raising into the worker
-    pool."""
+    pool — unless ``retry(req, msg)`` (the lane's per-request retry
+    budget, serve_retry_max) claims the request by returning True, in
+    which case it is re-queued and NOT completed here.  Only RAISED
+    prepare/solve failures are retryable; convergence failures are
+    deterministic and deadline sheds are final."""
     now = time.monotonic()
     live = []
     for r in requests:
@@ -248,6 +260,11 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
                                   reason="deadline")
             telemetry.counter_inc("amgx_serve_requests_total",
                                   status="REJECTED")
+            # the deadline shed IS the taxonomy's `deadline` kind —
+            # count it where every other FailureKind counts
+            from ..errors import FailureKind
+            telemetry.counter_inc("amgx_solve_failures_total",
+                                  kind=FailureKind.DEADLINE.value)
             r.deadline_shed = True
             r.complete(None, rc=RC.REJECTED,
                        error="deadline expired before execution")
@@ -308,7 +325,27 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
             cache.account(session)
     except Exception as e:      # noqa: BLE001 — worker pool must survive
         msg = f"{type(e).__name__}: {e}"
+        from ..errors import AMGXError, classify_exception
+        rc = e.rc if isinstance(e, AMGXError) else RC.UNKNOWN
+        # classify the raised failure into the taxonomy (setup_error /
+        # device_error) so serving-path failures land in the same
+        # counter/event the in-loop breakdown kinds use.  Only marks of
+        # the CURRENT attempt count: a retried request keeps its first
+        # attempt's "prepared" mark, which must not reclassify a
+        # setup-phase failure on the retry as a device error
+        marks = live[0].marks
+        last_exec = max((i for i, (nm, _) in enumerate(marks)
+                         if nm == "executing"), default=-1)
+        prepared = any(nm == "prepared"
+                       for nm, _ in marks[last_exec + 1:])
+        kind = classify_exception(e, during_setup=not prepared)
+        telemetry.counter_inc("amgx_solve_failures_total",
+                              kind=kind.value)
+        telemetry.event("breakdown", solver="serve", kind=kind.value,
+                        iteration=None, error=msg[:200])
         for r in live:
+            if retry is not None and retry(r, msg):
+                continue        # re-queued; completes on a later attempt
             telemetry.counter_inc("amgx_serve_requests_total",
                                   status="ERROR")
             # close the failed prepare/solve time under its own phase —
@@ -316,7 +353,7 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
             # the doctor's congestion-vs-compute hint away from the
             # actual failing solve path
             r.mark("errored")
-            r.complete(None, rc=RC.UNKNOWN, error=msg)
+            r.complete(None, rc=rc, error=msg)
         return
     t_done = time.monotonic()
     for r, res in zip(live, results):

@@ -109,6 +109,19 @@ class ExecutorLane:
         #: cold/novel-pattern requests the router placed here instead of
         #: their hash-home lane
         self.stolen_in = 0
+        #: per-request execution retry budget (serve_retry_max): a
+        #: batch whose prepare/solve RAISED re-queues its requests,
+        #: deadline permitting, instead of failing them outright
+        self.retry_max = int(cfg.get("serve_retry_max"))
+        #: circuit breaker (serve_breaker_*): N consecutive failed
+        #: batches open the breaker — the router routes around this
+        #: lane until the cooldown elapses (half-open).  0 disables.
+        self.breaker_threshold = int(cfg.get("serve_breaker_threshold"))
+        self.breaker_cooldown_s = \
+            float(cfg.get("serve_breaker_cooldown_s"))
+        self._consec_failures = 0
+        self._tripped_until = 0.0
+        self.breaker_trips = 0
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -130,7 +143,12 @@ class ExecutorLane:
         if self._dispatcher is not None:
             self._dispatcher.join(timeout=5.0)
             self._dispatcher = None
-        self._tm.join_threads()
+        try:
+            self._tm.join_threads()
+        except Exception:   # noqa: BLE001 — worker-death exceptions
+            # were already delivered through the request handles (the
+            # reap callback); re-raising them would wedge shutdown
+            pass
 
     def drain(self, timeout: Optional[float] = None) -> dict:
         """Flush this lane's queued + in-flight work.  Returns a
@@ -151,7 +169,12 @@ class ExecutorLane:
                 self._cond.wait(timeout=min(left or 0.05, 0.05))
             queued, inflight = len(self._queue), self._inflight
         if ok:
-            self._tm.wait_threads()
+            try:
+                self._tm.wait_threads()
+            except Exception:   # noqa: BLE001 — a dead worker's
+                # exception already failed its requests cleanly (the
+                # reap callback); the drain itself completed
+                pass
         return {"lane": self.index, "ok": ok, "queued": queued,
                 "inflight": inflight,
                 "seconds": round(time.monotonic() - t0, 4)}
@@ -164,10 +187,43 @@ class ExecutorLane:
     def queue_fraction(self) -> float:
         """Outstanding work as a fraction of this lane's admission
         capacity — the router's load signal.  A non-accepting
-        (draining) lane reads as fully loaded."""
-        if not self.accepting:
+        (draining) lane — or one whose circuit breaker is open — reads
+        as fully loaded, so every routing policy steers around it."""
+        if not self.accepting or self.breaker_open:
             return float("inf")
         return self.outstanding() / max(self.queue_depth, 1)
+
+    # ------------------------------------------------------ circuit breaker
+    @property
+    def breaker_open(self) -> bool:
+        return self.breaker_threshold > 0 \
+            and time.monotonic() < self._tripped_until
+
+    def record_batch_result(self, ok: bool):
+        """Feed the breaker one batch outcome: N consecutive failures
+        (serve_breaker_threshold) open it for the cooldown; any success
+        closes it and clears the streak."""
+        if self.breaker_threshold <= 0:
+            return
+        tripped = False
+        with self._lock:
+            if ok:
+                self._consec_failures = 0
+                self._tripped_until = 0.0
+                return
+            self._consec_failures += 1
+            if self._consec_failures >= self.breaker_threshold \
+                    and time.monotonic() >= self._tripped_until:
+                self._tripped_until = time.monotonic() \
+                    + self.breaker_cooldown_s
+                self.breaker_trips += 1
+                tripped = True
+        if tripped:
+            telemetry.counter_inc("amgx_serve_breaker_trips_total",
+                                  lane=self.index)
+            telemetry.event("lane_breaker_trip", lane=self.index,
+                            consecutive_failures=self._consec_failures,
+                            cooldown_s=self.breaker_cooldown_s)
 
     def try_admit(self, req: SolveRequest) -> bool:
         """Admit ``req`` into this lane's queue; False when over
@@ -208,20 +264,101 @@ class ExecutorLane:
                                     self._inflight, lane=self.index)
             self.service._refresh_queue_gauges()
             for batch in split_batches(drained, self.max_batch):
-                self._tm.push_work(self._batch_task(batch))
+                task = self._batch_task(batch)
+                fut = self._tm.push_work(task)
+                if fut is not None:
+                    # worker-death guard: if the worker dies BEFORE the
+                    # batch body runs (its own try/finally never
+                    # engages), the done-callback fails the in-flight
+                    # requests cleanly instead of hanging their waiters
+                    fut.add_done_callback(
+                        lambda f, t=task, b=batch:
+                        self._reap_batch(t, b, f))
+
+    def _reap_batch(self, task, batch: List[SolveRequest], fut):
+        """Future done-callback: no-op when the batch body ran (it
+        completed every request and dropped the in-flight count
+        itself); when the worker died before entering it, the retry
+        budget gets the same say it has for an in-body failure (the
+        knob's contract cannot depend on WHERE in the worker the death
+        landed), then the rest finish with a terminal error, the
+        breaker is fed, and the in-flight accounting released."""
+        if getattr(task, "entered", False):
+            return
+        exc = fut.exception()
+        msg = (f"worker died before batch execution: "
+               f"{type(exc).__name__}: {exc}") if exc is not None \
+            else "worker died before batch execution"
+        requeued: set = set()
+        errored = 0
+        for r in batch:
+            if r.done() or self._maybe_retry(r, requeued, msg):
+                continue
+            r.mark("errored")
+            r.complete(None, rc=RC.UNKNOWN, error=msg)
+            errored += 1
+        if errored:
+            telemetry.counter_inc("amgx_serve_requests_total",
+                                  status="ERROR", value=float(errored))
+        self.record_batch_result(False)
+        with self._cond:
+            self._inflight -= len(batch)
+            telemetry.gauge_set("amgx_serve_lane_inflight",
+                                self._inflight, lane=self.index)
+            self._cond.notify_all()
+        self.service._refresh_queue_gauges()
+
+    def _maybe_retry(self, req: SolveRequest, requeued: set,
+                     msg: str) -> bool:
+        """The per-request retry budget (serve_retry_max): re-queue a
+        request whose batch RAISED, deadline permitting.  Returns True
+        when the request was claimed (the caller must not complete
+        it)."""
+        if self.retry_max <= 0 or req.retries >= self.retry_max:
+            return False
+        if req.expired() or not self._running or not self.accepting:
+            return False            # the deadline/drain makes it final
+        req.retries += 1
+        req.mark("requeued")
+        requeued.add(id(req))
+        telemetry.counter_inc("amgx_serve_retries_total")
+        with self._cond:
+            self._queue.append(req)
+            self._cond.notify_all()
+        return True
 
     def _batch_task(self, batch: List[SolveRequest]):
         svc = self.service
         profile = svc._take_profile_slot()
 
         def run():
+            # the reap callback keys on this flag: once the body is
+            # entered, ITS try/finally owns request completion and the
+            # in-flight accounting
+            run.entered = True
             session = None
+            #: requests the retry budget re-queued — they are alive in
+            #: the lane queue again and must NOT be completed here
+            requeued: set = set()
+
+            def retry(req, msg):
+                return self._maybe_retry(req, requeued, msg)
+
+            batch_ok = True
             try:
                 session, _created = self.cache.get_or_create(
                     svc.cfg, batch[0].matrix, key=batch[0].key)
-                execute_batch(session, batch, cache=self.cache)
-                done = sum(1 for r in batch if r.rc == RC.OK)
+                execute_batch(session, batch, cache=self.cache,
+                              retry=retry)
+                done = sum(1 for r in batch if r.rc == RC.OK
+                           and r.done())
                 shed = sum(1 for r in batch if r.rc == RC.REJECTED)
+                # a batch that only survived by re-queueing its
+                # requests still FAILED — counting it ok would reset
+                # (or even close) the breaker on every retried failure
+                batch_ok = not requeued and \
+                    not any(r.done() and r.outcome() == "error"
+                            for r in batch)
                 with self._lock:
                     self.completed += done
                     self.rejected += shed
@@ -236,23 +373,34 @@ class ExecutorLane:
                 # the failure is delivered through the request handles;
                 # letting it reach the future would make a later
                 # drain()'s wait_threads() re-raise it mid-shutdown
+                batch_ok = False
                 msg = f"{type(e).__name__}: {e}"
                 for r in batch:
-                    if not r.done():
-                        r.mark("errored")
-                        r.complete(None, rc=RC.UNKNOWN, error=msg)
+                    if id(r) in requeued or r.done():
+                        continue
+                    if self._maybe_retry(r, requeued, msg):
+                        continue
+                    r.mark("errored")
+                    r.complete(None, rc=RC.UNKNOWN, error=msg)
             finally:
                 for r in batch:
+                    if id(r) in requeued:
+                        continue      # alive again in the lane queue
                     if not r.done():  # belt-and-braces: no waiter hangs
                         r.mark("errored")
                         r.complete(None, rc=RC.UNKNOWN,
                                    error="batch task failed")
+                # the circuit breaker eats one outcome per batch —
+                # worker death / poisoned setup trips it, a healthy
+                # batch closes it
+                self.record_batch_result(batch_ok)
                 with self._cond:
                     self._inflight -= len(batch)
                     telemetry.gauge_set("amgx_serve_lane_inflight",
                                         self._inflight, lane=self.index)
                     self._cond.notify_all()
                 svc._refresh_queue_gauges()
+        run.entered = False
         return run
 
     # ---------------------------------------------------------------- state
@@ -294,6 +442,10 @@ class ExecutorLane:
             "sessions": len(self.cache),
             "overloaded": snap["overloaded"],
             "slo_attainment": snap["attainment"],
+            # circuit breaker (serve_breaker_threshold): an open
+            # breaker means the router is steering around this lane
+            "breaker_open": bool(self.breaker_open),
+            "breaker_trips": int(self.breaker_trips),
         }
 
     def stats(self) -> dict:

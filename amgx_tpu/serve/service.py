@@ -63,6 +63,19 @@ class SolveService:
         self.batch_window_s = float(g("serve_batch_window_ms")) / 1e3
         self.max_batch = int(g("serve_max_batch"))
         self.default_deadline_s = float(g("serve_deadline_ms")) / 1e3
+        #: poison-pill quarantine (serve_quarantine_threshold): N
+        #: consecutive error-outcome requests of one pattern reject it
+        #: at ADMISSION — the pre-hardening service re-ran a failing
+        #: setup for every retrying client, forever
+        self.quarantine_threshold = int(g("serve_quarantine_threshold"))
+        self._pattern_failures: dict = {}
+        self._quarantined: dict = {}
+        #: chaos harness (utils/faultinject.py): a non-empty
+        #: fault_inject spec arms the process-global injection plan
+        fi_spec = str(g("fault_inject"))
+        if fi_spec:
+            from ..utils import faultinject
+            faultinject.configure_knob(fi_spec)
         #: the service's config never changes — hash it once, not per
         #: submit (the pattern fingerprint side is cached on the Matrix)
         self._cfg_hash = config_hash(cfg)
@@ -293,6 +306,11 @@ class SolveService:
         reject_reason = None
         if not self._accepting:
             reject_reason = "draining"
+        elif self.quarantine_threshold > 0 \
+                and req.key.pattern in self._quarantined:
+            # quarantined pattern: rejected AT ADMISSION — it never
+            # reaches a lane, so its poisoned setup is never re-run
+            reject_reason = "quarantined"
         else:
             if matrix.dist is not None and len(self.lanes) > 1:
                 # a mesh-sharded operator owns EVERY device already —
@@ -361,6 +379,7 @@ class SolveService:
         request counted."""
         outcome = req.outcome()
         latency = req.latency_s()
+        self._track_quarantine(req, outcome)
         deadline_met = req.deadline_t is None or (
             req.completed_mono is not None
             and req.completed_mono <= req.deadline_t)
@@ -400,6 +419,65 @@ class SolveService:
                 phases={k: round(v, 6) for k, v in durs.items()},
                 marks={k: round(v, 6)
                        for k, v in req.phase_offsets().items()})
+
+    # ----------------------------------------------------------- quarantine
+    def _track_quarantine(self, req, outcome: str):
+        """Per-pattern consecutive-failure tracking (the poison-pill
+        guard): ``error`` outcomes count, any completed solve (ok or
+        merely unconverged — the session WORKS) clears the streak;
+        admission rejections and deadline sheds are neutral."""
+        if self.quarantine_threshold <= 0:
+            return
+        pat = req.key.pattern
+        newly = None
+        with self._lat_lock:
+            if outcome == "error":
+                n = self._pattern_failures.get(pat, 0) + 1
+                self._pattern_failures[pat] = n
+                if n >= self.quarantine_threshold \
+                        and pat not in self._quarantined:
+                    self._quarantined[pat] = {
+                        "failures": n, "t": time.time(),
+                        "error": (req.error or "")[:200]}
+                    newly = n
+            elif outcome in ("ok", "failed"):
+                self._pattern_failures.pop(pat, None)
+        if newly is not None:
+            telemetry.counter_inc("amgx_serve_quarantined_total")
+            telemetry.gauge_set("amgx_serve_quarantined_patterns",
+                                len(self._quarantined))
+            telemetry.event("pattern_quarantined", pattern=pat[:12],
+                            failures=int(newly),
+                            error=(req.error or "")[:200])
+
+    def quarantined_patterns(self) -> dict:
+        """{pattern fingerprint: {"failures", "t", "error"}} of the
+        currently quarantined patterns."""
+        with self._lat_lock:
+            return {k: dict(v) for k, v in self._quarantined.items()}
+
+    def unquarantine(self, pattern: str) -> bool:
+        """Lift one pattern's quarantine (operator action after fixing
+        the root cause); returns True when it was quarantined.  Accepts
+        a full fingerprint OR a unique prefix — ``/healthz`` reports
+        patterns truncated to 12 chars, and the documented lift
+        workflow must work from what the wire shows (an ambiguous
+        prefix lifts nothing and returns False)."""
+        with self._lat_lock:
+            key = pattern if pattern in self._quarantined else None
+            if key is None and pattern:
+                matches = [p for p in self._quarantined
+                           if p.startswith(pattern)]
+                if len(matches) == 1:
+                    key = matches[0]
+            hit = key is not None \
+                and self._quarantined.pop(key, None) is not None
+            if hit:
+                self._pattern_failures.pop(key, None)
+        if hit:
+            telemetry.gauge_set("amgx_serve_quarantined_patterns",
+                                len(self._quarantined))
+        return hit
 
     def solve(self, matrix: Matrix, b, x0=None,
               timeout: Optional[float] = None):
@@ -599,9 +677,16 @@ class SolveService:
                                  emit_event=False,
                                  include_percentiles=False)
         saturated = [h["lane"] for h in lane_health if h["overloaded"]]
+        with self._lat_lock:
+            quarantined = list(self._quarantined)
         return {
             "ok": True,
             "accepting": self._accepting,
+            # the poison-pill contract: patterns rejected at admission
+            # (serve_quarantine_threshold consecutive error outcomes);
+            # an LB/operator lifts one via SolveService.unquarantine
+            "quarantined_patterns": [p[:12] for p in quarantined],
+            "quarantined_total": len(quarantined),
             "queue_depth": depth,
             "queue_capacity": self.queue_depth * len(self.lanes),
             "inflight": inflight,
@@ -679,6 +764,12 @@ class SolveService:
             # per-pattern fenced device seconds vs the cost model
             "profile": profile or None,
             "endpoint": self.endpoint,
+            # serve hardening: quarantined patterns (full fingerprints
+            # here — health() truncates for the wire) + retry traffic
+            "quarantine": {
+                "threshold": self.quarantine_threshold,
+                "patterns": self.quarantined_patterns(),
+            },
             "cache": self._cache_stats(),
             # multi-device scale-out: per-lane queue/SLO/cache state +
             # the router's affinity/replication/steal picture

@@ -30,8 +30,12 @@ import numpy as np
 from .. import telemetry
 from ..config import AMGConfig
 from ..core.matrix import DeviceMatrix, Matrix
-from ..errors import (BadConfigurationError, BadParametersError,
-                      SolveStatus)
+from ..errors import (AMGXError, BadConfigurationError,
+                      BadParametersError, FailureInfo, FailureKind,
+                      RC, SolveStatus, breakdown_kind,
+                      BREAKDOWN_KRYLOV, BREAKDOWN_NAN,
+                      BREAKDOWN_DIVERGENCE)
+from ..utils import faultinject
 from ..ops import blas
 from ..ops.spmv import spmv
 from ..utils.logging import amgx_output
@@ -61,6 +65,29 @@ def check_convergence(criterion: str, nrm, nrm_ini, nrm_max, tolerance,
     return jnp.all(ok)
 
 
+def _inject_fault(fault, it, x, state):
+    """Apply a traced fault-injection point to the iteration state at
+    its target iteration (``fault = (mode, iteration)`` — see
+    ``utils.faultinject.TRACED_POINTS``).  Only ever traced when a
+    point is armed; the clean path never calls this."""
+    mode, f_it = fault
+    tgt = jnp.asarray(int(f_it), jnp.int32)
+    if mode == "values_nan":
+        def poison(v):
+            if not jnp.issubdtype(v.dtype, jnp.inexact):
+                return v
+            bad = jnp.asarray(float("nan"), v.dtype)
+            return jnp.where(it == tgt, v * bad, v)
+        return poison(x), jax.tree_util.tree_map(poison, state)
+    # krylov_zero: collapse the 0-dim Krylov scalars (CG's rho) while
+    # the residual vectors stay healthy — the classic rho-breakdown
+    def zero_scalar(v):
+        if not jnp.issubdtype(v.dtype, jnp.inexact) or v.ndim != 0:
+            return v
+        return jnp.where(it == tgt, jnp.zeros_like(v), v)
+    return x, jax.tree_util.tree_map(zero_scalar, state)
+
+
 @dataclasses.dataclass
 class SolveResult:
     x: jax.Array
@@ -70,6 +97,13 @@ class SolveResult:
     residual_history: Optional[np.ndarray]
     setup_time: float = 0.0
     solve_time: float = 0.0
+    #: what went wrong (errors.FailureInfo: taxonomy kind + the first
+    #: iteration the in-loop guards observed it at); None on SUCCESS
+    failure: Optional[FailureInfo] = None
+    #: recovery-ladder audit (solvers/recovery.py) when the solve was
+    #: retried: {"kind", "action", "attempts", "outcome"}; None when no
+    #: recovery ran
+    recovery: Optional[dict] = None
 
 
 # --------------------------------------------------------------------------
@@ -207,6 +241,18 @@ class Solver:
             # cumulative cache-efficacy counters survive restarts in a
             # state file next to the warm-start artifacts
             telemetry.runstate.configure_default(aot_dir or cache_dir)
+        # breakdown-aware solving (solvers/recovery.py +
+        # utils/faultinject.py): the recovery ladder is opt-in
+        # (recovery_policy=AUTO); a non-empty fault_inject spec arms
+        # the process-global injection plan — configuring from the
+        # solver keeps C-shaped drivers on the one-config-string model
+        self.recovery_policy = str(g("recovery_policy"))
+        self.recovery_max_attempts = int(g("recovery_max_attempts"))
+        fi_spec = str(g("fault_inject"))
+        if fi_spec:
+            # idempotent per spec: nested/session/twin solvers built
+            # from the same config must not re-arm consumed triggers
+            faultinject.configure_knob(fi_spec)
         # an EXPLICIT verbosity_level drives the level-gated output
         # stream; the registry default must not clobber a verbosity the
         # host application set programmatically
@@ -324,6 +370,16 @@ class Solver:
         return precision.precision_view(A, kd)
 
     def _setup_impl(self, A: "Matrix | DeviceMatrix"):
+        # the matrix AS THE CALLER PASSED IT (pre-scaling/reorder): the
+        # recovery ladder's conservative/resetup rungs rebuild from it —
+        # re-running setup on the scaled copy would scale twice.  Only
+        # retained when the ladder can use it: with recovery off,
+        # pinning the original next to a scaled/reordered copy would
+        # double host matrix retention for nothing
+        if self.recovery_policy not in ("", "NONE"):
+            self._setup_input = A
+        faultinject.maybe_raise(
+            "setup_error", AMGXError("injected setup failure", RC.CORE))
         self.scaler = None
         self._reorder = None
         scaling = str(self.cfg.get("scaling", self.scope))
@@ -355,6 +411,13 @@ class Solver:
                 if A2 is not None:
                     A = A2
             self.A = A
+            faultinject.maybe_raise(
+                "upload_error",
+                AMGXError("injected transfer/upload failure",
+                          RC.CUDA_FAILURE))
+            faultinject.maybe_raise(
+                "oom", AMGXError("injected device out-of-memory",
+                                 RC.NO_MEMORY))
             with cpu_profiler("matrix_pack_device"), \
                     telemetry.setup_profile.phase("pack", kind="device"):
                 self.Ad = A.device()
@@ -502,6 +565,23 @@ class Solver:
                          self.use_scalar_norm)
 
     # ------------------------------------------------------------- solve API
+    def _sync_fault_trace(self):
+        """Fault injection (utils/faultinject.py): an armed traced
+        point (values_nan / krylov_zero) is compiled INTO the loop;
+        arming-state changes must invalidate EVERY jitted solve body —
+        one list, shared by both drivers, so a future cached variant
+        cannot be forgotten on one path and serve a poisoned executable
+        on the clean one.  Returns the active ``(mode, iteration)`` or
+        None; costs one getattr when disarmed."""
+        fault = faultinject.trace_mode()
+        if fault != getattr(self, "_fault_trace", None):
+            self._fault_trace = fault
+            self._solve_fn = None
+            self._refined_fn = None
+            self._solve_multi = None
+            self._solve_multi_refined = None
+        return fault
+
     def _tolerance_floor(self, dtype) -> float:
         """Smallest relative residual honestly reachable in ``dtype``
         (core/precision.py owns the floor formula and the ladder)."""
@@ -525,8 +605,14 @@ class Solver:
         modes) — the single predicate ``_check_tolerance_floor`` keys
         its warn-vs-raise split on."""
         dtype = self.Ad.dtype
+        # breakdown-triggered promotion (solvers/recovery.py "promote"
+        # rung): the ladder may force a promotion even when the
+        # tolerance sits above the dtype floor — a stagnating/poisoned
+        # narrow solve is re-run one rung wider
+        forced = bool(getattr(self, "_force_promotion", False))
         if not (self.monitor_residual
-                and self.tolerance < self._tolerance_floor(dtype)):
+                and (forced
+                     or self.tolerance < self._tolerance_floor(dtype))):
             return False, None, False
         from ..core import precision
         if self.tolerance <= 0 \
@@ -546,6 +632,16 @@ class Solver:
             return False, None, False
         wide = precision.promotion_target(dtype, host_dt,
                                           self.tolerance)
+        if wide is None and forced:
+            # the tolerance alone asked for no rung — take the next one
+            # up anyway (bounded by the host dtype and the hi+lo
+            # reconstruction limit, same gates as promotion_target)
+            ddt = np.dtype(dtype)
+            for rung in precision.LADDER:
+                if ddt.itemsize < rung.itemsize <= host_dt.itemsize \
+                        and rung.itemsize <= 2 * ddt.itemsize:
+                    wide = rung
+                    break
         if wide is None:
             return False, None, False
         return True, np.dtype(wide), False
@@ -600,6 +696,11 @@ class Solver:
         if self.Ad is None:
             raise BadConfigurationError("solve() before setup()")
         dtype = self.Ad.dtype
+        # the caller's untouched rhs/guess: the recovery ladder
+        # (solvers/recovery.py) re-enters solve() with these — the
+        # scaled/permuted/sharded forms below are per-attempt state
+        b_caller, x0_caller = b, x0
+        fault = self._sync_fault_trace()
         if self.scaler is not None:
             b = self.scaler.scale_rhs(np.asarray(b, dtype=dtype))
             if x0 is not None and not zero_initial_guess:
@@ -707,8 +808,8 @@ class Solver:
                 # refinement must see the caller's full-precision
                 # rhs/guess — the dtype-cast b/x0 above would fold the
                 # fp32 rounding of b itself into the "converged" solution
-                x, iters, nrm, nrm_ini, history = self._solve_refined(
-                    b_in, x0_in, wide)
+                x, iters, brk_code, first_bad, nrm, nrm_ini, history = \
+                    self._solve_refined(b_in, x0_in, wide)
             else:
                 import contextlib
                 ctx = jax.default_device(pin) if pin is not None \
@@ -735,13 +836,22 @@ class Solver:
                         fn = self._maybe_aot("solve", fn, call_args,
                                              device=pin)
                     x, stats, history = fn(*call_args)
-                # ONE small host fetch for (iters, norms) — per-transfer
-                # cost dominates on remote-attached TPUs
-                stats = np.asarray(stats)
-                iters = int(stats[0])
-                m = (len(stats) - 1) // 2
-                nrm, nrm_ini = stats[1:1 + m], stats[1 + m:]
+                # ONE small host fetch for (iters, breakdown, norms) —
+                # per-transfer cost dominates on remote-attached TPUs
+                iters, brk_code, first_bad, nrm, nrm_ini = \
+                    self._decode_stats(np.asarray(stats))
         solve_time = time.perf_counter() - t0
+        # record the injection only when it actually PROVOKED something
+        # (a solve converging before the target iteration — or a
+        # solver whose recursion recomputes the zeroed scalar, like
+        # BiCGStab under krylov_zero — must not claim a fault that
+        # never bit): on monitored solves the breakdown flag is the
+        # evidence; unmonitored solves can only witness the iteration
+        # count
+        if fault is not None and \
+                (bool(brk_code) if self.monitor_residual
+                 else int(iters) > int(fault[1])):
+            faultinject.fired(fault[0], iteration=fault[1])
         if dist:
             from ..distributed.matrix import unshard_vector
             x = unshard_vector(self.Ad, x)
@@ -753,6 +863,7 @@ class Solver:
         iters = int(iters)
         nrm = np.atleast_1d(np.asarray(nrm))
         nrm_ini_np = np.atleast_1d(np.asarray(nrm_ini))
+        failure = None
         if self.monitor_residual:
             nrm_max_np = nrm_ini_np
             if self.convergence in ("RELATIVE_MAX", "RELATIVE_MAX_CORE") \
@@ -761,15 +872,21 @@ class Solver:
                 # max as ini under-reported legitimately converged solves
                 # against a growing nrm_max (solver.cu:776-805 tracks it)
                 h = np.atleast_2d(np.asarray(history))[:iters + 1]
-                h = h[np.isfinite(h).all(axis=1)] if h.size else h
+                h = self._finite_history(h, context="nrm_max")
                 if h.size:
                     nrm_max_np = np.maximum(nrm_ini_np, h.max(axis=0))
             conv = bool(np.all(self._host_converged(nrm, nrm_ini_np,
                                                     nrm_max_np)))
             diverged = bool(np.any(~np.isfinite(nrm)))
+            # breakdown codes with a finite terminal residual (krylov
+            # rho-collapse, indefinite pAp) report FAILED — the loop was
+            # cut short by the guard, not by the iteration budget
             status = (SolveStatus.SUCCESS if conv else
-                      (SolveStatus.DIVERGED if diverged
-                       else SolveStatus.NOT_CONVERGED))
+                      (SolveStatus.DIVERGED if diverged else
+                       (SolveStatus.FAILED if brk_code
+                        else SolveStatus.NOT_CONVERGED)))
+            failure = self._classify_failure(conv, diverged, brk_code,
+                                             first_bad, nrm, iters)
         else:
             status = SolveStatus.SUCCESS
         history_np = None
@@ -785,10 +902,25 @@ class Solver:
                         f"{solve_time / max(iters, 1):10.6f} s\n")
         if telemetry.is_enabled():
             self._emit_solve_telemetry(iters, nrm, nrm_ini_np, status,
-                                       history_np, solve_time)
-        return SolveResult(x=x, iterations=iters, status=status,
-                           residual_norm=nrm, residual_history=history_np,
-                           setup_time=self.setup_time, solve_time=solve_time)
+                                       history_np, solve_time,
+                                       failure=failure)
+        res = SolveResult(x=x, iterations=iters, status=status,
+                          residual_norm=nrm, residual_history=history_np,
+                          setup_time=self.setup_time,
+                          solve_time=solve_time, failure=failure)
+        if status != SolveStatus.SUCCESS \
+                and self.recovery_policy not in ("", "NONE") \
+                and self.monitor_residual \
+                and not getattr(self, "_in_recovery", False) \
+                and not getattr(self, "_suppress_recovery", False):
+            # bounded, telemetry-audited escalation (restart → promote
+            # → conservative smoother → full re-setup); the ladder
+            # re-enters solve() with _in_recovery set, so it can never
+            # recurse into itself
+            from .recovery import maybe_recover
+            res = maybe_recover(self, b_caller, x0_caller,
+                                zero_initial_guess, res)
+        return res
 
     def _maybe_aot(self, tag: str, jit_fn: Callable, args: tuple,
                    device=None) -> Callable:
@@ -804,6 +936,10 @@ class Solver:
         executable carries its device assignment and must only ever be
         reloaded for that same device."""
         if self.forensics:
+            return jit_fn
+        if getattr(self, "_fault_trace", None) is not None:
+            # a traced fault injection is compiled INTO this body — it
+            # must never be serialized under the clean executable's key
             return jit_fn
         try:
             from ..serve import aot
@@ -845,21 +981,80 @@ class Solver:
             return jit_fn
 
     def _packed_solve_fn(self) -> Callable:
-        """The solve body with (iters, nrm, nrm_ini) packed into one f64
-        stats vector — ONE small host fetch per solve.  Shared by the
-        single-RHS driver and the vmapped multi-RHS driver so both stay
-        on the same wire layout (decoded as ``(len - 1) // 2``)."""
+        """The solve body with (iters, breakdown, nrm, nrm_ini) packed
+        into one f64 stats vector — ONE small host fetch per solve.
+        Shared by the single-RHS driver and the vmapped multi-RHS
+        driver so both stay on the same wire layout (decoded by
+        :meth:`_decode_stats`: ``[it, brk_code, first_bad, nrm*m,
+        nrm_ini*m]``)."""
         body = self._build_solve_fn()
 
         def packed(b, x0, tol, it_limit):
-            x, it, nrm, nrm_ini, history = body(b, x0, tol, it_limit)
+            x, it, nrm, nrm_ini, history, fail = body(b, x0, tol,
+                                                      it_limit)
             stats = jnp.concatenate([
                 it[None].astype(jnp.float64),
+                fail.astype(jnp.float64),
                 jnp.ravel(nrm).astype(jnp.float64),
                 jnp.ravel(nrm_ini).astype(jnp.float64)])
             return x, stats, history
 
         return packed
+
+    @staticmethod
+    def _decode_stats(stats: np.ndarray):
+        """Inverse of :meth:`_packed_solve_fn`'s wire layout:
+        ``(iters, brk_code, first_bad, nrm, nrm_ini)``."""
+        iters = int(stats[0])
+        brk_code = int(stats[1])
+        first_bad = int(stats[2])
+        m = (len(stats) - 3) // 2
+        return iters, brk_code, first_bad, stats[3:3 + m], stats[3 + m:]
+
+    def _classify_failure(self, conv: bool, diverged: bool,
+                          brk_code: int, first_bad: int, nrm,
+                          iters: int) -> Optional[FailureInfo]:
+        """The terminal :class:`~amgx_tpu.errors.FailureInfo` of a
+        monitored solve (None on convergence): the in-loop guard's code
+        wins (it carries the first-bad iteration); a non-finite final
+        norm without one classifies by NaN-vs-inf; anything else that
+        burned the budget is stagnation."""
+        if conv:
+            return None
+        if brk_code:
+            kind = breakdown_kind(brk_code)
+            if kind is not None:
+                return FailureInfo(
+                    kind=kind,
+                    iteration=first_bad if first_bad >= 0 else None)
+        if diverged:
+            nan = bool(np.any(np.isnan(np.asarray(nrm))))
+            return FailureInfo(
+                kind=(FailureKind.NAN_POISON if nan
+                      else FailureKind.DIVERGENCE),
+                iteration=iters)
+        return FailureInfo(kind=FailureKind.STAGNATION, iteration=iters)
+
+    def _finite_history(self, h: np.ndarray,
+                        context: str = "") -> np.ndarray:
+        """Filter non-finite rows out of a residual-history slab — and
+        SAY SO: the old silent ``np.isfinite(...).all(axis=1)`` filters
+        dropped the very rows a breakdown forensics needs, with no
+        trace that the iteration record was truncated."""
+        if h.size == 0:
+            return h
+        mask = np.isfinite(h).all(axis=1)
+        if mask.all():
+            return h
+        first_bad = int(np.argmin(mask))
+        dropped = int((~mask).sum())
+        if telemetry.is_enabled():
+            telemetry.counter_inc("amgx_history_truncated_total")
+            telemetry.event("history_truncated",
+                            first_bad_iteration=first_bad,
+                            dropped=dropped, context=context,
+                            solver=self.config_name)
+        return h[mask]
 
     # ------------------------------------------------------ multi-RHS solve
     def solve_multi(self, B, X0=None, zero_initial_guess: bool = False,
@@ -897,6 +1092,7 @@ class Solver:
             return []
         dtype = self.Ad.dtype
         dist = self.Ad.fmt == "sharded-ell"
+        fault = self._sync_fault_trace()
         refine, wide, structural = self._promotion_plan()
         self._check_tolerance_floor(refine, structural)
         # the bf16 → f32 promotion rung is BATCHABLE: the refined outer
@@ -920,13 +1116,26 @@ class Solver:
         # sequential fallback under a pin
         if k == 1 or dist or (refine and not refined_batch) \
                 or (refine and pin is not None):
-            out = []
-            for j, bj in enumerate(B):
-                xj = None if X0 is None else X0[j]
-                out.append(self.solve(bj, x0=xj,
-                                      zero_initial_guess=
-                                      zero_initial_guess))
-            return out
+            # sequential fallback: recovery stays OFF here so
+            # solve_multi behaves uniformly across batch sizes — the
+            # serving layer executes everything through this API, and
+            # a ladder engaging only when a request happened to batch
+            # alone would multiply that batch's deadline by the
+            # attempt count (recovery.maybe_recover's scope contract)
+            suppress = not getattr(self, "_suppress_recovery", False)
+            if suppress:
+                self._suppress_recovery = True
+            try:
+                out = []
+                for j, bj in enumerate(B):
+                    xj = None if X0 is None else X0[j]
+                    out.append(self.solve(bj, x0=xj,
+                                          zero_initial_guess=
+                                          zero_initial_guess))
+                return out
+            finally:
+                if suppress:
+                    self._suppress_recovery = False
 
         Bm = np.stack([np.asarray(bj).ravel() for bj in B])
         if self.scaler is not None:
@@ -997,8 +1206,14 @@ class Solver:
                     X, stats, history = self._maybe_aot(
                         "solve_multi", fn, call_args,
                         device=pin)(*call_args)
-            stats = np.asarray(stats)      # ONE host fetch: (k, 1+2m)
+            stats = np.asarray(stats)      # ONE host fetch: (k, 3+2m)
         solve_time = time.perf_counter() - t0
+        if fault is not None and \
+                (bool((stats[:, 1] != 0).any()) if self.monitor_residual
+                 else int(stats[:, 0].max()) > int(fault[1])):
+            # provoked iff ANY lane flagged the breakdown (monitored) /
+            # reached the target iteration (unmonitored)
+            faultinject.fired(fault[0], iteration=fault[1], batch=k)
         Xh = None
         if self._reorder is not None or self.scaler is not None:
             Xh = np.asarray(X)
@@ -1012,11 +1227,13 @@ class Solver:
             hist_all = np.asarray(history)
 
         results = []
-        m = (stats.shape[1] - 1) // 2
+        m = (stats.shape[1] - 3) // 2
         for j in range(k):
-            iters = int(stats[j, 0])
-            nrm = np.atleast_1d(stats[j, 1:1 + m])
-            nrm_ini = np.atleast_1d(stats[j, 1 + m:])
+            iters, brk_code, first_bad, nrm, nrm_ini = \
+                self._decode_stats(stats[j])
+            nrm = np.atleast_1d(nrm)
+            nrm_ini = np.atleast_1d(nrm_ini)
+            failure = None
             if Xh is not None:
                 xj = Xh[j]
                 if self._reorder is not None:
@@ -1033,7 +1250,8 @@ class Solver:
                 if self.convergence in ("RELATIVE_MAX",
                                         "RELATIVE_MAX_CORE") \
                         and history_np is not None:
-                    h = history_np[np.isfinite(history_np).all(axis=1)] \
+                    h = self._finite_history(history_np,
+                                             context=f"nrm_max[{j}]") \
                         if history_np.size else history_np
                     if h.size:
                         nrm_max = np.maximum(nrm_ini, h.max(axis=0))
@@ -1041,8 +1259,12 @@ class Solver:
                                                         nrm_max)))
                 diverged = bool(np.any(~np.isfinite(nrm)))
                 status = (SolveStatus.SUCCESS if conv else
-                          (SolveStatus.DIVERGED if diverged
-                           else SolveStatus.NOT_CONVERGED))
+                          (SolveStatus.DIVERGED if diverged else
+                           (SolveStatus.FAILED if brk_code
+                            else SolveStatus.NOT_CONVERGED)))
+                failure = self._classify_failure(conv, diverged,
+                                                 brk_code, first_bad,
+                                                 nrm, iters)
             else:
                 status = SolveStatus.SUCCESS
             if telemetry.is_enabled():
@@ -1051,6 +1273,17 @@ class Solver:
                                if bool(np.any(~np.isfinite(nrm)))
                                else "NOT_CONVERGED"))
                 telemetry.counter_inc("amgx_solves_total", status=label)
+                if failure is not None:
+                    # the serving layer executes everything through this
+                    # path — production breakdowns must land in the same
+                    # taxonomy counter/event the single-RHS path emits
+                    telemetry.counter_inc("amgx_solve_failures_total",
+                                          kind=failure.kind.value)
+                    telemetry.event("breakdown",
+                                    solver=self.config_name,
+                                    kind=failure.kind.value,
+                                    iteration=failure.iteration,
+                                    batch_lane=j)
             results.append(SolveResult(
                 x=xj, iterations=iters, status=status,
                 residual_norm=nrm,
@@ -1059,7 +1292,8 @@ class Solver:
                 residual_history=(history_np
                                   if self.store_res_history
                                   or self.print_solve_stats else None),
-                setup_time=self.setup_time, solve_time=solve_time))
+                setup_time=self.setup_time, solve_time=solve_time,
+                failure=failure))
         if telemetry.is_enabled():
             if self.forensics:
                 # drain in-flight forensics callbacks (see
@@ -1131,7 +1365,7 @@ class Solver:
                                call_args)(*call_args)
 
     def _emit_solve_telemetry(self, iters, nrm, nrm_ini, status,
-                              history, solve_time):
+                              history, solve_time, failure=None):
         """Per-solve telemetry: phase duration, iteration count, final
         relative residual, convergence-rate estimate, divergence event
         and the per-iteration residual trajectory (iteration 0 = the
@@ -1184,6 +1418,15 @@ class Solver:
                 telemetry.counter_inc("amgx_solve_diverged_total")
                 telemetry.event("divergence", solver=self.config_name,
                                 iteration=iters, norm=nrm_m)
+            if failure is not None:
+                # the taxonomy-kinded failure record (errors.FailureKind)
+                # — what the doctor's "failures & recovery" section and
+                # the recovery ladder's audit key on
+                telemetry.counter_inc("amgx_solve_failures_total",
+                                      kind=failure.kind.value)
+                telemetry.event("breakdown", solver=self.config_name,
+                                kind=failure.kind.value,
+                                iteration=failure.iteration)
             if history is not None:
                 for i, row in enumerate(np.atleast_2d(history)):
                     telemetry.event("residual", iteration=i,
@@ -1379,12 +1622,12 @@ class Solver:
             self._bindings.collect(), b_hi, b_lo, x_hi, x_lo,
             jnp.asarray(self.tolerance, wdt),
             jnp.asarray(self.max_iters, jnp.int32))
-        stats = np.asarray(stats)       # ONE small host fetch
-        iters = int(stats[0])
-        m = (len(stats) - 1) // 2
+        # ONE small host fetch; same wire layout as _packed_solve_fn
+        iters, brk_code, first_bad, nrm, nrm_ini = \
+            self._decode_stats(np.asarray(stats))
         # keep the wide-precision device solution: rounding x back to the
         # device dtype would throw away the digits refinement bought
-        return x64, iters, stats[1:1 + m], stats[1 + m:], history
+        return x64, iters, brk_code, first_bad, nrm, nrm_ini, history
 
     def _build_refined_fn(self, wide=np.float64) -> Callable:
         body = self._build_solve_fn()
@@ -1434,17 +1677,22 @@ class Solver:
                                       tol, alt_tol)
 
             def cond(c):
-                _x, _r, it_tot, _n, done, _h, k = c
+                _x, _r, it_tot, _n, done, _h, k, _f = c
                 return (~done) & (it_tot < it_limit) & (k < max_outer)
 
             def outer(c):
-                x64, r64, it_tot, _nrm, _done, hist, k = c
+                x64, r64, it_tot, _nrm, _done, hist, k, fail = c
                 scale = jnp.maximum(jnp.max(jnp.abs(r64)),
                                     jnp.asarray(tiny, f64))
                 rb = (r64 / scale).astype(dtype)
-                dx, it, _, _, h_in = body(
+                dx, it, _, _, h_in, f_in = body(
                     rb, jnp.zeros_like(rb),
                     jnp.asarray(inner_tol, dtype), it_limit - it_tot)
+                # the FIRST inner breakdown wins; its first-bad
+                # iteration re-bases onto the global iteration count
+                new = (fail[0] == 0) & (f_in[0] != 0)
+                fail = jnp.where(
+                    new, jnp.stack([f_in[0], it_tot + f_in[1]]), fail)
                 x64n = x64 + scale * dx.astype(f64)
                 r64n = b64 - self._spmv_wide(x64n, Ad64, wide)
                 nrm_n = norm64(r64n)
@@ -1462,16 +1710,19 @@ class Solver:
                                      hist)
                 done_n = check_convergence(crit, nrm_n, nrm_ini, nrm_ini,
                                            tol, alt_tol) \
-                    | ~jnp.all(jnp.isfinite(nrm_n))
+                    | ~jnp.all(jnp.isfinite(nrm_n)) \
+                    | (fail[0] != 0)
                 return (x64n, r64n, it_tot + it, nrm_n, done_n, hist,
-                        k + jnp.asarray(1, jnp.int32))
+                        k + jnp.asarray(1, jnp.int32), fail)
 
+            fail0 = jnp.stack([jnp.asarray(0, jnp.int32),
+                               jnp.asarray(-1, jnp.int32)])
             carry = (x64, r64, jnp.asarray(0, jnp.int32), nrm_ini, done0,
-                     hist, jnp.asarray(0, jnp.int32))
-            x64, r64, it_tot, nrm, done, hist, k = jax.lax.while_loop(
-                cond, outer, carry)
-            stats = jnp.concatenate([it_tot[None].astype(f64), nrm,
-                                     nrm_ini])
+                     hist, jnp.asarray(0, jnp.int32), fail0)
+            x64, r64, it_tot, nrm, done, hist, k, fail = \
+                jax.lax.while_loop(cond, outer, carry)
+            stats = jnp.concatenate([it_tot[None].astype(f64),
+                                     fail.astype(f64), nrm, nrm_ini])
             return x64, stats, hist
 
         return refined_fn
@@ -1516,6 +1767,11 @@ class Solver:
         max_iters = self.max_iters
         crit = self.convergence
         alt_tol = self.alt_rel_tolerance
+        # traced fault injection (utils/faultinject.py): None — the
+        # default — adds NOTHING to the jaxpr; an armed values_nan /
+        # krylov_zero point mutates the iteration state at one target
+        # iteration (solve() invalidates this body on arming changes)
+        fault = getattr(self, "_fault_trace", None)
 
         def solve_fn(b, x0, tol, it_limit):
             r0 = b - spmv(self.Ad, x0)
@@ -1528,42 +1784,80 @@ class Solver:
             state0 = self.solve_init(b, x0)
 
             def cond(carry):
-                x, state, it, nrm, nmax, done, hist = carry
+                x, state, it, nrm, nmax, done, brk, bad_it, hist = carry
                 return (~done) & (it < jnp.minimum(it_limit, max_iters))
 
             def body(carry):
-                x, state, it, nrm, nmax, done, hist = carry
+                x, state, it, nrm, nmax, done, brk, bad_it, hist = carry
                 x, state = self.solve_iteration(b, x, state, it)
+                if fault is not None:
+                    x, state = _inject_fault(fault, it, x, state)
                 if monitor:
                     est = self.residual_norm_estimate(b, x, state)
                     if est is None:
                         est = self.compute_residual_norm(b, x)
                     nrm = jnp.atleast_1d(est)
+                    # device-side breakdown flag: the solver's in-loop
+                    # guards (CG pAp/rho) carry a code in their state;
+                    # a flagged loop stops at THIS iteration instead of
+                    # burning the remaining budget, and the first-bad
+                    # iteration rides out in the packed stats.  The
+                    # KRYLOV code is provisional (collapsed scalars
+                    # also mean ordinary convergence) — it only sticks
+                    # while the monitored residual is alive, which the
+                    # carried norm already knows for free
+                    code = self.breakdown_code(state)
+                    if code is not None:
+                        alive = jnp.any(nrm > 0)
+                        code = jnp.where(
+                            (code == BREAKDOWN_KRYLOV) & ~alive,
+                            0, code)
+                        hit = (brk == 0) & (code != 0)
+                        brk = jnp.where(hit, code, brk)
+                        bad_it = jnp.where(hit, it + 1, bad_it)
                     nmax = jnp.maximum(nmax, nrm)
                     done = check_convergence(crit, nrm, nrm_ini, nmax,
                                              tol, alt_tol)
-                    done = done | ~jnp.all(jnp.isfinite(nrm))
+                    bad = ~jnp.all(jnp.isfinite(nrm))
+                    hit = (brk == 0) & bad
+                    brk = jnp.where(
+                        hit, jnp.where(jnp.any(jnp.isnan(nrm)),
+                                       BREAKDOWN_NAN,
+                                       BREAKDOWN_DIVERGENCE), brk)
+                    bad_it = jnp.where(hit, it + 1, bad_it)
+                    done = done | bad | (brk != 0)
                 if keep_history:
                     hist = hist.at[it + 1].set(nrm)
-                return x, state, it + 1, nrm, nmax, done, hist
+                return (x, state, it + 1, nrm, nmax, done, brk, bad_it,
+                        hist)
 
             done0 = jnp.asarray(False)
             if monitor:
                 done0 = check_convergence(crit, nrm_ini, nrm_ini, nrm_ini,
                                           tol, alt_tol)
-            carry = (x0, state0, jnp.asarray(0, jnp.int32), nrm_ini, nrm_ini,
-                     done0, history)
-            x, state, it, nrm, nmax, done, history = jax.lax.while_loop(
-                cond, body, carry)
+            carry = (x0, state0, jnp.asarray(0, jnp.int32), nrm_ini,
+                     nrm_ini, done0, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(-1, jnp.int32), history)
+            (x, state, it, nrm, nmax, done, brk, bad_it, history) = \
+                jax.lax.while_loop(cond, body, carry)
             x = self.solve_finalize(b, x, state)
             if monitor:
                 # the declared norm is a freshly computed TRUE residual —
                 # in-loop estimates (quasi-residual, CG recursion) only
                 # steer the loop (reference solver.cu:776-805)
                 nrm = jnp.atleast_1d(self.compute_residual_norm(b, x))
-            return x, it, nrm, nrm_ini, history
+            fail = jnp.stack([brk, bad_it])
+            return x, it, nrm, nrm_ini, history, fail
 
         return solve_fn
+
+    def breakdown_code(self, state) -> Optional[jax.Array]:
+        """Traced int32 breakdown code the solver's iteration state
+        carries (``errors.BREAKDOWN_*``; 0 = healthy).  Solvers with
+        in-loop guards (CG family: ``pAp < 0``, ``rho == 0``) keep a
+        ``brk`` field in their state; everything else returns None and
+        relies on the non-finite residual check."""
+        return getattr(state, "brk", None)
 
     def residual_norm_estimate(self, b, x, state):
         """Solvers with an implicit residual estimate (FGMRES quasi-residual)

@@ -22,9 +22,36 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..errors import BREAKDOWN_INDEFINITE, BREAKDOWN_KRYLOV
 from ..ops import blas
 from ..ops.spmv import spmv
 from .base import Solver, SolverFactory, register_solver
+
+
+def _cg_breakdown(brk, rz, pq):
+    """In-loop CG breakdown guard (reference: the reference detects
+    these only post-hoc; the TPU loop flags them ON DEVICE so the
+    convergence reduction stops within an iteration of the event):
+
+    * ``rho == 0`` or ``pAp == 0`` — z ⊥ r / A-null search direction,
+      the Krylov recursion cannot extend (``BREAKDOWN_KRYLOV``).  This
+      code is PROVISIONAL: at true convergence these scalars also
+      vanish, so the base monitor block discards it when the monitored
+      residual is dead (``Solver.breakdown_code`` contract) — which is
+      what lets the guard cost ZERO extra vector work per iteration
+      (the old residual-alive dot duplicated the carried norm);
+    * ``Re(pAp) < 0`` — the operator (or preconditioner) is not SPD
+      (``BREAKDOWN_INDEFINITE``).
+
+    The FIRST code sticks; 0 stays healthy.  NaN comparisons are False,
+    so a poisoned state falls through to the monitor's non-finite
+    check."""
+    kry = (rz == 0) | (pq == 0)
+    indef = jnp.real(pq) < 0
+    code = jnp.where(indef, BREAKDOWN_INDEFINITE,
+                     jnp.where(kry, BREAKDOWN_KRYLOV, 0)) \
+        .astype(jnp.int32)
+    return jnp.where(brk == 0, code, brk)
 
 
 class _PrecondMixin:
@@ -57,6 +84,7 @@ class _CGState(NamedTuple):
     r: jax.Array
     p: jax.Array
     rz: jax.Array
+    brk: jax.Array      # int32 breakdown code (errors.BREAKDOWN_*)
 
 
 @register_solver("CG")
@@ -76,12 +104,17 @@ class CGSolver(Solver):
         r = b - spmv(self.Ad, x)
         z = self._M(r)
         rz = blas.dot(r, z)
-        return _CGState(r=r, p=z, rz=rz)
+        return _CGState(r=r, p=z, rz=rz,
+                        brk=jnp.zeros((), jnp.int32))
 
     def solve_iteration(self, b, x, state, iter_idx):
-        r, p, rz = state
+        r, p, rz, brk = state
+        # breakdown guards: incoming rho collapse / new pAp sign
+        # (provisional — the base monitor block validates against the
+        # carried residual norm; see _cg_breakdown)
         q = spmv(self.Ad, p)
         pq = blas.dot(p, q)
+        brk = _cg_breakdown(brk, rz, pq)
         alpha = jnp.where(pq != 0, rz / jnp.where(pq == 0, 1.0, pq), 0.0)
         x = x + alpha * p
         r = r - alpha * q
@@ -89,7 +122,7 @@ class CGSolver(Solver):
         rz_new = blas.dot(r, z)
         beta = jnp.where(rz != 0, rz_new / jnp.where(rz == 0, 1.0, rz), 0.0)
         p = z + beta * p
-        return x, _CGState(r=r, p=p, rz=rz_new)
+        return x, _CGState(r=r, p=p, rz=rz_new, brk=brk)
 
     def residual_norm_estimate(self, b, x, state):
         return blas.norm(state.r, self.norm_type, self.Ad.block_dim,
@@ -111,6 +144,7 @@ class _PCGFState(NamedTuple):
     z: jax.Array
     p: jax.Array
     rz: jax.Array
+    brk: jax.Array      # int32 breakdown code (errors.BREAKDOWN_*)
 
 
 @register_solver("PCGF")
@@ -126,12 +160,14 @@ class PCGFSolver(_PrecondMixin, Solver):
         r = b - spmv(self.Ad, x)
         z = self._apply_M(r)
         rz = blas.dot(r, z)
-        return _PCGFState(r=r, z=z, p=z, rz=rz)
+        return _PCGFState(r=r, z=z, p=z, rz=rz,
+                          brk=jnp.zeros((), jnp.int32))
 
     def solve_iteration(self, b, x, state, iter_idx):
-        r, z, p, rz = state
+        r, z, p, rz, brk = state
         q = spmv(self.Ad, p)
         pq = blas.dot(p, q)
+        brk = _cg_breakdown(brk, rz, pq)
         alpha = jnp.where(pq != 0, rz / jnp.where(pq == 0, 1.0, pq), 0.0)
         x = x + alpha * p
         r_new = r - alpha * q
@@ -141,7 +177,7 @@ class PCGFSolver(_PrecondMixin, Solver):
         beta_num = rz_new - blas.dot(r, z_new)
         beta = jnp.where(rz != 0, beta_num / jnp.where(rz == 0, 1.0, rz), 0.0)
         p = z_new + beta * p
-        return x, _PCGFState(r=r_new, z=z_new, p=p, rz=rz_new)
+        return x, _PCGFState(r=r_new, z=z_new, p=p, rz=rz_new, brk=brk)
 
     def residual_norm_estimate(self, b, x, state):
         return blas.norm(state.r, self.norm_type, self.Ad.block_dim,
@@ -156,6 +192,7 @@ class _BiCGStabState(NamedTuple):
     rho: jax.Array
     alpha: jax.Array
     omega: jax.Array
+    brk: jax.Array      # int32 breakdown code (errors.BREAKDOWN_*)
 
 
 class _BiCGStabBase(Solver):
@@ -169,11 +206,16 @@ class _BiCGStabBase(Solver):
         one = jnp.asarray(1.0, r.dtype)
         return _BiCGStabState(r=r, r_star=r, p=jnp.zeros_like(r),
                               v=jnp.zeros_like(r), rho=one, alpha=one,
-                              omega=one)
+                              omega=one, brk=jnp.zeros((), jnp.int32))
 
     def solve_iteration(self, b, x, state, iter_idx):
-        r, r_star, p, v, rho, alpha, omega = state
+        r, r_star, p, v, rho, alpha, omega, brk = state
         rho_new = blas.dot(r_star, r)
+        # the classic BiCGStab serious breakdown: r ⊥ r* — provisional
+        # (the base monitor block discards it when the residual is
+        # dead, i.e. ordinary convergence)
+        brk = jnp.where((brk == 0) & (rho_new == 0),
+                        jnp.asarray(BREAKDOWN_KRYLOV, jnp.int32), brk)
         safe = lambda d: jnp.where(d == 0, 1.0, d)
         beta = (rho_new / safe(rho)) * (alpha / safe(omega))
         p = r + beta * (p - omega * v)
@@ -188,7 +230,7 @@ class _BiCGStabBase(Solver):
         x = x + alpha * p_hat + omega * s_hat
         r = s - omega * t
         return x, _BiCGStabState(r=r, r_star=r_star, p=p, v=v, rho=rho_new,
-                                 alpha=alpha, omega=omega)
+                                 alpha=alpha, omega=omega, brk=brk)
 
     def residual_norm_estimate(self, b, x, state):
         return blas.norm(state.r, self.norm_type, self.Ad.block_dim,

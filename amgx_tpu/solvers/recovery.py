@@ -1,0 +1,260 @@
+"""The automatic recovery ladder: bounded, telemetry-audited escalation.
+
+When a monitored solve terminates in failure (breakdown, divergence,
+stagnation — see :class:`~amgx_tpu.errors.FailureKind`) and the
+``recovery_policy`` knob is ``AUTO``, the driver walks a **bounded
+ladder** of increasingly expensive repairs instead of handing the
+caller a dead result:
+
+1. **restart** — re-run the Krylov loop from the last finite iterate
+   (a fresh Krylov space sheds the poisoned/collapsed basis; costs one
+   more solve, reuses every compiled executable);
+2. **promote** — one precision rung up (PR 10's promotion plan, now
+   triggered by *breakdown* rather than only tolerance floors: the
+   narrow pack re-runs under the defect-correction outer loop bounded
+   by the uploaded host matrix);
+3. **conservative** — rebuild with a conservative smoother config
+   (a Chebyshev smoother with bad spectrum bounds amplifies — swap to
+   Jacobi and re-setup a twin solver; the user's solver is untouched);
+4. **resetup** — full setup from the original operator (the hierarchy
+   itself may be poisoned — e.g. an injected upload corruption).
+
+Each attempt emits a schema-validated ``recovery_attempt`` event and an
+``amgx_recovery_total{kind,action,outcome}`` counter sample, so a
+production trace says exactly which breakdowns happened, what fixed
+them, and what it cost.  The ladder is *bounded* by
+``recovery_max_attempts`` and never recurses (attempt solves run with
+``_in_recovery`` set).
+
+Off (``recovery_policy=NONE``, the default) this module is never
+imported by the solve path.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..errors import FailureInfo, FailureKind, SolveStatus
+
+#: ladder rungs, cheapest first — the vocabulary of the
+#: ``recovery_attempt`` event and the amgx_recovery_total action label
+ACTIONS = ("restart", "promote", "conservative", "resetup")
+
+#: smoother knobs swapped by the conservative rung (any non-Jacobi
+#: smoother — Chebyshev with a bad spectrum estimate, an aggressive
+#: GS/DILU — falls back to the unconditionally-safe damped Jacobi)
+_SMOOTHER_KNOBS = ("smoother", "fine_smoother", "coarse_smoother")
+_SAFE_SMOOTHERS = ("BLOCK_JACOBI", "JACOBI_L1", "CF_JACOBI")
+
+
+class _Skip(Exception):
+    """A rung that cannot apply to this solver/config (no wider rung to
+    promote to, already-conservative smoother) — audited as outcome
+    ``skipped``, burns no attempt budget."""
+
+
+def _failure_kind(result) -> FailureKind:
+    if result.failure is not None:
+        return result.failure.kind
+    nrm = result.residual_norm
+    if nrm is not None and not np.all(np.isfinite(np.asarray(nrm))):
+        return FailureKind.DIVERGENCE
+    return FailureKind.STAGNATION
+
+
+def _finite_start(result, x0):
+    """The restart iterate: the failed solve's x when every entry is
+    finite (a stagnated/indefinite exit keeps its progress), else the
+    caller's original guess."""
+    try:
+        x = np.asarray(result.x)
+        if x.size and np.all(np.isfinite(x)):
+            return x, False
+    except Exception:
+        pass
+    return x0, True
+
+
+def _solve_again(solver, b, x0, zero_initial_guess):
+    return solver.solve(b, x0=x0, zero_initial_guess=zero_initial_guess)
+
+
+def _act_restart(solver, b, x0, zero_initial_guess, last):
+    x_start, fell_back = _finite_start(last, x0)
+    if fell_back:
+        return _solve_again(solver, b, x0, zero_initial_guess)
+    # under a RELATIVE_* criterion the restarted solve's baseline is
+    # the (already reduced) residual at the restart iterate — rescale
+    # the tolerance so the restart chases the ORIGINAL target instead
+    # of eight more orders from wherever the first leg stopped.  The
+    # tolerance rides the jitted body as an argument, so no retrace.
+    tol = solver.tolerance
+    scaled = None
+    if solver.convergence.startswith("RELATIVE") \
+            and last.residual_history is not None \
+            and len(last.residual_history) \
+            and last.residual_norm is not None:
+        ini = float(np.max(np.atleast_1d(last.residual_history[0])))
+        cur = float(np.max(np.atleast_1d(last.residual_norm)))
+        if np.isfinite(ini) and np.isfinite(cur) and 0 < cur and 0 < ini:
+            scaled = min(tol * ini / cur, 0.5)
+    try:
+        if scaled is not None:
+            solver.tolerance = scaled
+        return solver.solve(b, x0=x_start, zero_initial_guess=False)
+    finally:
+        solver.tolerance = tol
+
+
+def _act_promote(solver, b, x0, zero_initial_guess, last):
+    base_refine, _w, _s = solver._promotion_plan()
+    if base_refine:
+        # the failed solve ALREADY ran under the promotion rung (deep
+        # tolerance on a narrow pack) — forcing it again would re-run
+        # the identical refined solve and burn an attempt for nothing
+        raise _Skip("solve already ran at the promoted rung")
+    solver._force_promotion = True
+    try:
+        refine, _wide, _structural = solver._promotion_plan()
+        if not refine:
+            raise _Skip("no wider promotion rung available "
+                        "(host matrix not wider than the device pack, "
+                        "or structurally unrefinable)")
+        return _solve_again(solver, b, x0, zero_initial_guess)
+    finally:
+        solver._force_promotion = False
+
+
+def _setup_source(solver):
+    """The operator the rebuild rungs re-setup from: the pre-scaling
+    stash when present; the solver's working matrix only when it is
+    the caller's original (re-running setup on a scaled/reordered COPY
+    would scale twice — skip instead)."""
+    A = getattr(solver, "_setup_input", None)
+    if A is not None:
+        return A
+    if solver.scaler is not None \
+            or getattr(solver, "_reorder", None) is not None:
+        raise _Skip("original operator unavailable (solver holds a "
+                    "scaled/reordered copy only)")
+    return solver.A if solver.A is not None else solver.Ad
+
+
+def _act_conservative(solver, b, x0, zero_initial_guess, last):
+    cfg = solver.cfg.clone()
+    swapped = []
+    for (scope, name), (value, new_scope) in list(cfg._params.items()):
+        if name in _SMOOTHER_KNOBS and value not in _SAFE_SMOOTHERS:
+            # keep the entry's sub-scope binding: the Jacobi twin reads
+            # its params from the same scope the old smoother did (and
+            # ignores the Chebyshev-specific ones)
+            cfg._params[(scope, name)] = ("BLOCK_JACOBI", new_scope)
+            swapped.append(f"{scope}:{name}={value}")
+    if not swapped:
+        raise _Skip("smoother stack is already conservative")
+    from .base import SolverFactory
+    A = _setup_source(solver)
+    twin = SolverFactory.create(solver.config_name, cfg, solver.scope)
+    twin._toplevel = getattr(solver, "_toplevel", False)
+    twin._in_recovery = True
+    twin.setup(A)
+    return twin.solve(b, x0=x0, zero_initial_guess=zero_initial_guess)
+
+
+def _act_resetup(solver, b, x0, zero_initial_guess, last):
+    solver.setup(_setup_source(solver))
+    return _solve_again(solver, b, x0, zero_initial_guess)
+
+
+_ACTION_FN = {"restart": _act_restart, "promote": _act_promote,
+              "conservative": _act_conservative,
+              "resetup": _act_resetup}
+
+
+def _audit(kind: FailureKind, action: str, attempt: int, outcome: str,
+           solver, wall_s: float, detail: str = ""):
+    telemetry.counter_inc("amgx_recovery_total", kind=kind.value,
+                          action=action, outcome=outcome)
+    if telemetry.is_enabled():
+        telemetry.event("recovery_attempt", kind=kind.value,
+                        action=action, attempt=int(attempt),
+                        outcome=outcome, solver=solver.config_name,
+                        wall_s=round(wall_s, 6),
+                        **({"detail": detail[:200]} if detail else {}))
+        if getattr(solver, "telemetry_path", ""):
+            # the audit lands AFTER the attempt solve's own incremental
+            # flush — without this, a streaming trace would always be
+            # missing its final recovery record
+            telemetry.flush_jsonl(solver.telemetry_path)
+
+
+def maybe_recover(solver, b, x0, zero_initial_guess: bool, result):
+    """Walk the ladder for a failed ``result``; returns the recovered
+    result (``.recovery`` records the audit) or the best failing one
+    (``.recovery["outcome"] == "exhausted"``).  Never raises: a rung
+    that errors is audited and the ladder escalates past it.
+
+    Scope: the SINGLE-RHS solve path only.  Batched ``solve_multi``
+    lanes report their :class:`FailureInfo` without recovery — in the
+    serving layer the retry budget / quarantine are the batched path's
+    recovery story, and an in-ladder re-solve there would silently
+    multiply a whole batch's deadline by the attempt count."""
+    kind = _failure_kind(result)
+    budget = max(0, int(solver.recovery_max_attempts))
+    if budget == 0:
+        return result
+    solver._in_recovery = True
+    attempt = 0
+    last = result
+    last_action = None
+    try:
+        for action in ACTIONS:
+            if attempt >= budget:
+                break
+            t0 = time.perf_counter()
+            try:
+                cand = _ACTION_FN[action](solver, b, x0,
+                                          zero_initial_guess, last)
+            except _Skip as sk:
+                # an inapplicable rung burns no budget — audit and
+                # escalate
+                _audit(kind, action, attempt, "skipped", solver,
+                       time.perf_counter() - t0, detail=str(sk))
+                continue
+            except Exception as e:  # noqa: BLE001 — the ladder must
+                # never raise past the solve that invoked it; the
+                # failure is audited and the next rung tries
+                attempt += 1
+                _audit(kind, action, attempt, "error", solver,
+                       time.perf_counter() - t0,
+                       detail=f"{type(e).__name__}: {e}")
+                last_action = action
+                continue
+            attempt += 1
+            last_action = action
+            ok = cand is not None and cand.status == SolveStatus.SUCCESS
+            _audit(kind, action, attempt,
+                   "recovered" if ok else "failed", solver,
+                   time.perf_counter() - t0)
+            if cand is not None:
+                last = cand
+            if ok:
+                cand.recovery = {"kind": kind.value, "action": action,
+                                 "attempts": attempt,
+                                 "outcome": "recovered"}
+                return cand
+        # ladder exhausted: hand back the best failing result with the
+        # audit attached (and one terminal counter sample so dashboards
+        # can alert on unrecovered breakdowns without event parsing)
+        telemetry.counter_inc("amgx_recovery_total", kind=kind.value,
+                              action="ladder", outcome="exhausted")
+        last.recovery = {"kind": kind.value, "action": last_action,
+                         "attempts": attempt, "outcome": "exhausted"}
+        if last.failure is None:
+            last.failure = result.failure or FailureInfo(kind=kind)
+        return last
+    finally:
+        solver._in_recovery = False

@@ -428,6 +428,65 @@ def diagnose(paths: List[str]) -> dict:
                                     for k, v in sorted(cc_fb_by.items())},
         }
 
+    # ---- failures & recovery (errors.FailureKind +
+    # ---- solvers/recovery.py + utils/faultinject.py) ----------------
+    recov_total, recov_by = csum("amgx_recovery_total")
+    fi_total, fi_by = csum("amgx_fault_injected_total")
+    trunc_total, _ = csum("amgx_history_truncated_total")
+    fail_total, fail_by = csum("amgx_solve_failures_total")
+    q_total, _ = csum("amgx_serve_quarantined_total")
+    serve_retries, _ = csum("amgx_serve_retries_total")
+    breaker_trips, _ = csum("amgx_serve_breaker_trips_total")
+    recovery_events: List[dict] = []
+    breakdown_events: List[dict] = []
+    quarantine_events: List[dict] = []
+    for s in agg["sessions"]:
+        for r in s["records"]:
+            if r["kind"] != "event":
+                continue
+            if r["name"] == "recovery_attempt":
+                recovery_events.append(dict(r["attrs"]))
+            elif r["name"] == "breakdown":
+                breakdown_events.append(dict(r["attrs"]))
+            elif r["name"] == "pattern_quarantined":
+                quarantine_events.append(dict(r["attrs"]))
+    failures = None
+    if recov_total or recovery_events or fi_total or trunc_total \
+            or fail_total or q_total or serve_retries or breaker_trips:
+        recovered = sum(
+            v for k, v in recov_by.items() if "outcome=recovered" in k)
+        exhausted = sum(
+            v for k, v in recov_by.items() if "outcome=exhausted" in k)
+        # EXECUTED attempts only: the terminal action=ladder sample and
+        # skipped (inapplicable, zero-budget) rungs are audit records,
+        # not attempts — counting them would inflate the attempt total
+        # and mis-fire the repeated-engagement hint
+        att_counter = sum(
+            v for k, v in recov_by.items()
+            if "action=ladder" not in k and "outcome=skipped" not in k)
+        att_events = sum(
+            1 for e in recovery_events
+            if e.get("action") != "ladder"
+            and e.get("outcome") != "skipped")
+        failures = {
+            "solve_failures_by_kind": {
+                k: int(v) for k, v in sorted(fail_by.items())},
+            "breakdowns": breakdown_events[-8:],
+            "recovery_attempts": int(max(att_counter, att_events)),
+            "recovery_by": {k: int(v)
+                            for k, v in sorted(recov_by.items())},
+            "recovered": int(recovered),
+            "exhausted": int(exhausted),
+            "recovery_events": recovery_events[-16:],
+            "fault_injected": {k: int(v)
+                               for k, v in sorted(fi_by.items())},
+            "history_truncated": int(trunc_total),
+            "quarantined": int(q_total),
+            "quarantine_events": quarantine_events[-8:],
+            "serve_retries": int(serve_retries),
+            "breaker_trips": int(breaker_trips),
+        }
+
     # ---- hints ------------------------------------------------------
     hints: List[str] = []
     if agg["dropped_records"]:
@@ -515,6 +574,49 @@ def diagnose(paths: List[str]) -> dict:
     if divergences:
         hints.append(f"{int(divergences)} divergence event(s): a "
                      "residual went non-finite")
+    if failures:
+        # the recovery ladder saving a solve ONCE is working as
+        # designed; repeated engagement means the underlying breakdown
+        # keeps happening — a masked root cause burning 2-5× solve cost
+        n_rec = failures["recovery_attempts"]
+        if n_rec >= 2:
+            kinds = sorted({str(e.get("kind")) for e
+                            in failures["recovery_events"]}
+                           or set(failures["solve_failures_by_kind"]))
+            hints.append(
+                f"recovery ladder engaged {n_rec}× "
+                f"({failures['recovered']} recovered, "
+                f"{failures['exhausted']} exhausted"
+                + (f"; kinds: {', '.join(k for k in kinds if k)}"
+                   if kinds else "")
+                + ") — recovered solves pay 2-5× wall cost; find the "
+                  "root cause in the breakdown kinds instead of "
+                  "relying on the ladder")
+        if failures["exhausted"]:
+            hints.append(
+                f"{failures['exhausted']} solve(s) exhausted the "
+                "recovery ladder unrecovered — the failure survives "
+                "restart, promotion, a conservative smoother AND a "
+                "full re-setup: suspect the operator/rhs themselves")
+        if failures["fault_injected"]:
+            pts = ", ".join(f"{k}: {v}" for k, v
+                            in failures["fault_injected"].items())
+            hints.append(
+                f"fault injection was ACTIVE in this trace ({pts}) — "
+                "failures here include synthetic chaos faults, not "
+                "production signal")
+        if failures["history_truncated"]:
+            hints.append(
+                f"{failures['history_truncated']} residual history "
+                "slab(s) carried non-finite rows (history_truncated "
+                "events name the first bad iteration) — the iteration "
+                "record around a breakdown is partial")
+        if failures["quarantined"]:
+            hints.append(
+                f"{failures['quarantined']} pattern(s) quarantined "
+                "after repeated setup/solve errors — clients of those "
+                "patterns are being rejected at admission; fix the "
+                "operator and lift via SolveService.unquarantine()")
     hints.extend(_forensics_hints(fr))
     hints.extend(_setup_hints(setup, setup_fallbacks, compile_cache))
     if compile_cache and compile_cache["fallbacks"]:
@@ -622,6 +724,7 @@ def diagnose(paths: List[str]) -> dict:
         "serving": serving,
         "serving_lanes": lanes_diag,
         "slo": slo,
+        "failures": failures,
         "convergence": dict(conv, trails=len(trails),
                             plateau=plateau, divergences=int(divergences)),
         "forensics": fr,
@@ -1056,6 +1159,40 @@ def render(d: dict) -> str:
                          + (f"{m * 1e3:>10.2f}"
                             if isinstance(m, (int, float))
                             else f"{'?':>10}"))
+
+    fl = d.get("failures")
+    if fl:
+        L.append("")
+        L.append("failures & recovery")
+        L.append("-" * 40)
+        for k, v in fl.get("solve_failures_by_kind", {}).items():
+            L.append(f"  failed solves {k:<24} {v}")
+        for e in fl.get("breakdowns", []):
+            it = e.get("iteration")
+            L.append(f"  breakdown {str(e.get('kind')):<20}"
+                     + (f" at iteration {it}" if it is not None else ""))
+        if fl.get("recovery_attempts"):
+            L.append(f"  recovery attempts: {fl['recovery_attempts']}"
+                     f"  (recovered {fl.get('recovered', 0)}, "
+                     f"exhausted {fl.get('exhausted', 0)})")
+            for e in fl.get("recovery_events", []):
+                L.append(f"    {str(e.get('kind')):<20}"
+                         f"{str(e.get('action')):<14}"
+                         f"-> {e.get('outcome')}")
+        for k, v in fl.get("fault_injected", {}).items():
+            L.append(f"  INJECTED {k:<24} {v}")
+        if fl.get("history_truncated"):
+            L.append(f"  history truncations: "
+                     f"{fl['history_truncated']}")
+        if fl.get("quarantined"):
+            L.append(f"  quarantined patterns: {fl['quarantined']}")
+            for e in fl.get("quarantine_events", []):
+                L.append(f"    {e.get('pattern')} after "
+                         f"{e.get('failures')} failures")
+        if fl.get("serve_retries"):
+            L.append(f"  serve request retries: {fl['serve_retries']}")
+        if fl.get("breaker_trips"):
+            L.append(f"  lane breaker trips: {fl['breaker_trips']}")
 
     setup = d.get("setup")
     if setup:

@@ -232,6 +232,43 @@ def validate_record(rec: dict):
             need(a["to_parts"] >= 1
                  and a["to_parts"] <= a["from_parts"],
                  "dist_agglomerate event has non-shrinking parts")
+        if rec["name"] == "recovery_attempt":
+            # recovery-ladder audit records (solvers/recovery.py) are
+            # the doctor's "failures & recovery" input — a drifting
+            # kind/action/outcome vocabulary would silently un-count
+            # recoveries
+            a = rec["attrs"]
+            from ..errors import FailureKind
+            kinds = frozenset(k.value for k in FailureKind)
+            need(a.get("kind") in kinds,
+                 f"recovery_attempt event has unknown kind "
+                 f"{a.get('kind')!r}")
+            need(a.get("action") in ("restart", "promote",
+                                     "conservative", "resetup",
+                                     "ladder"),
+                 f"recovery_attempt event has unknown action "
+                 f"{a.get('action')!r}")
+            need(isinstance(a.get("attempt"), int) and a["attempt"] >= 0,
+                 "recovery_attempt event missing attempt")
+            need(a.get("outcome") in ("recovered", "failed", "error",
+                                      "skipped", "exhausted"),
+                 f"recovery_attempt event has unknown outcome "
+                 f"{a.get('outcome')!r}")
+        if rec["name"] == "fault_injected":
+            # chaos-run provenance: every synthetic failure in a trace
+            # must name its injection point
+            need(isinstance(rec["attrs"].get("point"), str)
+                 and rec["attrs"]["point"],
+                 "fault_injected event missing point")
+        if rec["name"] == "history_truncated":
+            # forensics contract: a truncated iteration record says
+            # where truncation began and how much is gone
+            a = rec["attrs"]
+            need(isinstance(a.get("first_bad_iteration"), int)
+                 and a["first_bad_iteration"] >= 0,
+                 "history_truncated event missing first_bad_iteration")
+            need(isinstance(a.get("dropped"), int) and a["dropped"] >= 1,
+                 "history_truncated event missing dropped count")
         if rec["name"] == "device_setup_fallback":
             # fallback events are the doctor's per-level "why did rap
             # run host-side" input (amg/device_setup/)

@@ -221,7 +221,18 @@ class ObservabilityHTTPD:
         the real port from :attr:`port`).  Idempotent."""
         if self._server is not None:
             return self
-        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        # a just-released port can linger in TIME_WAIT for a beat after
+        # a restart — give the bind a short bounded retry
+        # (utils/retry.py) instead of failing the endpoint start
+        import errno
+
+        from ..utils.retry import retry_call
+        srv = retry_call(
+            lambda: ThreadingHTTPServer((host, int(port)), _Handler),
+            max_attempts=3, base_delay_s=0.05,
+            retryable=lambda e: isinstance(e, OSError)
+            and getattr(e, "errno", None) == errno.EADDRINUSE,
+            label="httpd_bind")
         srv.daemon_threads = True
         srv.owner = self
         self._server = srv

@@ -247,6 +247,36 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "amgx_serve_replications_total":
         ("counter", "hot patterns replicated onto an idle lane "
                     "{lane=replica lane}"),
+    # ---- breakdown-aware solving (errors.FailureKind +
+    # ---- solvers/recovery.py + utils/faultinject.py, ISSUE 13) ------
+    "amgx_solve_failures_total":
+        ("counter", "monitored solves that terminated in failure, by "
+                    "taxonomy kind {kind}"),
+    "amgx_history_truncated_total":
+        ("counter", "residual-history slabs whose non-finite rows were "
+                    "filtered (each emits a history_truncated event "
+                    "with the first bad iteration)"),
+    "amgx_recovery_total":
+        ("counter", "recovery-ladder attempts {kind,action,outcome}"),
+    "amgx_fault_injected_total":
+        ("counter", "fault-injection firings by point {point}"),
+    "amgx_retries_total":
+        ("counter", "bounded transient-failure retries "
+                    "(utils/retry.py) {label}"),
+    "amgx_worker_respawns_total":
+        ("counter", "worker pools re-created after out-of-band "
+                    "death/shutdown was detected"),
+    "amgx_serve_retries_total":
+        ("counter", "serve requests re-queued by the per-request "
+                    "execution retry budget (serve_retry_max)"),
+    "amgx_serve_quarantined_total":
+        ("counter", "patterns quarantined after consecutive error "
+                    "outcomes (serve_quarantine_threshold)"),
+    "amgx_serve_quarantined_patterns":
+        ("gauge", "patterns currently rejected at admission by the "
+                  "quarantine"),
+    "amgx_serve_breaker_trips_total":
+        ("counter", "executor-lane circuit-breaker trips {lane}"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)

@@ -5,10 +5,13 @@ from .profiler import cpu_profiler, profiler_tree, TimerMap
 from .determinism import checksum, determinism_checker, DeterminismChecker
 from .memory import memory_info, MemoryInfo
 from .matrix_analysis import analyze_matrix, estimate_spectral_bounds
+from .retry import RetryPolicy, retry_call
+from . import faultinject
 
 __all__ = ["amgx_output", "error_output", "amgx_distributed_output",
            "register_print_callback", "set_verbosity", "get_verbosity",
            "cpu_profiler", "profiler_tree", "TimerMap",
            "checksum", "determinism_checker", "DeterminismChecker",
            "memory_info", "MemoryInfo",
-           "analyze_matrix", "estimate_spectral_bounds"]
+           "analyze_matrix", "estimate_spectral_bounds",
+           "RetryPolicy", "retry_call", "faultinject"]

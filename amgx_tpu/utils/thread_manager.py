@@ -40,6 +40,9 @@ class ThreadManager:
         #: tasks that raised since construction (cumulative; the pool
         #: survives every one of them)
         self.failed_tasks = 0
+        #: pools re-created after worker death / out-of-band shutdown
+        #: was detected (push_work checks liveness before submitting)
+        self.respawns = 0
 
     # ------------------------------------------------ reference API names
     def spawn_threads(self) -> None:
@@ -51,6 +54,40 @@ class ThreadManager:
                     max_workers=self._max_workers,
                     thread_name_prefix="amgx-worker")
 
+    def ensure_alive(self) -> bool:
+        """Worker-death detection: a pool that was shut down out of
+        band (or whose worker threads all died) is replaced with a
+        fresh one so the NEXT task runs instead of raising
+        ``RuntimeError: cannot schedule new futures``.  Returns True
+        when a respawn happened.  The detection AND replacement run
+        under one lock so a concurrent ``push_work`` never observes a
+        half-respawned (None) pool.  In-flight futures of the dead
+        pool stay failed — their requests complete with a terminal
+        error through the batch task's own guards, never a hang."""
+        if self.serialize:
+            return False
+        with self._spawn_lock:
+            pool = self._pool
+            if pool is None:
+                return False
+            dead = getattr(pool, "_shutdown", False)
+            if not dead:
+                threads = getattr(pool, "_threads", None)
+                dead = bool(threads) and all(not t.is_alive()
+                                             for t in threads)
+            if not dead:
+                return False
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=self._max_workers,
+                thread_name_prefix="amgx-worker")
+            self.respawns += 1
+        try:
+            from ..telemetry import metrics as _m
+            _m.counter_inc("amgx_worker_respawns_total")
+        except Exception:
+            pass    # telemetry must never block the respawn
+        return True
+
     def _guard(self, task: Callable[[], None]):
         """Exception-safe task wrapper: count + record the failure (the
         telemetry counter makes silent worker deaths observable) and
@@ -58,6 +95,12 @@ class ThreadManager:
         fail-the-caller contract.  The executor worker itself survives
         and keeps draining the queue."""
         try:
+            # chaos harness (utils/faultinject.py): the worker_death
+            # point kills THIS task the way a crashing worker would —
+            # the guard's accounting below proves the pool survives it
+            from .faultinject import WorkerDeathError, maybe_raise
+            maybe_raise("worker_death",
+                        WorkerDeathError("injected worker death"))
             return task()
         except BaseException:
             with self._fail_lock:
@@ -69,18 +112,36 @@ class ThreadManager:
                 pass    # telemetry must never mask the task's failure
             raise
 
-    def push_work(self, task: Callable[[], None]) -> None:
+    def push_work(self, task: Callable[[], None]
+                  ) -> "Optional[concurrent.futures.Future]":
         """Queue one AsyncTask; runs inline under ``serialize_threads``.
 
         ``push_work`` before :meth:`spawn_threads` auto-spawns the pool
         (the old behaviour ran the task inline, silently serialising a
-        caller that forgot to spawn)."""
+        caller that forgot to spawn).  Returns the Future (None under
+        ``serialize``) so callers that must observe worker death — the
+        serving lanes, whose in-flight requests would otherwise hang if
+        a worker died before entering the batch body — can attach a
+        done-callback."""
         if self.serialize:
             self._guard(task)
-            return
+            return None
         if self._pool is None:
             self.spawn_threads()
-        self._futures.append(self._pool.submit(self._guard, task))
+        else:
+            self.ensure_alive()
+        try:
+            fut = self._pool.submit(self._guard, task)
+        except RuntimeError:
+            # raced a shutdown between the liveness check and submit:
+            # respawn once and retry — a second failure is a real bug
+            self.ensure_alive()
+            fut = self._pool.submit(self._guard, task)
+        self._futures.append(fut)
+        self._prune()
+        return fut
+
+    def _prune(self):
         if len(self._futures) >= 512:
             # long-running users (the serving dispatcher) push work for
             # the process lifetime and only wait at drain — prune

@@ -640,6 +640,56 @@ def _bench_distributed():
     return parsed
 
 
+def _bench_chaos(make_matrix, cfg_str, dtype, scope="out"):
+    """AMGX_BENCH_CHAOS=1: inject ONE NaN-poison fault into the
+    headline solver stack with the recovery ladder armed, and report
+    the recovered-solve overhead vs the clean solve.
+
+    The recovered wall time includes everything a real chaos event
+    costs: the early in-loop detection, the injection-armed retrace of
+    the solve body, and the ladder's restart solve — so ``overhead_x``
+    is the honest price of surviving one poisoned solve, not just the
+    extra iterations.  A final clean solve proves the disarmed path
+    retraces back to the fast body."""
+    import numpy as np
+
+    import amgx_tpu as amgx
+    from amgx_tpu.errors import SolveStatus
+    from amgx_tpu.utils import faultinject
+
+    m = make_matrix()
+    n = m.shape[0]
+    b = np.ones(n, dtype=np.float64)
+    cfg = amgx.AMGConfig(cfg_str + f", {scope}:recovery_policy=AUTO")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    slv.solve(b)                       # warm: compile the clean body
+    t0 = time.perf_counter()
+    r_clean = slv.solve(b)
+    clean_s = time.perf_counter() - t0
+    faultinject.configure("values_nan:iter=3:count=1")
+    try:
+        t0 = time.perf_counter()
+        r_chaos = slv.solve(b)
+        recovered_s = time.perf_counter() - t0
+        injected = faultinject.stats()
+    finally:
+        faultinject.reset()
+    r_after = slv.solve(b)             # disarmed: clean retrace works
+    return {
+        "clean_solve_s": round(clean_s, 6),
+        "recovered_solve_s": round(recovered_s, 6),
+        "overhead_x": (round(recovered_s / clean_s, 3)
+                       if clean_s > 0 else None),
+        "recovered": bool(r_chaos.status == SolveStatus.SUCCESS),
+        "recovery": r_chaos.recovery,
+        "clean_iterations": int(r_clean.iterations),
+        "recovered_iterations": int(r_chaos.iterations),
+        "after_status": int(r_after.status),
+        "injected": injected,
+    }
+
+
 def _bench_serving(n_side: int = 12, n_requests: int = 32):
     """Serving-mode benchmark: drive the request-level layer
     (amgx_tpu/serve/) with concurrent same-pattern traffic and report
@@ -857,28 +907,33 @@ def main():
     # STRUCTURED diagnostic: a flaky TPU worker (BENCH_r05) otherwise
     # leaves an unparseable traceback and an empty bench record.  A
     # transient worker hiccup gets ONE retry after a short backoff
-    # before the round is declared unusable; either way the JSON
-    # carries ``retried`` so flaky and dead rounds stay distinguishable
+    # (the shared utils/retry.py driver; only failures that READ as
+    # device-init burn the attempt) before the round is declared
+    # unusable; either way the JSON carries ``retried`` so flaky and
+    # dead rounds stay distinguishable
+    from amgx_tpu.utils.retry import retry_call
     retried = False
-    try:
-        backend = jax.default_backend()
+
+    def _init_backend():
+        b = jax.default_backend()
         jax.devices()
-    except Exception as e:
-        if not _is_device_init_error(e):
-            # unrecognised init failure: keep the STRUCTURED line (the
-            # whole point of this guard) — just don't burn a retry on
-            # something that doesn't look transient
-            return _emit_error_json("device_unavailable", e)
+        return b
+
+    def _note_retry(exc, _attempt):
+        nonlocal retried
         retried = True
-        print("[bench] device init failed "
-              f"({type(e).__name__}); retrying in 10s", file=sys.stderr)
-        time.sleep(10.0)
-        try:
-            backend = jax.default_backend()
-            jax.devices()
-        except Exception as e2:
-            return _emit_error_json("device_unavailable", e2,
-                                    retried=True)
+        print(f"[bench] device init failed "
+              f"({type(exc).__name__}); retrying in 10s",
+              file=sys.stderr)
+
+    try:
+        backend = retry_call(_init_backend, max_attempts=2,
+                             base_delay_s=10.0,
+                             retryable=_is_device_init_error,
+                             on_retry=_note_retry, label="bench_init")
+    except Exception as e:
+        return _emit_error_json("device_unavailable", e,
+                                retried=retried)
     on_tpu = backend not in ("cpu",)
 
     import amgx_tpu as amgx
@@ -1374,6 +1429,24 @@ def main():
             traceback.print_exc()
             warm_start = {"error": str(e)[:200]}
 
+    # chaos block (ISSUE 13, AMGX_BENCH_CHAOS=1): one NaN-poison fault
+    # into the headline stack with the recovery ladder armed —
+    # recovered-solve overhead vs clean solve (bench_trend's `recov`
+    # column); a failure here must not take down the headline JSON line
+    chaos = None
+    if os.environ.get("AMGX_BENCH_CHAOS") == "1":
+        try:
+            chaos = _bench_chaos(
+                lambda: poisson7pt_device(n_side, n_side, n_side,
+                                          device_dtype=dtype),
+                cfg_str, dtype)
+        except Exception as e:
+            import traceback
+            print(f"[bench] chaos benchmark failed: {e}",
+                  file=sys.stderr)
+            traceback.print_exc()
+            chaos = {"error": str(e)[:200]}
+
     # pod-scale distributed weak-scaling block (ISSUE 12): 1/2/4/8-part
     # classical solves at fixed rows/device on a forced 8-device CPU
     # mesh, with agglomeration + shard-local device Galerkin active —
@@ -1445,6 +1518,7 @@ def main():
             "serving": serving,
             **({"warm_start": warm_start} if warm_start else {}),
             **({"mixed_precision": mixed} if mixed else {}),
+            **({"chaos": chaos} if chaos else {}),
             "device_dtype": str(dtype),
             **({"poisson256": big} if big else {}),
             **({"distributed": distributed} if distributed else {}),

@@ -84,6 +84,10 @@ CASES = (
     ("weak_eff", _x(("extras", "distributed", "weak_eff_8"))),
     ("halo%", lambda d: _pct(_x(
         ("extras", "distributed", "halo_frac_8"))(d))),
+    # breakdown recovery (ISSUE 13, AMGX_BENCH_CHAOS=1 rounds): the
+    # recovered-solve overhead of one injected NaN-poison fault vs the
+    # clean headline solve; non-chaos rounds render "-"
+    ("recov", _x(("extras", "chaos", "overhead_x"))),
 )
 
 

@@ -763,13 +763,96 @@ def main():
         fail(f"doctor recommended agglomeration for a balanced trace: "
              f"{diag_dbal.get('hints')}")
 
+    # 16. failures & recovery (ISSUE 13): a NaN-poisoned PCG solve
+    # with the recovery ladder armed emits schema-valid
+    # recovery_attempt / fault_injected / history_truncated events (the
+    # validator enforces their vocabularies), the doctor renders the
+    # "failures & recovery" section, and the repeated-recovery hint
+    # fires — while the clean section-1 trace stays silent
+    from amgx_tpu.utils import faultinject
+    telemetry.reset()
+    telemetry.disable()
+    path_r = path + ".recovery"
+    if os.path.exists(path_r):
+        os.unlink(path_r)
+    cfg_r = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=80, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_MAX, out:store_res_history=1, "
+        "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=2, "
+        "out:recovery_policy=AUTO, out:recovery_max_attempts=4, "
+        f"out:telemetry=1, out:telemetry_path={path_r}")
+    slv_r = amgx.create_solver(cfg_r)
+    slv_r.setup(amgx.Matrix(A))
+    # two recovered solves so the "engaged repeatedly" hint fires
+    faultinject.configure("values_nan:iter=2:count=1")
+    try:
+        res_r1 = slv_r.solve(np.ones(A.shape[0]))
+    finally:
+        faultinject.reset()
+    faultinject.configure("values_nan:iter=2:count=1")
+    try:
+        res_r2 = slv_r.solve(np.ones(A.shape[0]))
+    finally:
+        faultinject.reset()
+    telemetry.disable()
+    for i, rr in enumerate((res_r1, res_r2)):
+        if int(rr.status) != 0 or not rr.recovery \
+                or rr.recovery.get("outcome") != "recovered":
+            fail(f"poisoned solve {i} did not recover: status "
+                 f"{rr.status}, recovery {rr.recovery}")
+    with open(path_r) as f:
+        lines_r = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_r)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"recovery trace: {e}")
+    recs_r = [json.loads(l) for l in lines_r if l.strip()]
+    ev_names_r = {r["name"] for r in recs_r if r["kind"] == "event"}
+    for needed in ("recovery_attempt", "fault_injected", "breakdown",
+                   "history_truncated"):
+        if needed not in ev_names_r:
+            fail(f"recovery trace is missing the {needed!r} event")
+    for r in recs_r:
+        if r["kind"] in ("counter", "gauge", "hist") and \
+                r["name"] not in telemetry.METRICS:
+            fail(f"unregistered metric name {r['name']!r} in the "
+                 "recovery trace (update telemetry.METRICS)")
+    diag_r = doctor.diagnose([path_r])
+    if not diag_r.get("failures"):
+        fail("doctor diagnose has no failures section for the "
+             "recovery trace")
+    if diag_r["failures"].get("recovered", 0) < 2:
+        fail(f"doctor undercounts recoveries: {diag_r['failures']}")
+    rep_r = doctor.render(diag_r)
+    if "failures & recovery" not in rep_r:
+        fail("doctor report is missing the 'failures & recovery' "
+             "section")
+    if not any("recovery ladder engaged" in h
+               for h in diag_r.get("hints", ())):
+        fail(f"doctor did not hint on repeated recoveries: "
+             f"{diag_r.get('hints')}")
+    if not any("fault injection was ACTIVE" in h
+               for h in diag_r.get("hints", ())):
+        fail(f"doctor did not flag the active fault injection: "
+             f"{diag_r.get('hints')}")
+    # …and the clean section-1 trace stays silent: no failures
+    # section, no recovery hint
+    diag_clean = doctor.diagnose([path])
+    if diag_clean.get("failures"):
+        fail(f"doctor invented a failures section for the clean "
+             f"trace: {diag_clean['failures']}")
+    if any("recovery ladder" in h for h in diag_clean.get("hints", ())):
+        fail(f"recovery hint fired on a clean trace: "
+             f"{diag_clean.get('hints')}")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
           f"serving-obs OK, mixed-precision OK, serving-lanes OK, "
-          f"distributed OK)")
+          f"distributed OK, failures-recovery OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -785,6 +868,7 @@ def main():
         os.unlink(path_dd)
         os.unlink(path_db)
         os.unlink(path_dbal)
+        os.unlink(path_r)
 
 
 def dist_child(trace_path: str) -> int:

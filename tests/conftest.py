@@ -66,6 +66,10 @@ def pytest_configure(config):
         "markers", "mixed_precision: bf16-hierarchy / promotion-ladder "
                    "fast tests (tier-1; pytest -m mixed_precision "
                    "selects just these)")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / breakdown-recovery fast "
+                   "tests (tier-1; pytest -m chaos selects just "
+                   "these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

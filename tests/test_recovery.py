@@ -1,0 +1,367 @@
+"""Recovery-ladder chaos tests (solvers/recovery.py).
+
+Proves the bounded escalation end to end with injected faults: each
+rung recovers the failure class it exists for, inapplicable rungs are
+audited as skipped without burning budget, the ladder is bounded and
+never recurses, every attempt emits a schema-valid ``recovery_attempt``
+event + ``amgx_recovery_total`` counters — and the serve layer's
+quarantine/retry hardening rides the same taxonomy.
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.errors import RC, FailureKind, SolveStatus
+from amgx_tpu.io import poisson5pt
+from amgx_tpu.solvers import SolverFactory
+from amgx_tpu.utils import faultinject
+
+pytestmark = pytest.mark.chaos
+
+BASE = (
+    "config_version=2, solver(s)=PCG, s:preconditioner(p)=BLOCK_JACOBI, "
+    "p:max_iters=3, s:max_iters=200, s:monitor_residual=1, "
+    "s:tolerance=1e-8, s:convergence=RELATIVE_INI, "
+    "s:store_res_history=1, s:recovery_policy=AUTO")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _solver(cfg_str=BASE, A=None, toplevel=False):
+    s = SolverFactory.create("PCG", amgx.AMGConfig(cfg_str), "s")
+    if toplevel:
+        # the session/capi entry points mark the outermost solver; the
+        # precision knobs (tpu_matrix_dtype) only apply there
+        s._toplevel = True
+    A = sp.csr_matrix(poisson5pt(16, 16)) if A is None else A
+    s.setup(amgx.Matrix(A))
+    return s, A
+
+
+class _CounterSnap:
+    """Point-in-time counter view (the live registry is reset when the
+    capture scope closes)."""
+
+    def __init__(self, snap):
+        self._c = snap["counters"]
+
+    def get_counter(self, name, **labels):
+        key = name
+        if labels:
+            key += "{" + ",".join(f"{k}={v}" for k, v
+                                  in sorted(labels.items())) + "}"
+        return self._c.get(key, 0.0)
+
+
+def _capture_recovery_events(fn):
+    telemetry.enable(8192)
+    try:
+        telemetry.reset()
+        out = fn()
+        evs = [r for r in telemetry.records() if r["kind"] == "event"
+               and r["name"] == "recovery_attempt"]
+        # every audit record validates against the documented schema
+        for r in evs:
+            telemetry.validate_record(r)
+        return out, evs, _CounterSnap(telemetry.registry().snapshot())
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# the rungs
+# ---------------------------------------------------------------------------
+def test_restart_recovers_one_shot_nan_poison():
+    s, A = _solver()
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=2:count=1")
+
+    res, evs, reg = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery == {"kind": "nan_poison", "action": "restart",
+                            "attempts": 1, "outcome": "recovered"}
+    assert res.failure is None
+    assert [e["attrs"]["outcome"] for e in evs] == ["recovered"]
+    assert reg.get_counter("amgx_recovery_total", kind="nan_poison",
+                           action="restart", outcome="recovered") == 1
+    # the recovered solution is a REAL solution
+    relres = np.linalg.norm(b - A @ np.asarray(res.x)) \
+        / np.linalg.norm(b)
+    assert relres < 1e-7
+
+
+def test_restart_recovers_stagnation_from_partial_iterate():
+    """A budget-starved solve (kind=stagnation) restarts FROM its
+    partial iterate — the second leg finishes what the first started."""
+    s, A = _solver(BASE.replace("s:max_iters=200", "s:max_iters=12"))
+    b = np.ones(A.shape[0])
+    res, evs, _ = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery["action"] == "restart"
+    assert res.recovery["kind"] == "stagnation"
+
+
+def test_ladder_escalates_to_resetup_when_early_rungs_fail():
+    """count=2 poisons the initial solve AND the restart; promote and
+    conservative are inapplicable here (f64 host == f64 pack; Jacobi
+    already conservative) and audit as skipped without burning budget;
+    resetup then runs clean and recovers."""
+    s, A = _solver()
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=2:count=2")
+    res, evs, reg = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery["action"] == "resetup"
+    assert res.recovery["attempts"] == 2     # skips burned nothing
+    by_action = {e["attrs"]["action"]: e["attrs"]["outcome"]
+                 for e in evs}
+    assert by_action["restart"] == "failed"
+    assert by_action["promote"] == "skipped"
+    assert by_action["conservative"] == "skipped"
+    assert by_action["resetup"] == "recovered"
+
+
+def test_promote_rung_recovers_narrow_pack():
+    """An f32 pack with an f64 host matrix: breakdown-triggered
+    promotion (PR 10's plan, forced by the ladder) re-runs the solve
+    one rung wider after restart fails."""
+    # tolerance ABOVE the f32 floor: the plain solve runs unrefined —
+    # only the ladder's forced promotion brings in the wide rung
+    s, A = _solver(BASE.replace("s:tolerance=1e-8", "s:tolerance=1e-5")
+                   + ", s:tpu_matrix_dtype=float32", toplevel=True)
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=2:count=2")
+    res, evs, _ = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery["action"] == "promote"
+    by_action = {e["attrs"]["action"]: e["attrs"]["outcome"]
+                 for e in evs}
+    assert by_action["restart"] == "failed"
+    assert by_action["promote"] == "recovered"
+
+
+def test_conservative_rung_swaps_smoother():
+    """An AMG stack smoothed by Chebyshev: when restart keeps failing,
+    the conservative rung rebuilds a twin with Jacobi smoothing (the
+    bad-spectrum-bounds escape hatch) and recovers."""
+    cfg = (
+        "config_version=2, solver(s)=PCG, s:max_iters=200, "
+        "s:monitor_residual=1, s:tolerance=1e-8, "
+        "s:convergence=RELATIVE_INI, s:recovery_policy=AUTO, "
+        "s:preconditioner(amg)=AMG, amg:algorithm=AGGREGATION, "
+        "amg:selector=SIZE_2, amg:max_iters=1, "
+        "amg:smoother(sm)=CHEBYSHEV, sm:max_iters=1, "
+        "amg:min_coarse_rows=16, amg:coarse_solver=DENSE_LU_SOLVER")
+    s, A = _solver(cfg)
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=2:count=2")
+    res, evs, _ = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery["action"] == "conservative"
+    by_action = {e["attrs"]["action"]: e["attrs"]["outcome"]
+                 for e in evs}
+    assert by_action["restart"] == "failed"
+    assert by_action["conservative"] == "recovered"
+    # the user's solver is untouched by the twin rebuild
+    assert s.cfg.get("smoother", "amg") == "CHEBYSHEV"
+
+
+def test_ladder_exhausts_bounded_and_audited():
+    """A fault that survives every rung: the ladder stops at the
+    budget, audits the exhaustion, and hands back a failing result
+    with the audit attached — it never loops or raises."""
+    s, A = _solver()
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=1:count=99")
+    res, evs, reg = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status != SolveStatus.SUCCESS
+    assert res.recovery["outcome"] == "exhausted"
+    assert res.failure is not None
+    assert res.recovery["attempts"] <= 4     # recovery_max_attempts
+    assert reg.get_counter("amgx_recovery_total", kind="nan_poison",
+                           action="ladder", outcome="exhausted") == 1
+
+
+def test_policy_off_returns_failure_untouched():
+    s, A = _solver(BASE.replace("s:recovery_policy=AUTO",
+                                "s:recovery_policy=NONE"))
+    b = np.ones(A.shape[0])
+    faultinject.configure("values_nan:iter=2:count=1")
+    res, evs, _ = _capture_recovery_events(lambda: s.solve(b))
+    assert res.status in (SolveStatus.DIVERGED, SolveStatus.FAILED)
+    assert res.recovery is None
+    assert res.failure.kind == FailureKind.NAN_POISON
+    assert evs == []                      # no ladder, no audit
+
+
+# ---------------------------------------------------------------------------
+# history truncation is traced, not silent (satellite)
+# ---------------------------------------------------------------------------
+def test_history_truncation_emits_event():
+    cfg = BASE.replace("s:convergence=RELATIVE_INI",
+                       "s:convergence=RELATIVE_MAX") \
+        .replace("s:recovery_policy=AUTO", "s:recovery_policy=NONE")
+    s, A = _solver(cfg)
+    b = np.ones(A.shape[0])
+    telemetry.enable(4096)
+    try:
+        telemetry.reset()
+        faultinject.configure("values_nan:iter=2:count=1")
+        s.solve(b)
+        evs = [r for r in telemetry.records() if r["kind"] == "event"
+               and r["name"] == "history_truncated"]
+        assert evs, "non-finite history rows were dropped silently"
+        for r in evs:
+            telemetry.validate_record(r)
+        a = evs[0]["attrs"]
+        assert a["first_bad_iteration"] >= 1
+        assert a["dropped"] >= 1
+        reg = telemetry.registry()
+        assert reg.get_counter("amgx_history_truncated_total") >= 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# serve hardening: quarantine at admission + retry budget + breaker
+# ---------------------------------------------------------------------------
+SERVE_CFG = (
+    "config_version=2, solver(out)=PCG, out:max_iters=100, "
+    "out:monitor_residual=1, out:tolerance=1e-8, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=3, "
+    "serve_batch_window_ms=5, serve_workers=2")
+
+
+def test_quarantine_rejects_at_admission_not_resetup():
+    """The poison-pill acceptance: after N consecutive setup failures
+    the pattern is rejected AT ADMISSION (RC.REJECTED, reason
+    quarantined) — the failing setup is NOT re-run for later clients,
+    and /healthz names the quarantined pattern."""
+    from amgx_tpu.serve import SolveService
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    m = amgx.Matrix(A)
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(SERVE_CFG + ", serve_quarantine_threshold=2")
+    with SolveService(cfg) as svc:
+        faultinject.configure("setup_error:count=99")
+        for _ in range(2):                   # two error outcomes
+            p = svc.submit(m, b)
+            assert p.wait_done(60) and p.rc != RC.OK
+        fired_before = faultinject.stats()["setup_error"]["fired"]
+        p3 = svc.submit(m, b)                # quarantined now
+        assert p3.wait_done(10)
+        assert p3.rc == RC.REJECTED
+        assert "quarantined" in (p3.error or "")
+        # the poisoned setup was NOT re-run for the rejected request
+        assert faultinject.stats()["setup_error"]["fired"] \
+            == fired_before
+        h = svc.health()
+        assert h["quarantined_total"] == 1
+        assert h["quarantined_patterns"]
+        # operator lifts it after fixing the root cause
+        faultinject.reset()
+        pat = list(svc.quarantined_patterns())[0]
+        assert svc.unquarantine(pat)
+        res = svc.solve(m, b, timeout=120)
+        assert res.status == SolveStatus.SUCCESS
+        assert svc.health()["quarantined_total"] == 0
+
+
+def test_serve_retry_budget_recovers_transient_failure():
+    """One transient setup fault + serve_retry_max=1: the request is
+    re-queued (not failed), the second attempt succeeds."""
+    from amgx_tpu.serve import SolveService
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(SERVE_CFG + ", serve_retry_max=1")
+    telemetry.enable(4096)
+    try:
+        telemetry.reset()
+        with SolveService(cfg) as svc:
+            faultinject.configure("setup_error:count=1")
+            p = svc.submit(amgx.Matrix(A), b)
+            assert p.wait_done(120)
+            assert p.rc == RC.OK, p.error
+            assert p.result.status == SolveStatus.SUCCESS
+        reg = telemetry.registry()
+        assert reg.get_counter("amgx_serve_retries_total") == 1
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_lane_breaker_trips_and_routes_around():
+    """serve_breaker_threshold=1: one failed batch opens the lane's
+    breaker — its load reads as inf, the router places follow-up cold
+    patterns elsewhere, and the breaker closes after the cooldown."""
+    from amgx_tpu.serve import SolveService
+    cfg = amgx.AMGConfig(
+        SERVE_CFG + ", serve_lanes=2, serve_breaker_threshold=1, "
+                    "serve_breaker_cooldown_s=0.2")
+    telemetry.enable(4096)
+    try:
+        telemetry.reset()
+        with SolveService(cfg) as svc:
+            lane0 = svc.lanes[0]
+            lane0.record_batch_result(False)
+            assert lane0.breaker_open
+            assert lane0.queue_fraction() == float("inf")
+            assert lane0.health()["breaker_open"]
+            # cold routing avoids the tripped lane
+            lane_idx, decision = svc.router.route("pat-x", "v0")
+            assert lane_idx == 1
+            reg = telemetry.registry()
+            assert reg.get_counter("amgx_serve_breaker_trips_total",
+                                   lane=0) == 1
+            # half-open after the cooldown: a success closes it
+            import time as _t
+            _t.sleep(0.25)
+            assert not lane0.breaker_open
+            lane0.record_batch_result(True)
+            assert lane0.queue_fraction() != float("inf")
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+
+
+def test_serving_reports_failure_without_in_worker_recovery():
+    """The batched/served path is UNIFORM across batch sizes: even with
+    recovery_policy=AUTO a served solve's breakdown reports a clean
+    failed outcome with the taxonomy attached — the ladder (which would
+    multiply the batch's deadline by its attempt count inside a lane
+    worker) never engages there; the serve retry/quarantine knobs are
+    that path's recovery story.  The SAME solver config recovers on the
+    direct solve() path."""
+    from amgx_tpu.serve import SolveService
+    A = sp.csr_matrix(poisson5pt(8, 8))
+    b = np.ones(A.shape[0])
+    cfg = amgx.AMGConfig(SERVE_CFG + ", recovery_policy=AUTO")
+    with SolveService(cfg) as svc:
+        svc.solve(amgx.Matrix(A), b, timeout=120)   # warm session
+        faultinject.configure("values_nan:iter=2:count=1")
+        p = svc.submit(amgx.Matrix(A), b)
+        assert p.wait_done(120)
+        assert p.rc == RC.OK
+        assert int(p.result.status) != 0          # failed, not hung
+        assert p.result.failure is not None
+        assert p.result.failure.kind == FailureKind.NAN_POISON
+        assert p.result.recovery is None          # no in-worker ladder
+        faultinject.reset()
+    # direct solve() with the same config DOES recover
+    s = SolverFactory.create("PCG", cfg, "out")
+    s.setup(amgx.Matrix(A))
+    faultinject.configure("values_nan:iter=2:count=1")
+    res = s.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery is not None and \
+        res.recovery["outcome"] == "recovered"
