@@ -218,17 +218,25 @@ def pack_kind(Ad) -> str:
     dispatch regression shows up in BENCH logs, not just as a slower
     number)."""
     fmt = getattr(Ad, "fmt", "?")
+
+    def _bn_suffix():
+        from ..ops.pallas_csr import bn_block_dim
+        return "-block" if bn_block_dim(getattr(Ad, "bn_dims", ())) > 1 \
+            else ""
+
+    if fmt == "dia" and getattr(Ad, "block_dim", 1) > 1:
+        return "dia/block"
     if fmt == "ell":
         if getattr(Ad, "sh_vals", None) is not None:
             return "ell/shift"
         if getattr(Ad, "win_codes", None) is not None:
             return "ell/window"
         if getattr(Ad, "bn_codes", None) is not None:
-            return "ell/binned"
+            return "ell/binned" + _bn_suffix()
         return "ell/gather"
     if fmt == "csr":
         if getattr(Ad, "bn_codes", None) is not None:
-            return "csr/binned"
+            return "csr/binned" + _bn_suffix()
         return "csr/segsum"
     return fmt
 
@@ -242,7 +250,8 @@ def padded_entries(Ad) -> Optional[int]:
     implicit operator)."""
     fmt = getattr(Ad, "fmt", "?")
     if fmt == "dia":
-        return Ad.ell_width * Ad.n_rows          # nd diagonals × n rows
+        # nd diagonals × n rows (× b² value slots per block diagonal)
+        return Ad.ell_width * Ad.n_rows * Ad.block_dim ** 2
     if fmt == "dia3":
         return ((len(Ad.P.dia_offsets) * Ad.P.n_rows)
                 + (len(Ad.A.dia_offsets) * Ad.A.n_rows)
@@ -258,11 +267,15 @@ def padded_entries(Ad) -> Optional[int]:
             T, n_tiles, Dpad, _pad, _L = Ad.sh_dims
             return n_tiles * Dpad * T
         if getattr(Ad, "bn_codes", None) is not None:
-            return int(Ad.bn_codes.size)
+            # lanes × b² value slots per lane (block-native planes; the
+            # scalar expansion's lanes are already scalar slots)
+            from ..ops.pallas_csr import bn_block_dim
+            return int(Ad.bn_codes.size) * bn_block_dim(Ad.bn_dims) ** 2
         return Ad.n_rows * Ad.ell_width * b * b
     if fmt == "csr":
         if getattr(Ad, "bn_codes", None) is not None:
-            return int(Ad.bn_codes.size)
+            from ..ops.pallas_csr import bn_block_dim
+            return int(Ad.bn_codes.size) * bn_block_dim(Ad.bn_dims) ** 2
         b = Ad.block_dim
         ne = (Ad.vals.shape[0] if Ad.vals is not None
               else (Ad.row_ids.shape[0] if Ad.row_ids is not None else 0))
@@ -301,6 +314,46 @@ def dia_arrays(csr: sp.csr_matrix, max_diags: Optional[int] = None):
     vals = np.zeros((len(offsets), n), dtype=csr.data.dtype)
     vals[lut[shifted], rows] = csr.data
     return [int(o) - (n - 1) for o in offsets], vals
+
+
+def dia_arrays_block(bsr: sp.bsr_matrix, max_diags: Optional[int] = None):
+    """Block row-aligned diagonals of a square BSR matrix: returns
+    (offsets list, vals (nd, n, b, b)) with block A[i, i+d_k] =
+    vals[k, i], or None when the BLOCK pattern has more than
+    ``max_diags`` distinct block diagonals.
+
+    The b×b analog of :func:`dia_arrays` (ISSUE 15 tentpole (b)): block
+    stencil operators — elasticity/CFD systems on structured meshes —
+    then carry ZERO per-entry index data, with each offset streaming an
+    (n, b, b) value plane."""
+    b = bsr.blocksize[0]
+    n, m = bsr.shape[0] // b, bsr.shape[1] // b
+    if bsr.nnz == 0:
+        return None
+    idx_t = np.int32 if (n + m - 1) < 2**31 else np.int64
+    rows = np.repeat(np.arange(n, dtype=idx_t), np.diff(bsr.indptr))
+    shifted = bsr.indices.astype(idx_t, copy=False) - rows + idx_t(n - 1)
+    counts = np.bincount(shifted, minlength=n + m - 1)
+    offsets = np.flatnonzero(counts)
+    if max_diags is not None and len(offsets) > max_diags:
+        return None
+    lut = np.empty(n + m - 1, dtype=idx_t)
+    lut[offsets] = np.arange(len(offsets), dtype=idx_t)
+    vals = np.zeros((len(offsets), n, b, b), dtype=bsr.data.dtype)
+    vals[lut[shifted], rows] = bsr.data
+    return [int(o) - (n - 1) for o in offsets], vals
+
+
+def _block_native_on(block_native: "Optional[bool]" = None) -> bool:
+    """The block-native layout knob: b×b systems pack block-DIA planes
+    / block-native binned micro-tiles by default; ``AMGX_BLOCK_NATIVE=0``
+    (or an explicit ``block_native=False``) keeps PR 1's scalar
+    expansion — the A/B baseline ``prim_bench block`` measures
+    against."""
+    import os
+    if block_native is not None:
+        return bool(block_native)
+    return os.environ.get("AMGX_BLOCK_NATIVE", "1") != "0"
 
 
 def ell_layout(indptr: np.ndarray, indices: np.ndarray):
@@ -559,7 +612,8 @@ class Matrix:
             self._dia_thunk = None
             self._dia_checked_max = 10**9
         if self._dia is None and self._host is None and \
-                self._device is not None and self._device.fmt == "dia":
+                self._device is not None and self._device.fmt == "dia" and \
+                self._device.block_dim == 1:
             self._download_dia()
         if self._dia is not None:
             offs, _ = self._dia
@@ -586,7 +640,8 @@ class Matrix:
                 getattr(self, "_dia_thunk", None) is not None:
             self.dia_cache()
         if self._dia is None and self._host is None and self.block_dim == 1 \
-                and self._device is not None and self._device.fmt == "dia":
+                and self._device is not None and self._device.fmt == "dia" and \
+                self._device.block_dim == 1:
             self._download_dia()
         if self._dia is not None and self.block_dim == 1:
             offs, vals = self._dia
@@ -697,7 +752,8 @@ class Matrix:
                 h.update(np.ascontiguousarray(blk.indices).tobytes())
         elif self._dia is not None or \
                 getattr(self, "_dia_thunk", None) is not None or \
-                (self._device is not None and self._device.fmt == "dia"):
+                (self._device is not None and self._device.fmt == "dia"
+                 and self._device.block_dim == 1):
             offs, _ = self.dia_cache()
             h.update(b"dia")
             h.update(repr(tuple(int(o) for o in offs)).encode())
@@ -764,7 +820,8 @@ class Matrix:
                 getattr(self, "_dia_thunk", None) is not None:
             self.dia_cache()     # analytic thunk beats a device download
         if self._host is None and self._dia is None and \
-                self._device is not None and self._device.fmt == "dia":
+                self._device is not None and self._device.fmt == "dia" and \
+                self._device.block_dim == 1:
             self._download_dia()
         if self._host is None and self._dia is not None:
             from ..amg.pairwise import dia_to_scipy
@@ -831,7 +888,8 @@ class Matrix:
                 getattr(self, "_dia_thunk", None) is not None:
             self.dia_cache()
         if self._host is None and self._dia is None and \
-                self._device is not None and self._device.fmt == "dia":
+                self._device is not None and self._device.fmt == "dia" and \
+                self._device.block_dim == 1:
             self._download_dia()     # lazy: grid-stats / IO consumers only
         if self._host is None and self._dia is not None:
             # structural count without assembling CSR (explicit stored
@@ -894,9 +952,13 @@ class Matrix:
             else:
                 # dia_max_diags=0: the cache above already proved the
                 # matrix non-DIA — don't pay the O(nnz) scan again
+                # (block matrices never entered the scalar cache: keep
+                # the budget so the BLOCK-DIA attempt can run)
                 self._device = pack_device(self.host, self.block_dim,
                                            dtype, ell_max_width,
-                                           dia_max_diags=0,
+                                           dia_max_diags=0
+                                           if self.block_dim == 1
+                                           else 48,
                                            device=self.placement)
             # placement is honored inside _pack_dia_arrays /
             # pack_device (device=...): no second pass needed
@@ -951,6 +1013,38 @@ def _try_binned_scalar_block(bsr: sp.bsr_matrix, dtype, arrays,
                        scsr.shape[1], dtype, arrays, meta)
 
 
+def _try_binned_block(bsr: sp.bsr_matrix, dtype, arrays, meta) -> bool:
+    """BLOCK-NATIVE binned pack (ISSUE 15 tentpole (a)): one column
+    code per b×b block and (b², L) component value planes — 1/b² the
+    index bytes of the scalar expansion, and the per-entry pick widens
+    to a b-lane MXU contraction.  bf16 value planes are allowed (the
+    kernel accumulates f32); falls back to the scalar-expansion attach
+    when the block plan exceeds the padding budget."""
+    import jax as _jax
+
+    from ..ops import pallas_csr
+    if not (_jax.default_backend() == "tpu" or pallas_csr._INTERPRET):
+        return False
+    np_dtype = np.dtype(dtype)
+    from . import precision as _prec
+    if not _prec.is_floating(np_dtype):
+        return False
+    if np_dtype.itemsize > 4 and not pallas_csr._INTERPRET:
+        return False          # f64 rides the kernel only when interpreted
+    b = bsr.blocksize[0]
+    bsr.sort_indices()
+    out = pallas_csr.csr_binned_pack(
+        bsr.indptr, bsr.indices,
+        np.asarray(bsr.data).astype(dtype, copy=False),
+        bsr.shape[1] // b, dtype, block_dim=b)
+    if out is None:
+        return _try_binned_scalar_block(bsr, dtype, arrays, meta)
+    bn_arrays, dims = out
+    arrays.update(bn_arrays)
+    meta.update(bn_dims=dims)
+    return True
+
+
 def _dense_pack_enabled() -> bool:
     """Dense fallback only helps where gathers are catastrophic (TPU);
     the CPU backend's native gathers are fine.  AMGX_DENSE_PACK=1
@@ -966,7 +1060,8 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
                      ell_max_width: int = 2048,
                      dia_max_diags: int = 48,
                      lean_win: bool = False,
-                     use_shift: bool = True):
+                     use_shift: bool = True,
+                     block_native: "Optional[bool]" = None):
     """The device pack computed HOST-side: (arrays, meta) with no
     transfer.  Callers choose the transfer strategy — one ``device_put``
     (:func:`pack_device`) or a whole-hierarchy arena upload
@@ -976,6 +1071,10 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
     Format selection: DIA when the matrix is square, scalar, and has few
     distinct diagonals (stencil operators — the reference's headline
     workloads); otherwise ELL; CSR segment-sum for pathological rows.
+    Block matrices (b > 1) try block-DIA first (block stencils stream
+    (n, b, b) planes per offset with zero index data), then the
+    block-native binned layout; ``block_native=False`` /
+    ``AMGX_BLOCK_NATIVE=0`` keeps PR 1's scalar expansion for A/B runs.
     """
     b = int(block_dim)
     if b == 1 and host.shape[0] == host.shape[1]:
@@ -987,6 +1086,23 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
                 return ({"vals": vals.astype(dtype, copy=False)},
                         dict(fmt="dia", offsets=offsets,
                              n_cols=csr.shape[1]))
+    if b > 1 and host.shape[0] == host.shape[1] and dia_max_diags and \
+            _block_native_on(block_native):
+        bsr0 = host if isinstance(host, sp.bsr_matrix) else \
+            sp.bsr_matrix(host, blocksize=(b, b))
+        if bsr0.shape[0] and bsr0.nnz:
+            bsr0.sort_indices()
+            arrs = dia_arrays_block(bsr0, max_diags=dia_max_diags)
+            if arrs is not None:
+                offsets, bvals = arrs
+                n_b = bsr0.shape[0] // b
+                diag = np.zeros((n_b, b, b), dtype=dtype)
+                if 0 in offsets:
+                    diag[:] = bvals[offsets.index(0)]
+                return ({"vals": bvals.astype(dtype, copy=False),
+                         "diag": diag},
+                        dict(fmt="dia", offsets=offsets, block_dim=b,
+                             n_cols=bsr0.shape[1] // b))
     if b == 1:
         csr = sp.csr_matrix(host)
         csr.sort_indices()
@@ -1086,6 +1202,8 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
                     # would double hierarchy upload bytes
                     del arrays["cols"], arrays["vals"]
                     meta.update(fmt="csr", ell_width=0)
+            elif _block_native_on(block_native):
+                _try_binned_block(bsr, dtype, arrays, meta)
             else:
                 _try_binned_scalar_block(bsr, dtype, arrays, meta)
         return arrays, meta
@@ -1108,6 +1226,8 @@ def pack_host_arrays(host: sp.spmatrix, block_dim: int, dtype,
             # the gather-form triplets for fallback/abs_rowsum/densify
             # consumers — shipping both would double hierarchy bytes
             del arrays["cols"], arrays["vals"], arrays["row_ids"]
+    elif _block_native_on(block_native):
+        _try_binned_block(bsr, dtype, arrays, meta)
     else:
         _try_binned_scalar_block(bsr, dtype, arrays, meta)
     return arrays, meta
@@ -1130,6 +1250,15 @@ def assemble_device_matrix(arrays, meta) -> DeviceMatrix:
             n_rows=n, n_cols=m, block_dim=1, fmt="dense", ell_width=0)
     if meta["fmt"] == "dia":
         dvals = arrays["vals"]
+        if meta.get("block_dim", 1) > 1:
+            # block-DIA: (nd, n, b, b) planes, (n, b, b) diagonal
+            return DeviceMatrix(
+                cols=None, vals=dvals, diag=arrays["diag"],
+                row_ids=None, n_rows=dvals.shape[1],
+                n_cols=int(meta["n_cols"]),
+                block_dim=int(meta["block_dim"]), fmt="dia",
+                ell_width=len(meta["offsets"]),
+                dia_offsets=tuple(int(o) for o in meta["offsets"]))
         ddiag = arrays.get("diag")
         if ddiag is None:
             ddiag = _dia_device_diag(meta["offsets"], dvals)
@@ -1160,7 +1289,8 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
                 ell_max_width: int = 2048,
                 dia_max_diags: int = 48,
                 use_shift: bool = True,
-                device=None) -> DeviceMatrix:
+                device=None,
+                block_native: "Optional[bool]" = None) -> DeviceMatrix:
     """Host pack + ONE ``device_put`` for all of its arrays (onto
     ``device`` when pinned — staging on the default device first would
     ship, and for complex dtypes hang, on a backend that cannot hold
@@ -1170,7 +1300,8 @@ def pack_device(host: sp.spmatrix, block_dim: int, dtype,
     from ..telemetry import setup_profile
     arrays, meta = pack_host_arrays(host, block_dim, dtype,
                                     ell_max_width, dia_max_diags,
-                                    use_shift=use_shift)
+                                    use_shift=use_shift,
+                                    block_native=block_native)
     keys = sorted(arrays)
     with setup_profile.transfer(sum(arrays[k].nbytes for k in keys),
                                 len(keys), "upload"):
@@ -1338,9 +1469,11 @@ def batch_upload(mats, lean_except=()) -> None:
             if m.host is None:
                 continue
             # the dia_cache above already proved non-DIA: don't pay the
-            # O(nnz) diagonal scan a second time
+            # O(nnz) diagonal scan a second time (block matrices never
+            # entered it — keep the budget for the block-DIA attempt)
             arrays, meta = pack_host_arrays(
-                m.host, m.block_dim, dtype, dia_max_diags=0,
+                m.host, m.block_dim, dtype,
+                dia_max_diags=0 if m.block_dim == 1 else 48,
                 lean_win=id(m) not in lean_except)
         jobs.append((m, dtype, arrays, meta))
     by_placement = {}

@@ -122,16 +122,21 @@ def narrowable_pack(dm) -> bool:
     """Can this device pack be narrowed without losing its SpMV path?
 
     Packs carrying an f32-only Pallas kernel layout (tile-DIA shift,
-    windowed one-hot, binned sliced-ELL planes) keep their dtype — the
-    kernel gates reject sub-f32 values and the gather fallback would
-    cost far more than the bytes saved.  DIA (the bf16 kernel exists),
-    dense (MXU-native), plain gather/segment-sum layouts (same dispatch
-    either way) all narrow."""
+    windowed one-hot, SCALAR binned sliced-ELL planes) keep their dtype
+    — the kernel gates reject sub-f32 values and the gather fallback
+    would cost far more than the bytes saved.  DIA (the bf16 kernel
+    exists — block-DIA planes dispatch per component through it), dense
+    (MXU-native), plain gather/segment-sum layouts (same dispatch
+    either way), and BLOCK-native binned planes (the block kernel
+    converts bf16 values in-register and accumulates f32) all
+    narrow."""
     if getattr(dm, "fmt", "") == "dia3":
         return True
+    if getattr(dm, "bn_codes", None) is not None:
+        from ..ops.pallas_csr import bn_block_dim
+        return bn_block_dim(getattr(dm, "bn_dims", ())) > 1
     return (getattr(dm, "sh_vals", None) is None
-            and getattr(dm, "win_codes", None) is None
-            and getattr(dm, "bn_codes", None) is None)
+            and getattr(dm, "win_codes", None) is None)
 
 
 def device_cast(dm, dtype):

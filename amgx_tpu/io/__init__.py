@@ -3,9 +3,10 @@ from .binary import read_binary, write_binary, read_system_auto
 from .poisson import (poisson5pt, poisson7pt, poisson7pt_dia, poisson9pt,
                       poisson27pt, generate_distributed_poisson_7pt)
 from .device_gen import poisson7pt_device
+from .gauntlet import gauntlet_cases
 
 __all__ = ["read_matrix_market", "write_matrix_market", "SystemData",
            "read_binary", "write_binary", "read_system_auto",
            "poisson5pt", "poisson7pt", "poisson7pt_dia", "poisson9pt",
            "poisson27pt", "generate_distributed_poisson_7pt",
-           "poisson7pt_device"]
+           "poisson7pt_device", "gauntlet_cases"]

@@ -41,8 +41,18 @@ Reference analog: the any-sparsity CSR vector kernels of
 ``base/src/multiply.cu:75-196`` / ``generic_spmv_csr.h`` — same
 contract, mapped to segment-streamed one-hot contractions instead of
 warp-per-row gathers.  f64 runs only under the interpreter (CPU test
-tier — Mosaic has no emulated f64); block matrices pack their SCALAR
-expansion, so b×b systems ride the same kernel.
+tier — Mosaic has no emulated f64).
+
+Block matrices (``block_dim = b > 1``) get a BLOCK-NATIVE layout
+(reference: AmgX is block-CSR end to end, ``multiply.cu:75-196`` blocked
+kernels): the chunk planes are laid out over the BLOCK pattern — one
+int32 column code per b×b block (1/b² the index bytes of the scalar
+expansion), values staged as (b², L) component planes (lane = block
+entry, row = in-block (a, c) component), x staged as b per-component
+sub-lanes of each segment so the per-entry pick widens to ONE
+(b·Sb, 128) MXU contraction whose b picked components serve all b²
+value planes.  The legacy scalar-expansion pack remains available
+behind the ``AMGX_BLOCK_NATIVE=0`` knob (core/matrix.py) for A/B runs.
 """
 from __future__ import annotations
 
@@ -151,27 +161,40 @@ def binned_pad_factor(indptr, indices, n_cols: int) -> Optional[float]:
     return n_real * (_W * _T) / max(nnz, 1)
 
 
-def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
-                    ) -> Optional[Tuple[dict, tuple]]:
-    """Host-side binned sliced-ELL pack of a SCALAR CSR matrix.
+def bn_block_dim(dims) -> int:
+    """Block dimension of a binned pack's static dims: scalar (and
+    scalar-expansion) packs carry the 9-tuple, block-native packs append
+    ``b`` as a 10th element."""
+    return int(dims[9]) if len(dims) > 9 else 1
+
+
+def csr_binned_pack(indptr, indices, data, n_cols: int, dtype,
+                    block_dim: int = 1) -> Optional[Tuple[dict, tuple]]:
+    """Host-side binned sliced-ELL pack of a CSR (scalar) or BSR
+    (``block_dim = b > 1``, ``data`` shaped (nnz, b, b)) matrix.
 
     Returns ``(arrays, bn_dims)`` or None when the matrix is empty, its
     padding exceeds the ``_PAD_CAP`` budget, or its columns overflow the
     int32 code space.  ``arrays``:
 
-    * ``bn_codes`` (1, L) int32 — global column per lane (padding 0),
-    * ``bn_vals``  (1, L) dtype — values (padding 0),
+    * ``bn_codes`` (1, L) int32 — global (block) column per lane
+      (padding 0): ONE code per b×b block, not per scalar entry,
+    * ``bn_vals``  (1, L) dtype — values (padding 0); block matrices
+      stage (b², L) component planes instead (row a·b+c = in-block
+      component (a, c) of every lane),
     * ``bn_meta``  (4·C,) int32 — per chunk: output tile, plane block,
       segment, first-chunk-of-tile flag (scalar prefetch),
-    * ``bn_pos``   (n,) int32 — original row → padded position, or
-      absent when the bin permutation is the identity.
+    * ``bn_pos``   (n,) int32 — original (block) row → padded position,
+      or absent when the bin permutation is the identity.
 
     ``bn_dims`` (static): (C, n_tiles, n_seg, T, SB, W, identity, n,
-    n_cols).
+    n_cols) — block-row/block-col counts for block packs, with ``b``
+    appended as a 10th element (:func:`bn_block_dim`).
     """
     indptr = np.asarray(indptr)
     indices = np.asarray(indices)
     data = np.asarray(data)
+    b = int(block_dim)
     if int(n_cols) >= (1 << 31):
         return None
     plan = _plan(indptr, indices, n_cols)
@@ -206,9 +229,15 @@ def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
                        np.diff(indptr)[perm])
     lane = chunk_e * Wp + (q % _W) * _T + (rows_p % _T)
     codes = np.zeros(L, dtype=np.int32)
-    vals = np.zeros(L, dtype=dtype)
     codes[lane] = indices[ent].astype(np.int32)
-    vals[lane] = data[ent]
+    if b == 1:
+        vals = np.zeros(L, dtype=dtype)
+        vals[lane] = data[ent]
+    else:
+        # block-native component planes: row a·b+c carries the (a, c)
+        # component of every lane's b×b block
+        vals = np.zeros((b * b, L), dtype=dtype)
+        vals[:, lane] = data[ent].reshape(-1, b * b).T
     c_tile = np.repeat(group_key // n_seg, chunks_per_group)
     c_seg = np.repeat(group_key % n_seg, chunks_per_group)
     c_blk = np.arange(n_real, dtype=np.int64)
@@ -220,7 +249,10 @@ def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
     miss = np.flatnonzero(~have)
     if len(miss):
         codes = np.concatenate([codes, np.zeros(Wp, dtype=np.int32)])
-        vals = np.concatenate([vals, np.zeros(Wp, dtype=dtype)])
+        vals = (np.concatenate([vals, np.zeros(Wp, dtype=dtype)])
+                if b == 1 else
+                np.concatenate([vals, np.zeros((b * b, Wp),
+                                               dtype=dtype)], axis=1))
         c_tile = np.concatenate([c_tile, miss])
         c_seg = np.concatenate([c_seg, np.zeros(len(miss), np.int64)])
         c_blk = np.concatenate([c_blk,
@@ -233,7 +265,7 @@ def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
     first[1:] = c_tile[1:] != c_tile[:-1]
     meta = np.concatenate([c_tile, c_blk, c_seg, first]).astype(np.int32)
     arrays = {"bn_codes": codes.reshape(1, -1),
-              "bn_vals": vals.reshape(1, -1),
+              "bn_vals": vals.reshape(1, -1) if b == 1 else vals,
               "bn_meta": meta}
     if not identity:
         pos = np.empty(n, dtype=np.int32)
@@ -241,18 +273,28 @@ def csr_binned_pack(indptr, indices, data, n_cols: int, dtype
         arrays["bn_pos"] = pos
     dims = (C, int(n_tiles), int(n_seg), _T, _SB, _W,
             1 if identity else 0, int(n), int(n_cols))
+    if b > 1:
+        dims = dims + (b,)
     return arrays, dims
 
 
 def binned_supported(Ad) -> bool:
     """Dispatch gate: binned arrays present and the kernel can run here
-    (TPU for f32; the interpreter also carries f64 for the CPU parity
-    tier — Mosaic itself has no f64)."""
+    (TPU for f32 — and bf16 value planes on the BLOCK-native layout,
+    which accumulates f32 in-kernel; the interpreter also carries f64
+    for the CPU parity tier — Mosaic itself has no f64)."""
     if getattr(Ad, "bn_codes", None) is None:
         return False
     if not (jax.default_backend() == "tpu" or _INTERPRET):
         return False
-    return jnp.dtype(Ad.dtype) == jnp.float32 or _INTERPRET
+    if _INTERPRET:
+        return True
+    dt = jnp.dtype(Ad.dtype)
+    if dt == jnp.float32:
+        return True
+    # bf16 block value planes: streamed at half width, converted to f32
+    # in-register before the component multiply-adds (mixed precision)
+    return dt == jnp.bfloat16 and bn_block_dim(Ad.bn_dims) > 1
 
 
 @functools.partial(jax.jit, static_argnums=(4,))
@@ -347,9 +389,126 @@ def _binned_call(meta, codes, vals, x2, dims):
     )(meta, x2, codes, vals)
 
 
+@functools.partial(jax.jit, static_argnums=(4,))
+def _binned_block_call(meta, codes, vals, x4, dims):
+    """Block-native chunk kernel: one (b·Sb, 128) widened MXU pick per
+    chunk serves all b² value planes — b× less one-hot work and 1/b²
+    the index bytes of the scalar expansion.  bf16 value planes stream
+    at half width and convert to f32 in-register; the accumulator is
+    always at least f32."""
+    C, n_tiles, n_seg, T, Sb, w, _ident, _n, _m, b = dims
+    Wp = w * T
+    # the pick's exactness depends on the X dtype, not the value
+    # planes': bf16 VALUE planes still arrive with an f32 x (widened by
+    # _binned_spmv_block), and a single default-precision MXU pass
+    # would truncate that x to bf16 — the bf16×3 split must run
+    # whenever x is f32 (the interpreter-only f64 tier is the one case
+    # a single pass is exact)
+    f32 = x4.dtype == jnp.float32
+    # accumulation dtype: f32 for f32/bf16 planes, the exact dtype for
+    # the interpreter-only parity tiers (f64)
+    acc_dt = jnp.float32 if jnp.dtype(vals.dtype).itemsize <= 4 \
+        else vals.dtype
+
+    def kernel(m_ref, x_ref, codes_ref, vals_ref, y_ref):
+        c = pl.program_id(0)
+        codes_t = codes_ref[...]                       # (1, Wp) int32
+        lane = jnp.bitwise_and(codes_t, jnp.asarray(127, codes_t.dtype))
+        blk = jax.lax.shift_right_logical(
+            codes_t, jnp.asarray(7, codes_t.dtype))
+        local = blk - m_ref[2 * C + c] * Sb
+        iota_l = jax.lax.broadcasted_iota(jnp.int32, (128, Wp), 0)
+        oh = lane == iota_l                            # (128, Wp)
+        # x block: b component sub-lanes of one segment, laid out
+        # component-major within the segment — (b·Sb, 128)
+        xs2 = x_ref[...]
+        dims_dg = (((1,), (0,)), ((), ()))
+        if f32:
+            # bf16×3 split (see the scalar kernel): exact f32 pick
+            ohT = oh.astype(jnp.bfloat16)
+            h1 = xs2.astype(jnp.bfloat16)
+            r1 = xs2 - h1.astype(jnp.float32)
+            h2 = r1.astype(jnp.bfloat16)
+            h3 = (r1 - h2.astype(jnp.float32)).astype(jnp.bfloat16)
+            pick = (jax.lax.dot_general(
+                        h1, ohT, dims_dg,
+                        preferred_element_type=jnp.float32)
+                    + jax.lax.dot_general(
+                        h2, ohT, dims_dg,
+                        preferred_element_type=jnp.float32)
+                    + jax.lax.dot_general(
+                        h3, ohT, dims_dg,
+                        preferred_element_type=jnp.float32))
+        else:
+            pick = jax.lax.dot_general(
+                xs2, oh.astype(xs2.dtype), dims_dg,
+                preferred_element_type=xs2.dtype)
+        pick3 = pick.reshape(b, Sb, Wp)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Sb, Wp), 0)
+        # segment-local block select per component: (b, Wp)
+        sel = jnp.sum(jnp.where((local == iota_b)[None], pick3, 0),
+                      axis=1).astype(acc_dt)
+        vals_t = vals_ref[...]                         # (b², Wp)
+        if vals_t.dtype != acc_dt:
+            vals_t = vals_t.astype(acc_dt)             # bf16 → f32
+        # b² plane multiply-adds: component (a, c) of every block
+        # multiplies picked x-component c into output component a
+        prows = []
+        for a in range(b):
+            pa = vals_t[a * b:a * b + 1, :] * sel[0:1, :]
+            for cc in range(1, b):
+                pa = pa + vals_t[a * b + cc:a * b + cc + 1, :] \
+                    * sel[cc:cc + 1, :]
+            prows.append(pa)
+        p = jnp.concatenate(prows, axis=0)             # (b, Wp)
+        acc = p[:, 0:T]
+        for k in range(1, w):
+            acc = acc + p[:, k * T:(k + 1) * T]        # (b, T)
+        first = m_ref[3 * C + c]
+
+        @pl.when(first == 1)
+        def _init():
+            y_ref[...] = acc
+
+        @pl.when(first == 0)
+        def _accum():
+            y_ref[...] = y_ref[...] + acc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(C,),
+        in_specs=[
+            # x: (n_seg·b·Sb, 128) — one segment's b component
+            # sub-lanes are contiguous, staged together per chunk
+            pl.BlockSpec((b * Sb, 128), lambda c, m: (m[2 * C + c],
+                                                      jnp.int32(0)),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Wp), lambda c, m: (jnp.int32(0),
+                                                m[C + c]),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((b * b, Wp), lambda c, m: (jnp.int32(0),
+                                                    m[C + c]),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((b, T), lambda c, m: (jnp.int32(0),
+                                                     m[c]),
+                               memory_space=pltpu.VMEM),
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, n_tiles * T), acc_dt),
+        grid_spec=grid_spec,
+        interpret=_INTERPRET,
+    )(meta, x4, codes, vals)
+
+
 def binned_spmv(Ad, x: jax.Array) -> jax.Array:
     """y = A @ x via the binned sliced-ELL kernel.  ``x`` is the flat
-    scalar vector (block matrices packed their scalar expansion)."""
+    scalar vector; block-native packs split it into per-component
+    sub-lanes, scalar-expansion block packs consume it directly."""
+    b = bn_block_dim(Ad.bn_dims)
+    if b > 1:
+        return _binned_spmv_block(Ad, x)
     C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
     m_pad = n_seg * Sb * 128
     x2 = jnp.pad(x, (0, m_pad - m_sc)).reshape(-1, 128)
@@ -362,12 +521,34 @@ def binned_spmv(Ad, x: jax.Array) -> jax.Array:
     return y[Ad.bn_pos]
 
 
+def _binned_spmv_block(Ad, x: jax.Array) -> jax.Array:
+    """Block-native apply: x is the flat (n_cols·b,) scalar vector.
+    Sub-f32 x widens to f32 (the pick splits/accumulates f32); the
+    result rides the ACCUMULATION dtype — the dispatcher's
+    ``_narrow_to`` applies the promote-types output contract."""
+    C, n_tiles, n_seg, T, Sb, w, ident, n_b, m_b, b = Ad.bn_dims
+    if jnp.dtype(x.dtype).itemsize < 4:
+        x = x.astype(jnp.float32)
+    m_pad = n_seg * Sb * 128
+    # (b, m_pad) component planes → segment-major/component-minor rows
+    # so one (b·Sb, 128) x block holds a whole segment's components
+    xp = jnp.pad(x.reshape(m_b, b).T, ((0, 0), (0, m_pad - m_b)))
+    x4 = xp.reshape(b, n_seg, Sb * 128).transpose(1, 0, 2) \
+        .reshape(-1, 128)
+    y2 = _binned_block_call(Ad.bn_meta, Ad.bn_codes, Ad.bn_vals, x4,
+                            Ad.bn_dims)                # (b, n_tiles·T)
+    yt = y2.T                                          # (rows_pad, b)
+    if ident:
+        return yt[:n_b].reshape(-1)
+    return yt[Ad.bn_pos].reshape(-1)
+
+
 def _row_pad_of_lane(Ad):
     """Padded row id per plane LANE.  Chunk order is tile-sorted and
     dummy chunks share one zero block, so the per-chunk meta is mapped
     back to plane blocks through the chunk→block column (the zero
     block's attribution is irrelevant: its values are all 0)."""
-    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims[:9]
     Wp = w * T
     L = Ad.bn_codes.size
     tile_of_blk = jnp.zeros((L // Wp,), jnp.int32).at[
@@ -381,7 +562,7 @@ def binned_entries_view(Ad):
     planes — ORIGINAL scalar row ids; padding lanes carry value 0 on
     row 0.  Serves the segment-sum fallback, ``abs_rowsum`` and host
     densification on a lean pack (kernel layouts are the only arrays)."""
-    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims[:9]
     row_pad = _row_pad_of_lane(Ad)
     if ident:
         rows = jnp.where(row_pad < n_sc, row_pad, 0)
@@ -397,7 +578,7 @@ def binned_entries_view(Ad):
 def binned_abs_rowsum(Ad) -> jax.Array:
     """Σ_j |A[i, j]| per scalar row from the planes alone (padding
     contributes 0) — L1-Jacobi / Gershgorin on a lean binned pack."""
-    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims
+    C, n_tiles, n_seg, T, Sb, w, ident, n_sc, m_sc = Ad.bn_dims[:9]
     row_pad = _row_pad_of_lane(Ad)
     rs = jax.ops.segment_sum(jnp.abs(Ad.bn_vals.reshape(-1)), row_pad,
                              num_segments=n_tiles * T)

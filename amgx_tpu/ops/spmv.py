@@ -112,6 +112,8 @@ def spmv(A, x: jax.Array) -> jax.Array:
         _tel_pack("op")
         return A.apply(x)
     if A.fmt == "dia":
+        if A.block_dim > 1:
+            return _bdia_spmv(A, x)
         from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
         if ((jax.default_backend() == "tpu" or _INTERPRET)
                 and dia_spmv_supported(A.n_rows, A.dia_offsets, A.dtype)
@@ -178,29 +180,27 @@ def spmv(A, x: jax.Array) -> jax.Array:
                       else None, A=A)
             prod = _widen(A.ell_vals_view()) * _widen(x)[A.ell_cols_view()]
             return _narrow_to(jnp.sum(prod, axis=1), A, x)
-        from .pallas_csr import binned_spmv, binned_supported
+        from .pallas_csr import bn_block_dim, binned_spmv, binned_supported
         if binned_supported(A):
-            # the pack carries the block matrix's SCALAR expansion —
-            # x is already the flat scalar vector
-            _tel_pack("ell/binned", A=A)
-            return binned_spmv(A, x)
+            # block-NATIVE planes (one code per b×b block, b-lane MXU
+            # pick) — or the legacy scalar expansion behind the
+            # AMGX_BLOCK_NATIVE=0 knob, where x is already flat scalar
+            native = bn_block_dim(A.bn_dims) > 1
+            _tel_pack("ell/binned-block" if native else "ell/binned",
+                      A=A)
+            return _narrow_to(binned_spmv(A, x), A, x)
         _tel_pack("ell/block-gather",
                   fallback="kernel_gate_rejected"
                   if getattr(A, "bn_codes", None) is not None else None,
                   A=A)
-        xb = x.reshape(A.n_cols, b)
-        xg = xb[A.cols]                      # (n, K, b)
-        pet = jnp.float32 if (_sub_f32(A.vals.dtype)
-                              or _sub_f32(xg.dtype)) else A.vals.dtype
-        y = jnp.einsum("nkab,nkb->na", A.vals, xg,
-                       preferred_element_type=pet)
-        return _narrow_to(y.reshape(-1), A, x)
+        return _block_gather_spmv(A, x)
     # CSR path: binned sliced-ELL kernel first, segment-sum fallback
-    from .pallas_csr import (binned_entries_view, binned_spmv,
-                             binned_supported)
+    from .pallas_csr import (binned_entries_view, bn_block_dim,
+                             binned_spmv, binned_supported)
     if binned_supported(A):
-        _tel_pack("csr/binned", A=A)
-        return binned_spmv(A, x)
+        _tel_pack("csr/binned-block"
+                  if bn_block_dim(A.bn_dims) > 1 else "csr/binned", A=A)
+        return _narrow_to(binned_spmv(A, x), A, x)
     if b == 1:
         if A.vals is None:
             # lean binned pack on a backend the kernel cannot serve:
@@ -231,6 +231,83 @@ def spmv(A, x: jax.Array) -> jax.Array:
     return _narrow_to(y.reshape(-1), A, x)
 
 
+#: element budget of one materialised (n, Kc, b) x-gather in the block
+#: ELL fallback — chunking the K axis keeps large block matrices from
+#: OOMing on the full (n, K, b) gather (ISSUE 15 satellite); at f32 the
+#: default bounds each chunk's gather to ~64 MB
+_BLOCK_GATHER_ELEMS = 1 << 24
+
+
+def _block_gather_spmv(A, x: jax.Array) -> jax.Array:
+    """Block ELL gather fallback, contracted per-K-chunk: the old
+    single-shot ``xb[A.cols]`` materialised an (n, K, b) gather before
+    the einsum — b× the matrix's own value bytes as TEMPORARY memory,
+    which OOMed large block systems that only needed the fallback.
+    Each chunk gathers at most ``_BLOCK_GATHER_ELEMS`` elements and
+    accumulates into the (n, b) result."""
+    b = A.block_dim
+    n = A.n_rows
+    K = A.cols.shape[1]
+    xb = x.reshape(A.n_cols, b)
+    pet = jnp.float32 if (_sub_f32(A.vals.dtype) or _sub_f32(xb.dtype)) \
+        else jnp.promote_types(A.vals.dtype, xb.dtype)
+    kc = max(1, min(K, _BLOCK_GATHER_ELEMS // max(n * b, 1)))
+    y = jnp.zeros((n, b), dtype=pet)
+    for k0 in range(0, K, kc):
+        k1 = min(k0 + kc, K)
+        cols_c = jax.lax.slice_in_dim(A.cols, k0, k1, axis=1)
+        vals_c = jax.lax.slice_in_dim(A.vals, k0, k1, axis=1)
+        y = y + jnp.einsum("nkab,nkb->na", vals_c, xb[cols_c],
+                           preferred_element_type=pet)
+    return _narrow_to(y.reshape(-1), A, x)
+
+
+def _bdia_spmv(A, x: jax.Array) -> jax.Array:
+    """Block-DIA apply: every block diagonal carries an (n, b, b) value
+    plane; no per-entry index data at all (ISSUE 15 tentpole (b)).
+
+    Kernel path: each in-block component (a, c) is EXACTLY a scalar DIA
+    over the c-th x sub-lane with the same block offsets, so the
+    existing Pallas DIA kernel serves block planes as b² component
+    dispatches (bf16 planes stream at half width, f32 accumulate).
+    XLA path: nd shifted (n, b) slices of one padded x block, each
+    contracted with its (n, b, b) plane — still zero index bytes.
+    """
+    import dataclasses
+    b = A.block_dim
+    n = A.n_rows
+    offs = A.dia_offsets
+    xb = _widen(x).reshape(A.n_cols, b)
+    from .pallas_spmv import _INTERPRET, dia_spmv, dia_spmv_supported
+    if ((jax.default_backend() == "tpu" or _INTERPRET)
+            and dia_spmv_supported(n, offs, A.dtype)
+            and jnp.dtype(x.dtype).itemsize <= 4):
+        _tel_pack("dia/block-kernel", A=A)
+        out_cols = []
+        for a in range(b):
+            acc = None
+            for c in range(b):
+                comp = dataclasses.replace(
+                    A, vals=A.vals[:, :, a, c], diag=A.diag[:, a, a],
+                    block_dim=1)
+                ya = dia_spmv(comp, xb[:, c])
+                acc = ya if acc is None else acc + ya
+            out_cols.append(acc)
+        y = jnp.stack(out_cols, axis=1)
+        return _narrow_to(y.reshape(-1), A, x)
+    _tel_pack("dia/block-slices", A=A)
+    maxo = max(max(abs(o) for o in offs), 1)
+    xp = jnp.pad(xb, ((maxo, maxo), (0, 0)))
+    pet = jnp.float32 if _sub_f32(A.dtype) else \
+        jnp.promote_types(A.dtype, xb.dtype)
+    acc = jnp.zeros((n, b), dtype=pet)
+    for k, o in enumerate(offs):
+        xs = jax.lax.slice(xp, (maxo + o, 0), (maxo + o + n, b))
+        acc = acc + jnp.einsum("nab,nb->na", _widen(A.vals[k]), xs,
+                               preferred_element_type=pet)
+    return _narrow_to(acc.reshape(-1), A, x)
+
+
 def abs_rowsum(A) -> jax.Array:
     """Σ_j |A[i, j]| per scalar row, from any pack (pad/explicit zeros
     contribute 0).  Serves the L1-Jacobi diagonal and Chebyshev
@@ -241,10 +318,20 @@ def abs_rowsum(A) -> jax.Array:
     if A.fmt == "dia3":
         return _widen(A.l1row)  # precomputed from the embedded form
     if A.fmt == "dia":
+        if A.block_dim > 1:
+            # (nd, n, b, b) block planes: per scalar row (i, a) sum
+            # over every diagonal's block row a
+            return jnp.sum(jnp.abs(_widen(A.vals)),
+                           axis=(0, 3)).reshape(-1)
         return jnp.sum(jnp.abs(_widen(A.vals)), axis=0)
     if A.fmt == "dense":
         return jnp.sum(jnp.abs(_widen(A.vals)), axis=1)
     if A.fmt == "ell":
+        if A.block_dim > 1:
+            # (n, K, b, b) → per scalar row (i, a): sum over K and the
+            # in-block column axis
+            return jnp.sum(jnp.abs(_widen(A.vals)),
+                           axis=(1, 3)).reshape(-1)
         # ell_vals_view reconstructs row-major values on a lean pack
         return jnp.sum(jnp.abs(_widen(A.ell_vals_view())), axis=1)
     if A.fmt == "sharded-ell":
@@ -255,6 +342,11 @@ def abs_rowsum(A) -> jax.Array:
         # lean binned pack: the planes are the only value arrays
         from .pallas_csr import binned_abs_rowsum
         return binned_abs_rowsum(A)
+    if A.block_dim > 1:
+        # (e, b, b) blocks: in-block column sums, then per-block-row
+        per = jnp.sum(jnp.abs(_widen(A.vals)), axis=2)
+        return jax.ops.segment_sum(per, A.row_ids,
+                                   num_segments=A.n_rows).reshape(-1)
     return jax.ops.segment_sum(jnp.abs(_widen(A.vals)), A.row_ids,
                                num_segments=A.n_rows)
 

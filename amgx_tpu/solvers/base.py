@@ -1511,6 +1511,17 @@ class Solver:
         import scipy.sparse as sp
         from ..core.matrix import dia_arrays, ell_layout
         if Ad.fmt == "dia":
+            if Ad.block_dim > 1:
+                # block-DIA planes: rebuild (nd, n, b, b) from the BSR
+                from ..core.matrix import dia_arrays_block
+                b = Ad.block_dim
+                bsr = self.A.host if isinstance(self.A.host,
+                                                sp.bsr_matrix) else \
+                    sp.bsr_matrix(self.A.host, blocksize=(b, b))
+                bsr.sort_indices()
+                offs, bvals = dia_arrays_block(bsr)
+                assert tuple(offs) == tuple(Ad.dia_offsets)
+                return bvals.astype(np.float64, copy=False)
             # dia_cache first: for DIA-backed matrices (device-generated
             # operators included) this never assembles the host CSR
             arrs = self.A.dia_cache() if isinstance(self.A, Matrix) \

@@ -81,6 +81,15 @@ def _densify_device(Ad) -> np.ndarray:
     if Ad.fmt == "dia":
         vals = np.asarray(Ad.vals)
         out = np.zeros((n, m), dtype=vals.dtype)
+        if b > 1:
+            # block-DIA planes: scatter each offset's (nb, b, b) blocks
+            nb = Ad.n_rows
+            for k, o in enumerate(Ad.dia_offsets):
+                rows = np.arange(max(0, -o), min(nb, nb - o))
+                for i in rows:
+                    out[i * b:(i + 1) * b,
+                        (i + o) * b:(i + o + 1) * b] = vals[k, i]
+            return out
         for k, o in enumerate(Ad.dia_offsets):
             rows = np.arange(max(0, -o), min(n, n - o))
             out[rows, rows + o] = vals[k, rows]

@@ -286,8 +286,12 @@ class MulticolorGSSolver(_ColoredSmootherBase):
         for c in order:
             s = self.color_slabs[c]
             xg = x.reshape(-1, bd)[s.cols]                 # (nc, K, b)
+            # sub-f32 slab values (bf16 hierarchy) accumulate in f32 —
+            # the same floor every SpMV path applies (core/precision.py)
+            from ..core.precision import compute_dtype as _cdt
+            pet = jnp.promote_types(_cdt(s.vals.dtype), xg.dtype)
             Ax = jnp.einsum("nkab,nkb->na", s.vals, xg,
-                            preferred_element_type=s.vals.dtype)
+                            preferred_element_type=pet)
             r_c = b.reshape(-1, bd)[s.rows] - Ax
             if self.dinv.ndim == 1:    # L1 variant: scalar damped diag
                 dx = relax * self.dinv.reshape(-1, bd)[s.rows] * r_c

@@ -31,23 +31,31 @@ def _invert_block_diag(diag) -> jax.Array:
     setup.  One host computation + one transfer instead.
     """
     d = np.asarray(diag)
-    if d.ndim == 1:
-        out = np.where(d != 0, 1.0 / np.where(d == 0, 1.0, d), 0.0)
+    # sub-f32 storage (bf16 block hierarchies): numpy's LinAlg kernels
+    # don't take ml_dtypes — invert at the f32 compute floor and store
+    # the RESULT narrow, the same storage-vs-arithmetic split every
+    # other smoother-data path applies (core/precision.py)
+    from ..core.precision import compute_dtype as _cdt
+    store_dt = d.dtype
+    work = d.astype(_cdt(d.dtype), copy=False)
+    if work.ndim == 1:
+        out = np.where(work != 0,
+                       1.0 / np.where(work == 0, 1.0, work), 0.0)
     else:
         # scale-invariant singularity test: normalise each block by its
         # max entry first (raw |det| underflows for well-conditioned but
         # small-magnitude blocks, silently replacing D⁻¹ with I)
-        bdim = d.shape[-1]
-        scale = np.max(np.abs(d), axis=(-2, -1))
+        bdim = work.shape[-1]
+        scale = np.max(np.abs(work), axis=(-2, -1))
         nz = scale > 0
-        dn = d / np.where(nz, scale, 1.0)[:, None, None]
+        dn = work / np.where(nz, scale, 1.0)[:, None, None]
         bad = ~nz | (np.abs(np.linalg.det(dn))
-                     < bdim * np.finfo(d.dtype).eps)
+                     < bdim * np.finfo(work.dtype).eps)
         safe = np.where(bad[:, None, None],
-                        np.eye(bdim, dtype=d.dtype), dn)
+                        np.eye(bdim, dtype=work.dtype), dn)
         out = np.linalg.inv(safe) / np.where(nz & ~bad, scale,
                                              1.0)[:, None, None]
-    return jnp.asarray(out.astype(d.dtype))
+    return jnp.asarray(out.astype(store_dt))
 
 
 def _apply_dinv(dinv: jax.Array, v: jax.Array) -> jax.Array:

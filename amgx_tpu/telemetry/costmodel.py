@@ -62,8 +62,10 @@ def spmv_cost(Ad, nnz: Optional[int] = None) -> dict:
     estimated = nnz is None
     if nnz is None:
         nnz = slots
+    bdim = int(getattr(Ad, "block_dim", 1) or 1)
     out = {"pack": pack, "fmt": fmt, "dtype": str(np.dtype(Ad.dtype)),
            "itemsize": itemsize, "estimated": estimated,
+           "block_dim": bdim,
            "nnz": None if nnz is None else int(nnz),
            "padded_entries": None if slots is None else int(slots)}
     if fmt == "op" or slots is None:
@@ -74,8 +76,10 @@ def spmv_cost(Ad, nnz: Optional[int] = None) -> dict:
     out["padding_waste"] = round(slots / max(int(nnz), 1), 4)
 
     if fmt == "dia":
+        # block-DIA: nd offsets × (n, b, b) value planes + the x/y
+        # vectors — zero index bytes either way
         n = Ad.n_rows
-        byt = (Ad.ell_width + 2) * n * itemsize
+        byt = (Ad.ell_width * bdim * bdim + 2 * bdim) * n * itemsize
     elif fmt == "dia3":
         # Galerkin composition R·(A·(P·x)): each factor's diagonal rows
         # stream once, plus the two intermediates and x/y
@@ -104,12 +108,19 @@ def spmv_cost(Ad, nnz: Optional[int] = None) -> dict:
                + _vec_bytes(Ad.n_rows, Ad.n_cols, itemsize))
     elif getattr(Ad, "bn_codes", None) is not None:
         # binned sliced-ELL kernel: codes+vals planes stream once, one
-        # (Sb, 128) x segment per chunk, y once
+        # (Sb, 128) x segment per chunk (× b component sub-lanes), y
+        # once.  Block-NATIVE planes carry ONE int32 code per b×b block
+        # — index bytes are per BLOCK, not per scalar slot (the
+        # satellite fix: the scalar-expansion pack honestly moves b²×
+        # the index bytes, and the descriptor must distinguish them)
+        from ..ops.pallas_csr import bn_block_dim
+        bb = bn_block_dim(Ad.bn_dims)
         L = int(Ad.bn_codes.size)
         C = int(Ad.bn_dims[0])
         Sb = int(Ad.bn_dims[4])
-        byt = L * (_INDEX_BYTES + itemsize) \
-            + C * Sb * 128 * itemsize + Ad.n_rows * itemsize
+        byt = L * _INDEX_BYTES + L * bb * bb * itemsize \
+            + C * Sb * 128 * bb * itemsize \
+            + Ad.n_rows * bb * itemsize
     elif fmt == "ell":
         # gather form: values + int32 columns + x/y
         byt = slots * itemsize \

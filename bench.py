@@ -404,6 +404,139 @@ def _bench_mixed_precision(oracle, make_matrix, cfg_str, dtype,
     return out, case_bf
 
 
+def _chain_time(Adf, x, reps=3, k=256):
+    """min-of-reps per-apply seconds of a K-long SpMV chain (the same
+    amortised-chain estimator ``measure`` uses, self-contained so the
+    module-level bench blocks can call it)."""
+    import jax
+    import jax.numpy as jnp
+
+    from amgx_tpu.ops.spmv import spmv
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(2,))
+    def chain(A, v, K):
+        def body(i, v):
+            return spmv(A, v) * jnp.asarray(1e-3, v.dtype)
+        return jnp.sum(jax.lax.fori_loop(0, K, body, v))
+
+    float(chain(Adf, x, k))          # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(chain(Adf, x, k))
+        best = min(best, time.perf_counter() - t0)
+    return best / k
+
+
+def _bench_gauntlet(dtype, scale=1.0):
+    """The real-matrix gauntlet (ISSUE 15): every block case solved
+    through its matched config with iterations + achieved GB/s +
+    GFLOP/s recorded — loaded via the MatrixMarket write → block_dim
+    re-blocking read round trip, so the measured operator took the full
+    user upload path.  Returns one flat dict per case
+    (``gauntlet_<name>``) so perf_gate tracks each case's setup_s /
+    solve_s / iterations like any other bench case."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import numpy as np
+    import scipy.sparse as sp
+
+    import amgx_tpu as amgx
+    from amgx_tpu.core.matrix import pack_kind
+    from amgx_tpu.io.gauntlet import gauntlet_cases, \
+        load_via_matrix_market
+    from amgx_tpu.telemetry import costmodel
+
+    out = {}
+    with tempfile.TemporaryDirectory() as td:
+        for case in gauntlet_cases(scale=scale):
+            try:
+                sysd, _ = load_via_matrix_market(case, td)
+                m = amgx.Matrix(sysd.A, block_dim=case.block_dim)
+                m.device_dtype = dtype
+                oracle = sp.csr_matrix(sysd.A)
+                slv = amgx.create_solver(amgx.AMGConfig(case.cfg))
+                t0 = time.perf_counter()
+                slv.setup(m)
+                setup_s = time.perf_counter() - t0
+                b = np.ones(m.shape[0])
+                slv.solve(b)                    # warm/compile
+                t0 = time.perf_counter()
+                res = slv.solve(b)
+                solve_s = time.perf_counter() - t0
+                x = np.asarray(res.x, np.float64)
+                rr = float(np.linalg.norm(b - oracle @ x)
+                           / np.linalg.norm(b))
+                Ad = m.device()
+                xs = jnp.asarray(np.random.default_rng(3)
+                                 .standard_normal(m.shape[0]), dtype)
+                per = _chain_time(Ad, xs)
+                cost = costmodel.spmv_cost(Ad, nnz=oracle.nnz)
+                gbs = costmodel.achieved_gbs(
+                    cost["bytes_per_apply"] or 0, per)
+                out[f"gauntlet_{case.name}"] = {
+                    "n": int(m.shape[0]), "nnz": int(oracle.nnz),
+                    "block_dim": case.block_dim,
+                    "setup_s": round(setup_s, 4),
+                    "solve_s": round(solve_s, 4),
+                    "iterations": int(res.iterations),
+                    "relres": rr, "pack": pack_kind(Ad),
+                    "spmv_gbs": round(gbs, 2),
+                    "spmv_gflops": round(
+                        2.0 * oracle.nnz / max(per, 1e-12) / 1e9, 2),
+                    "roofline_frac": round(costmodel.roofline_fraction(
+                        gbs), 4),
+                }
+            except Exception as e:
+                import traceback
+                traceback.print_exc()
+                out[f"gauntlet_{case.name}"] = {"error": str(e)[:200]}
+    return out
+
+
+def _bench_block_kernels(dtype):
+    """Block-native vs scalar-expansion SpMV A/B on the b=4 gauntlet
+    class (ISSUE 15 acceptance): the SAME scattered block operator
+    packed both ways, per-apply chain-timed; ``block_spmv_speedup`` is
+    the equal-work wall ratio (≡ effective-GB/s ratio) perf_gate pins
+    at ≥ 1.5×.  The expansion pack stays available behind the
+    ``AMGX_BLOCK_NATIVE=0`` knob / ``block_native=False``."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from amgx_tpu.core.matrix import pack_device, pack_kind
+    from amgx_tpu.io.gauntlet import scattered_block_operator
+    from amgx_tpu.telemetry import costmodel
+
+    nb = 12288
+    bsr = scattered_block_operator(nb, 4)    # shared with prim_bench
+    nnz_sc = int(bsr.nnz)                    # scipy BSR counts scalars
+    x = jnp.asarray(np.random.default_rng(15)
+                    .standard_normal(nb * 4), dtype)
+    out = {"n": nb * 4, "nnz_scalar": nnz_sc, "block_dim": 4}
+    packs = {}
+    for label, native in (("native", True), ("expansion", False)):
+        Ad = pack_device(bsr, 4, dtype, dia_max_diags=0,
+                         block_native=native)
+        per = _chain_time(Ad, x, k=64)
+        cost = costmodel.spmv_cost(Ad, nnz=nnz_sc)
+        gbs = costmodel.achieved_gbs(cost["bytes_per_apply"] or 0, per)
+        packs[label] = per
+        out[label] = {
+            "pack": pack_kind(Ad), "per_apply_s": round(per, 8),
+            "bytes_per_apply": cost["bytes_per_apply"],
+            "achieved_gbs": round(gbs, 2),
+            "gflops": round(2.0 * nnz_sc / max(per, 1e-12) / 1e9, 2),
+        }
+    # equal-work ratio: both packs apply the same operator, so the
+    # wall ratio IS the effective-bandwidth ratio
+    out["block_spmv_speedup"] = round(
+        packs["expansion"] / max(packs["native"], 1e-12), 3)
+    return out
+
+
 def _warm_start_child() -> int:
     """One cold/warm-start probe process (``bench.py
     --warm-start-child``): import → classical setup → first solve, all
@@ -1025,7 +1158,10 @@ def main():
                    + len(Adf.R.dia_offsets) + 6)
             bytes_moved = nd3 * Adf.n_rows * itemsize
         elif Adf.fmt == "dia":
-            bytes_moved = (Adf.ell_width + 2) * nr * itemsize
+            # block-DIA planes count b² value slots per offset row
+            bb = Adf.block_dim
+            bytes_moved = (Adf.ell_width * bb * bb + 2 * bb) \
+                * Adf.n_rows * itemsize
         elif Adf.fmt == "ell" and Adf.sh_vals is not None:
             # tile-DIA shift pack: class-value rows + per-class x windows
             # + y (no per-entry column data at all)
@@ -1038,12 +1174,15 @@ def main():
                 Adf.ell_width * nr * 4
         elif Adf.bn_codes is not None:
             # binned sliced-ELL kernel: codes+vals planes stream once,
-            # one (Sb, 128) x segment per chunk, y once
+            # one (Sb, 128) x segment per chunk (× b sub-lanes for
+            # block-native planes), y once
+            from amgx_tpu.ops.pallas_csr import bn_block_dim
+            bb = bn_block_dim(Adf.bn_dims)
             L = int(Adf.bn_codes.size)
             C = int(Adf.bn_dims[0])
             Sb = int(Adf.bn_dims[4])
-            bytes_moved = L * (4 + itemsize) + \
-                C * Sb * 128 * itemsize + nr * itemsize
+            bytes_moved = L * (4 + bb * bb * itemsize) + \
+                C * Sb * 128 * bb * itemsize + nr * itemsize
         else:  # CSR: nnz vals + int32 cols/row_ids + x/y vectors
             bytes_moved = nnz * (itemsize + 8) + 2 * nr * itemsize
         return t, 2.0 * nnz / t / 1e9, bytes_moved / t / 1e9
@@ -1386,6 +1525,23 @@ def main():
 
         extra_cases["classical_device_resetup48"] = guarded(
             "classical_device_resetup48", case_resetup)
+
+        # real-matrix gauntlet (ISSUE 15): block b=2-5
+        # elasticity/CFD/anisotropic/jump cases, each solved via its
+        # matched config through the MatrixMarket round trip — per-case
+        # iterations + achieved GB/s tracked by perf_gate
+        if os.environ.get("AMGX_BENCH_GAUNTLET", "1") != "0":
+            g = guarded("gauntlet", lambda: _bench_gauntlet(dtype))
+            if isinstance(g, dict) and "error" not in g:
+                extra_cases.update(g)
+            else:
+                extra_cases["gauntlet"] = g
+
+        # block-native vs scalar-expansion SpMV A/B (ISSUE 15
+        # acceptance: b=4 ≥ 1.5× effective GB/s; perf_gate pins the
+        # floor as a "scaling"-kind contract)
+        extra_cases["block_kernels"] = guarded(
+            "block_kernels", lambda: _bench_block_kernels(dtype))
 
         # bf16-hierarchy headline case at 128³ (ISSUE 10 acceptance):
         # the perf-gate case — solve/setup/iterations like every other

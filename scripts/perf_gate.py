@@ -74,13 +74,19 @@ FLOOR_SLACK = 0.05
 #: distributed stack at fixed rows/device on the forced 8-device CPU
 #: mesh — a pinned floor, not a ratcheted measurement: falling below
 #: it means the pod-scale path stopped scaling)
+#: block_spmv_speedup is a SCALING metric from the bench
+#: ``block_kernels`` A/B (ISSUE 15: block-native b=4 SpMV over the
+#: scalar-expansion pack on the same operator — a pinned ≥1.5×
+#: contract that --update never ratchets: the block micro-tile layout
+#: must keep beating the expansion it replaced)
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
            ("cold_start_s", "time"), ("warm_start_s", "time"),
            ("serve_p99_s", "time"), ("rejection_rate", "rate"),
            ("bf16_effective_speedup", "floor"),
            ("lane_speedup", "scaling"),
-           ("weak_eff", "scaling"))
+           ("weak_eff", "scaling"),
+           ("block_spmv_speedup", "scaling"))
 
 
 def _extract_parsed(rec: dict):

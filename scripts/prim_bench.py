@@ -7,7 +7,14 @@ sizes.
 engine's fused Galerkin pass (ops/spgemm.py) on a Poisson 7-point
 operator with a 2×2×2 piecewise-constant P — host-symbolic seconds,
 device-numeric GB/s and GFLOP/s, and the fraction of the v5e HBM
-roofline (telemetry/costmodel.py) the contraction achieves."""
+roofline (telemetry/costmodel.py) the contraction achieves.
+
+``block`` mode (``prim_bench.py block [n_blocks] [b ...]``): b×b block
+SpMV per b ∈ {2,3,4,5} on a scattered block operator — block-NATIVE
+pack (b×b MXU micro-tiles, one index per block) vs the PR-1
+scalar-expansion pack (the ``AMGX_BLOCK_NATIVE=0`` knob's layout) —
+reporting per-apply GB/s, GFLOP/s, roofline fraction and the
+equal-work speedup (ISSUE 15 acceptance: b=4 ≥ 1.5×)."""
 import sys
 import time
 
@@ -71,8 +78,68 @@ def _bench_spgemm(n_side: int = 64):
           f"{costmodel.HBM_PEAK_GBS:.0f} GB/s v5e roofline)")
 
 
+def _bench_block(n_blocks: int = 12288, bs=(2, 3, 4, 5)):
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from amgx_tpu.core.matrix import pack_device, pack_kind
+    from amgx_tpu.io.gauntlet import scattered_block_operator
+    from amgx_tpu.telemetry import costmodel
+
+    dt = np.float32
+    interpret = os.environ.get("AMGX_PALLAS_INTERPRET") == "1"
+    if jax.default_backend() != "tpu" and not interpret:
+        print("block mode needs a TPU (or AMGX_PALLAS_INTERPRET=1 for "
+              "a functional run)", file=sys.stderr)
+        return
+    rng = np.random.default_rng(15)
+    for b in bs:
+        # the SAME operator bench.py's block_kernels A/B measures —
+        # the perf_gate contract and this tuning view must agree
+        bsr = scattered_block_operator(n_blocks, b)
+        x = jnp.asarray(rng.standard_normal(n_blocks * b), dt)
+        nnz_sc = int(bsr.nnz)       # scipy BSR .nnz counts scalars
+        res = {}
+        for label, native in (("native", True), ("expansion", False)):
+            Ad = pack_device(bsr, b, dt, dia_max_diags=0,
+                             block_native=native)
+
+            def apply_fn(A, v):
+                from amgx_tpu.ops.spmv import spmv
+                return spmv(A, v)
+
+            fn = jax.jit(apply_fn)
+            jax.block_until_ready(fn(Ad, x))
+            best = float("inf")
+            reps, k = (2, 4) if interpret else (3, 64)
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    y = fn(Ad, x)
+                jax.block_until_ready(y)
+                best = min(best, (time.perf_counter() - t0) / k)
+            cost = costmodel.spmv_cost(Ad, nnz=nnz_sc)
+            gbs = costmodel.achieved_gbs(cost["bytes_per_apply"] or 0,
+                                         best)
+            res[label] = best
+            print(f"b={b} {label:9s} [{pack_kind(Ad):18s}] "
+                  f"{best * 1e6:9.1f} us/apply  "
+                  f"{2.0 * nnz_sc / best / 1e9:8.2f} GFLOP/s  "
+                  f"{gbs:7.1f} GB/s "
+                  f"({costmodel.roofline_fraction(gbs):.2f}x of "
+                  f"{costmodel.HBM_PEAK_GBS:.0f})", flush=True)
+        print(f"b={b} speedup (equal-work, native vs expansion): "
+              f"{res['expansion'] / max(res['native'], 1e-12):.2f}x",
+              flush=True)
+
+
 if len(sys.argv) > 1 and sys.argv[1] == "spgemm":
     _bench_spgemm(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
+    sys.exit(0)
+
+if len(sys.argv) > 1 and sys.argv[1] == "block":
+    _bench_block(int(sys.argv[2]) if len(sys.argv) > 2 else 12288,
+                 tuple(int(a) for a in sys.argv[3:]) or (2, 3, 4, 5))
     sys.exit(0)
 
 n = 572_000

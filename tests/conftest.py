@@ -70,6 +70,9 @@ def pytest_configure(config):
         "markers", "chaos: fault-injection / breakdown-recovery fast "
                    "tests (tier-1; pytest -m chaos selects just "
                    "these)")
+    config.addinivalue_line(
+        "markers", "block: block-native kernel / gauntlet fast tests "
+                   "(tier-1; pytest -m block selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

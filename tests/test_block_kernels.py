@@ -1,0 +1,452 @@
+"""Block-native SpMV and smoother kernels (ISSUE 15) — interpret tier.
+
+The parity contract: for every b ∈ {2,3,4,5} the block-native layouts
+(binned b×b micro-tile planes, block-DIA offset planes, the chunked
+block-gather fallback) must reproduce the f64 host product — and the
+PR-1 scalar expansion they replace — at f32 (and bf16-plane) tolerance;
+block DILU's device factorisation must match the host one; and a
+values-only resetup of a block hierarchy must stay zero-retrace.
+"""
+import os
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu.core.matrix import pack_device, pack_kind
+from amgx_tpu.io import poisson5pt, poisson7pt
+from amgx_tpu.ops import pallas_csr
+from amgx_tpu.ops.spmv import abs_rowsum, spmv
+
+pytestmark = pytest.mark.block
+
+BF16 = np.dtype("bfloat16")
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(pallas_csr, "_INTERPRET", True)
+
+
+def _scattered_block(nb, b, density=0.02, seed=0):
+    rng = np.random.default_rng(seed)
+    base = (sp.random(nb, nb, density=density, random_state=seed,
+                      format="csr")
+            + sp.diags(rng.uniform(3.0, 4.0, nb))).tocsr()
+    data = rng.standard_normal((base.nnz, b, b))
+    return sp.bsr_matrix((data, base.indices, base.indptr),
+                         shape=(nb * b, nb * b))
+
+
+def _banded_block(nb, b, seed=1):
+    """Block 5-pt stencil: the block-DIA-eligible class."""
+    rng = np.random.default_rng(seed)
+    n_side = int(round(nb ** 0.5))
+    L = poisson5pt(n_side, n_side)
+    K = np.eye(b) * 3.0 + rng.standard_normal((b, b)) * 0.2
+    return sp.bsr_matrix(sp.kron(L, K), blocksize=(b, b))
+
+
+def _parity(Ad, bsr, tol=5e-5, seed=3, x_dtype=np.float32):
+    import jax.numpy as jnp
+    n = bsr.shape[1]
+    x = np.random.default_rng(seed).standard_normal(n).astype(x_dtype)
+    y = np.asarray(spmv(Ad, jnp.asarray(x)), np.float64)
+    ref = sp.csr_matrix(bsr).astype(np.float64) @ x.astype(np.float64)
+    scale = max(np.abs(ref).max(), 1.0)
+    assert np.abs(y - ref).max() / scale < tol
+
+
+# ------------------------------------------------------- binned parity
+@pytest.mark.parametrize("b", [2, 3, 4, 5])
+def test_block_binned_parity_vs_expansion(b):
+    """Block-native planes attach for every b, carry the 10-tuple dims,
+    and match both the f64 oracle and the scalar-expansion pack."""
+    import jax.numpy as jnp
+    bsr = _scattered_block(150, b, seed=b)
+    Adn = pack_device(bsr, b, np.float32, dia_max_diags=0)
+    assert pack_kind(Adn) == "ell/binned-block"
+    assert pallas_csr.bn_block_dim(Adn.bn_dims) == b
+    _parity(Adn, bsr)
+    # the A/B knob keeps the PR-1 scalar expansion available
+    Ade = pack_device(bsr, b, np.float32, dia_max_diags=0,
+                      block_native=False)
+    assert pack_kind(Ade) == "ell/binned"
+    assert pallas_csr.bn_block_dim(Ade.bn_dims) == 1
+    x = np.random.default_rng(3).standard_normal(
+        bsr.shape[1]).astype(np.float32)
+    yn = np.asarray(spmv(Adn, jnp.asarray(x)), np.float64)
+    ye = np.asarray(spmv(Ade, jnp.asarray(x)), np.float64)
+    assert np.abs(yn - ye).max() / max(np.abs(ye).max(), 1.0) < 1e-4
+
+
+def test_block_binned_env_knob(monkeypatch):
+    monkeypatch.setenv("AMGX_BLOCK_NATIVE", "0")
+    Ad = pack_device(_scattered_block(100, 3, seed=9), 3, np.float32,
+                     dia_max_diags=0)
+    assert pack_kind(Ad) == "ell/binned"     # scalar expansion
+
+
+def test_block_binned_bf16_planes_f32_krylov():
+    """bf16 block value planes: the kernel accepts them (f32
+    accumulation) and an f32 x stays f32 through the apply — the
+    mixed-precision output contract."""
+    import jax.numpy as jnp
+    bsr = _scattered_block(200, 4, seed=11)
+    Ad = pack_device(bsr, 4, np.float32, dia_max_diags=0)
+    from amgx_tpu.core import precision
+    assert precision.narrowable_pack(Ad)
+    Adb = Ad.astype(jnp.bfloat16)
+    from amgx_tpu.ops.pallas_csr import binned_supported
+    assert binned_supported(Adb)
+    x = np.random.default_rng(5).standard_normal(
+        bsr.shape[1]).astype(np.float32)
+    y = spmv(Adb, jnp.asarray(x))
+    assert y.dtype == jnp.float32
+    _parity(Adb, bsr, tol=0.03)
+    # bf16 x through a bf16 pack rounds once at the end → bf16 out
+    yb = spmv(Adb, jnp.asarray(x, jnp.bfloat16))
+    assert yb.dtype == jnp.bfloat16
+
+
+def test_block_binned_f64_interpret_parity():
+    bsr = _scattered_block(120, 3, seed=13)
+    Ad = pack_device(bsr, 3, np.float64, dia_max_diags=0)
+    assert pack_kind(Ad) == "ell/binned-block"
+    _parity(Ad, bsr, tol=1e-12, x_dtype=np.float64)
+
+
+def test_block_binned_abs_rowsum():
+    bsr = _scattered_block(130, 4, seed=17)
+    Ad = pack_device(bsr, 4, np.float32, dia_max_diags=0)
+    rs = np.asarray(abs_rowsum(Ad), np.float64)
+    ref = np.asarray(np.abs(sp.csr_matrix(bsr)).sum(axis=1)).ravel()
+    np.testing.assert_allclose(rs, ref, rtol=1e-5)
+
+
+# ---------------------------------------------------------- block DIA
+@pytest.mark.parametrize("b", [2, 3, 5])
+def test_block_dia_pack_and_parity(b):
+    bsr = _banded_block(100, b, seed=b)
+    Ad = pack_device(bsr, b, np.float64)
+    assert Ad.fmt == "dia" and Ad.block_dim == b
+    assert pack_kind(Ad) == "dia/block"
+    assert Ad.vals.shape[2:] == (b, b)
+    _parity(Ad, bsr, tol=1e-12, x_dtype=np.float64)
+    rs = np.asarray(abs_rowsum(Ad), np.float64)
+    ref = np.asarray(np.abs(sp.csr_matrix(bsr)).sum(axis=1)).ravel()
+    np.testing.assert_allclose(rs, ref, rtol=1e-12)
+
+
+def test_block_dia_kernel_component_path(monkeypatch):
+    """The Pallas DIA kernel serves block planes as per-component
+    dispatches under the interpreter."""
+    from amgx_tpu.ops import pallas_spmv
+    monkeypatch.setattr(pallas_spmv, "_INTERPRET", True)
+    bsr = _banded_block(256, 3, seed=7)
+    Ad = pack_device(bsr, 3, np.float32)
+    assert pack_kind(Ad) == "dia/block"
+    _parity(Ad, bsr, tol=5e-5)
+
+
+def test_block_dia_bf16_planes():
+    import jax.numpy as jnp
+    bsr = _banded_block(100, 3, seed=5)
+    Ad = pack_device(bsr, 3, np.float32)
+    from amgx_tpu.core import precision
+    assert precision.narrowable_pack(Ad)
+    Adb = Ad.astype(jnp.bfloat16)
+    x = np.random.default_rng(7).standard_normal(
+        bsr.shape[1]).astype(np.float32)
+    y = spmv(Adb, jnp.asarray(x))
+    assert y.dtype == jnp.float32      # f32 Krylov vectors stay f32
+    _parity(Adb, bsr, tol=0.03)
+
+
+def test_block_dia_gate_falls_to_binned_or_gather():
+    """A scattered block matrix exceeds the block-diagonal budget and
+    must NOT pack dia/block."""
+    bsr = _scattered_block(150, 3, density=0.05, seed=19)
+    Ad = pack_device(bsr, 3, np.float32)
+    assert Ad.fmt != "dia"
+
+
+# ------------------------------------------------- gather fallback fix
+def test_block_gather_chunked_matches_single_shot(monkeypatch):
+    """The per-K-chunk contraction (the (n, K, b) gather OOM fix) is
+    exact vs the single-shot einsum."""
+    import importlib
+
+    import jax.numpy as jnp
+    spmv_mod = importlib.import_module("amgx_tpu.ops.spmv")
+    bsr = _scattered_block(120, 4, density=0.05, seed=23)
+    # no interpret, f64: the pack keeps plain gather form on CPU
+    monkeypatch.setattr(pallas_csr, "_INTERPRET", False)
+    Ad = pack_device(bsr, 4, np.float64, dia_max_diags=0)
+    assert pack_kind(Ad) == "ell/gather"
+    x = np.random.default_rng(3).standard_normal(bsr.shape[1])
+    y1 = np.asarray(spmv(Ad, jnp.asarray(x)))
+    monkeypatch.setattr(spmv_mod, "_BLOCK_GATHER_ELEMS",
+                        Ad.n_rows * 4 * 2)    # force K-chunking
+    y2 = np.asarray(spmv(Ad, jnp.asarray(x)))
+    np.testing.assert_allclose(y2, y1, rtol=0, atol=1e-12)
+    ref = sp.csr_matrix(bsr) @ x
+    np.testing.assert_allclose(y2, ref, rtol=1e-12, atol=1e-12)
+
+
+# ------------------------------------------------------ DILU / GS
+def test_block_dilu_device_host_factor_parity():
+    from amgx_tpu.coloring import color_matrix
+    from amgx_tpu.solvers.dilu import (_block_dilu_factor,
+                                       _block_dilu_factor_device)
+    A4 = sp.kron(poisson7pt(6, 6, 6), sp.identity(4)).tocsr()
+    A4 = A4 + sp.kron(sp.identity(216),
+                      np.random.default_rng(1).standard_normal(
+                          (4, 4)) * 0.1)
+    m = amgx.Matrix(sp.csr_matrix(A4), block_dim=4)
+    cfg = amgx.AMGConfig("config_version=2, solver(s)=MULTICOLOR_DILU")
+    col = color_matrix(m, cfg, "s")
+    bsr = sp.bsr_matrix(sp.csr_matrix(A4), blocksize=(4, 4))
+    Lh, Uh, Eh = _block_dilu_factor(bsr, col.colors, 4)
+    Ld, Ud, Ed = _block_dilu_factor_device(bsr, col.colors, 4)
+    np.testing.assert_allclose(np.asarray(Ed), Eh, rtol=1e-10,
+                               atol=1e-12)
+    assert (sp.csr_matrix(Lh) != sp.csr_matrix(Ld)).nnz == 0
+    assert (sp.csr_matrix(Uh) != sp.csr_matrix(Ud)).nnz == 0
+
+
+def test_block_dilu_device_factor_singular_guard():
+    """A structurally singular E block takes E⁻¹ = I on both paths."""
+    from amgx_tpu.solvers.dilu import (_block_dilu_factor,
+                                       _block_dilu_factor_device)
+    n, b = 6, 2
+    blocks = np.tile(np.eye(b) * 2.0, (n, 1, 1))
+    blocks[2] = 0.0                       # singular diagonal block
+    bsr = sp.bsr_matrix((blocks, np.arange(n), np.arange(n + 1)),
+                        shape=(n * b, n * b))
+    colors = np.zeros(n, dtype=np.int32)
+    _, _, Eh = _block_dilu_factor(bsr, colors, b)
+    _, _, Ed = _block_dilu_factor_device(bsr, colors, b)
+    np.testing.assert_allclose(np.asarray(Ed), Eh, rtol=1e-12)
+    np.testing.assert_allclose(Eh[2], np.eye(b))
+
+
+def test_block_dilu_solver_uses_device_factor(monkeypatch):
+    """Above the size gate, MULTICOLOR_DILU block setup routes through
+    the device factorisation (and still converges)."""
+    from amgx_tpu.solvers import dilu as dilu_mod
+    monkeypatch.setattr(dilu_mod, "_DILU_DEVICE_MIN_ROWS", 1)
+    called = {}
+    orig = dilu_mod._block_dilu_factor_device
+
+    def spy(*a, **k):
+        called["yes"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(dilu_mod, "_block_dilu_factor_device", spy)
+    A4 = sp.kron(poisson7pt(6, 6, 6), sp.identity(4)).tocsr()
+    m = amgx.Matrix(A4, block_dim=4)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver(out)=PBICGSTAB, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(pre)=MULTICOLOR_DILU, pre:max_iters=1"))
+    slv.setup(m)
+    assert called.get("yes")
+    b = np.ones(A4.shape[0])
+    res = slv.solve(b)
+    x = np.asarray(res.x, np.float64)
+    assert np.linalg.norm(b - A4 @ x) / np.linalg.norm(b) < 1e-7
+
+
+def test_block_gs_bf16_slabs_accumulate_f32():
+    """Block GS slab sweep on a bf16-stored pack: the einsum floors
+    accumulation at f32 (the sweep still reduces the residual)."""
+    import jax.numpy as jnp
+    A = sp.kron(poisson5pt(8, 8), sp.identity(3)).tocsr()
+    m = amgx.Matrix(A, block_dim=3)
+    m.device_dtype = np.float32
+    slv = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver(s)=MULTICOLOR_GS, s:max_iters=4, "
+        "s:monitor_residual=0"))
+    slv.setup(m)
+    # narrow the slabs to bf16 in place (what a bf16 hierarchy stores)
+    for s in slv.color_slabs:
+        s.vals = s.vals.astype(jnp.bfloat16)
+    b = np.ones(A.shape[0], np.float32)
+    x = np.asarray(slv.apply_smoother(b)
+                   if hasattr(slv, "apply_smoother")
+                   else slv.solve(b).x, np.float64)
+    r = np.linalg.norm(b - A @ x) / np.linalg.norm(b)
+    assert np.isfinite(r) and r < 1.0
+
+
+# ------------------------------------------------- resetup / hierarchy
+def test_block_hierarchy_values_only_resetup_zero_retrace():
+    """Values-only resetup of a BLOCK AMG hierarchy stays
+    zero-retrace/zero-recompile (jax.monitoring counters) and refreshed
+    values land in the packs."""
+    from amgx_tpu import telemetry
+    from amgx_tpu.solvers.base import SolveStatus
+    A = sp.kron(poisson7pt(6, 6, 6), sp.identity(3)).tocsr() \
+        + sp.kron(sp.identity(216), np.eye(3) * 0.1)
+    A = sp.csr_matrix(A)
+    m = amgx.Matrix(A, block_dim=3)
+    m.device_dtype = np.float32
+    cfg = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=200, "
+        "out:monitor_residual=1, out:tolerance=1e-6, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=SIZE_2, "
+        "amg:max_iters=1, amg:max_levels=6, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:presweeps=1, amg:postsweeps=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, "
+        "amg:structure_reuse_levels=-1")
+    slv = amgx.create_solver(cfg)
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    x0 = np.asarray(slv.solve(b).x, np.float64)
+    bsr0 = sp.bsr_matrix(A, blocksize=(3, 3))
+    bsr0.sort_indices()
+
+    def refreshed(scale):
+        m2 = amgx.Matrix(A, block_dim=3)
+        m2.device_dtype = np.float32
+        # BSR-ordered coefficient replacement (the block
+        # replace_coefficients contract: data reshapes to (-1, b, b))
+        m2.replace_coefficients(bsr0.data * scale)
+        return m2
+
+    slv.resetup(refreshed(2.0))        # warm: refresh fns trace once
+    slv.solve(b)
+    with telemetry.capture() as cap:
+        slv.resetup(refreshed(3.0))
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    x = np.asarray(res.x, np.float64)
+    np.testing.assert_allclose(x, x0 / 3.0, rtol=1e-4, atol=1e-8)
+
+
+def test_block_hierarchy_bf16_narrowing():
+    """hierarchy_dtype=bfloat16 narrows BLOCK level packs (dia/block +
+    block ELL are narrowable now) and the solve still converges — incl.
+    the block dinv inversion at the f32 compute floor."""
+    from amgx_tpu.solvers.base import SolveStatus
+    A = sp.csr_matrix(sp.kron(poisson7pt(6, 6, 6),
+                              np.eye(3) * 2 + np.ones((3, 3)) * 0.2))
+    m = amgx.Matrix(A, block_dim=3)
+    m.device_dtype = np.float32
+    slv = amgx.create_solver(amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG, out:max_iters=200, "
+        "out:monitor_residual=1, out:tolerance=1e-6, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=AGGREGATION, amg:selector=SIZE_2, "
+        "amg:max_iters=1, amg:max_levels=6, "
+        "amg:smoother(sm)=BLOCK_JACOBI, sm:max_iters=1, "
+        "amg:presweeps=1, amg:postsweeps=1, amg:min_coarse_rows=16, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, "
+        "amg:hierarchy_dtype=bfloat16"))
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    x = np.asarray(res.x, np.float64)
+    assert np.linalg.norm(b - A @ x) / np.linalg.norm(b) < 1e-5
+    hier = slv.preconditioner.hierarchy
+    narrowed = [np.dtype(lvl.Ad.dtype) == BF16 for lvl in hier.levels]
+    assert all(narrowed), narrowed
+
+
+# --------------------------------------------------------- cost model
+def test_costmodel_block_native_vs_expansion_index_bytes():
+    """Block-native descriptors charge index bytes PER BLOCK: the
+    native pack's bytes_per_apply must undercut the scalar expansion's
+    on the same operator (satellite: no more b²× index over-counting)."""
+    from amgx_tpu.telemetry import costmodel
+    bsr = _scattered_block(200, 4, seed=29)
+    nnz_sc = bsr.nnz          # scipy BSR .nnz already counts scalars
+    Adn = pack_device(bsr, 4, np.float32, dia_max_diags=0)
+    Ade = pack_device(bsr, 4, np.float32, dia_max_diags=0,
+                      block_native=False)
+    cn = costmodel.spmv_cost(Adn, nnz=nnz_sc)
+    ce = costmodel.spmv_cost(Ade, nnz=nnz_sc)
+    assert cn["block_dim"] == 4
+    assert cn["flops_per_apply"] == ce["flops_per_apply"] == 2 * nnz_sc
+    assert cn["bytes_per_apply"] < ce["bytes_per_apply"]
+
+
+def test_costmodel_block_dia_descriptor():
+    from amgx_tpu.telemetry import costmodel
+    bsr = _banded_block(100, 3, seed=31)
+    Ad = pack_device(bsr, 3, np.float32)
+    c = costmodel.spmv_cost(Ad, nnz=bsr.nnz)
+    nd = Ad.ell_width
+    assert c["bytes_per_apply"] == (nd * 9 + 6) * Ad.n_rows * 4
+    assert c["padding_waste"] >= 1.0
+
+
+# ------------------------------------------------------ matrix market
+def test_mm_read_block_dim_reblocks(tmp_path):
+    from amgx_tpu.io.matrix_market import (read_matrix_market,
+                                           write_matrix_market)
+    bsr = _scattered_block(40, 3, seed=37)
+    path = str(tmp_path / "b3.mtx")
+    write_matrix_market(path, sp.csr_matrix(bsr))
+    sysd = read_matrix_market(path, block_dim=3)
+    assert sysd.block_dim == 3
+    assert isinstance(sysd.A, sp.bsr_matrix)
+    assert sysd.A.blocksize == (3, 3)
+    assert (sp.csr_matrix(sysd.A) != sp.csr_matrix(bsr)).nnz == 0
+
+
+def test_mm_read_block_dim_divisibility_error(tmp_path):
+    from amgx_tpu.errors import IOError_
+    from amgx_tpu.io.matrix_market import (read_matrix_market,
+                                           write_matrix_market)
+    A = sp.random(10, 10, density=0.3, random_state=1, format="csr") \
+        + sp.identity(10)
+    path = str(tmp_path / "odd.mtx")
+    write_matrix_market(path, sp.csr_matrix(A))
+    with pytest.raises(IOError_) as ei:
+        read_matrix_market(path, block_dim=3)
+    msg = str(ei.value)
+    assert "10 % 3 = 1" in msg and "re-block" in msg
+
+
+def test_mm_read_block_dim_conflict_error(tmp_path):
+    from amgx_tpu.errors import IOError_
+    from amgx_tpu.io.matrix_market import (read_matrix_market,
+                                           write_matrix_market)
+    A = sp.identity(8, format="csr") * 2.0
+    path = str(tmp_path / "declared.mtx")
+    write_matrix_market(path, A, block_dim=2)   # file declares 2x2
+    with pytest.raises(IOError_, match="conflicts"):
+        read_matrix_market(path, block_dim=4)
+    # matching explicit block_dim is fine
+    sysd = read_matrix_market(path, block_dim=2)
+    assert sysd.block_dim == 2
+
+
+# ---------------------------------------------------------- gauntlet
+def test_gauntlet_cases_solve_and_converge(tmp_path):
+    """Every gauntlet case loads through the MatrixMarket round trip
+    as a TRUE block system and converges under its matched config."""
+    from amgx_tpu.io.gauntlet import gauntlet_cases, \
+        load_via_matrix_market
+    for case in gauntlet_cases(scale=0.4):
+        sysd, _ = load_via_matrix_market(case, str(tmp_path))
+        assert isinstance(sysd.A, sp.bsr_matrix)
+        assert sysd.A.blocksize == (case.block_dim,) * 2
+        m = amgx.Matrix(sysd.A, block_dim=case.block_dim)
+        slv = amgx.create_solver(amgx.AMGConfig(case.cfg))
+        slv.setup(m)
+        b = np.ones(m.shape[0])
+        res = slv.solve(b)
+        x = np.asarray(res.x, np.float64)
+        rr = np.linalg.norm(b - sp.csr_matrix(sysd.A) @ x) \
+            / np.linalg.norm(b)
+        assert rr < 1e-6, f"{case.name}: relres {rr}"

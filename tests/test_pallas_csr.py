@@ -78,15 +78,34 @@ def test_matrixmarket_loaded_parity(tmp_path):
     _check(sysd.A, tol=5e-5)
 
 
-def test_block_matrix_scalar_expansion():
-    # b×b blocks ride the kernel through their scalar expansion — the
-    # BiCGStab+DILU block-coupled config's SpMV class
+def test_block_matrix_native_pack():
+    # b×b blocks ride the kernel BLOCK-natively (ISSUE 15): one code
+    # per block, (b², L) component planes, bn dims carry BLOCK shapes
     base = _scattered(400, 400, 0.015, 7)
     A4 = sp.kron(base, np.arange(1, 17).reshape(4, 4) / 10.0).tocsr()
     Ad = _check(A4, block_dim=4, seed=3)
     assert Ad.block_dim == 4
-    # bn dims carry the SCALAR shapes
+    assert pallas_csr.bn_block_dim(Ad.bn_dims) == 4
+    assert Ad.bn_dims[7] == 400 and Ad.bn_dims[8] == 400
+
+
+def test_block_matrix_scalar_expansion_knob():
+    # the PR-1 scalar expansion stays available behind the A/B knob —
+    # bn dims then carry the SCALAR shapes
+    import jax.numpy as jnp
+    base = _scattered(400, 400, 0.015, 7)
+    A4 = sp.kron(base, np.arange(1, 17).reshape(4, 4) / 10.0).tocsr()
+    from amgx_tpu.core.matrix import pack_device as _pd
+    Ad = _pd(sp.csr_matrix(A4), 4, np.float32, dia_max_diags=0,
+             block_native=False)
+    assert Ad.bn_codes is not None
+    assert pallas_csr.bn_block_dim(Ad.bn_dims) == 1
     assert Ad.bn_dims[7] == 1600 and Ad.bn_dims[8] == 1600
+    x = np.random.default_rng(3).standard_normal(1600).astype(
+        np.float32)
+    y = np.asarray(spmv(Ad, jnp.asarray(x)))
+    ref = A4.astype(np.float64) @ x.astype(np.float64)
+    assert np.abs(y - ref).max() / max(np.abs(ref).max(), 1.0) < 5e-5
 
 
 def test_wide_rows_csr_fmt():
