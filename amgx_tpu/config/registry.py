@@ -96,8 +96,10 @@ _SOLVER_VALUES = (
     "IDR", "IDRMSYNC", "JACOBI_L1", "BLOCK_JACOBI", "CF_JACOBI", "GS",
     "MULTICOLOR_GS", "FIXCOLOR_GS", "MULTICOLOR_ILU", "MULTICOLOR_DILU",
     "KACZMARZ", "CHEBYSHEV", "CHEBYSHEV_POLY", "POLYNOMIAL", "KPZ_POLYNOMIAL",
-    "DENSE_LU_SOLVER", "NOSOLVER",
+    "DENSE_LU_SOLVER", "NOSOLVER", "PCG_CA", "PCG_PIPE",
 )
+
+_KRYLOV_COMM = ("CLASSIC", "CA", "PIPELINED")
 
 
 def register_default_parameters():
@@ -146,6 +148,17 @@ def register_default_parameters():
     R("gmres_n_restart", int, 20, "Krylov vectors in (F)GMRES")
     R("gmres_krylov_dim", int, 0, "max Krylov dim (0: = restart)")
     R("subspace_dim_s", int, 8, "IDR subspace dim")
+    R("krylov_comm", str, "CLASSIC",
+      "Krylov communication mode: CLASSIC (two blocking reductions per CG "
+      "iter), CA (Chronopoulos-Gear single-reduction), PIPELINED "
+      "(Ghysels-Vanroose, reduction overlapped with SpMV+precond)",
+      _KRYLOV_COMM)
+    R("ca_residual_replace", int, 10,
+      "iterations between true-residual replacement in CA/pipelined CG "
+      "(0 disables; drift must never fake convergence — pipelined "
+      "recurrence drift on jumpy-coefficient operators exceeds 1e-4 "
+      "within ~15 iters, so the default must fire inside a typical "
+      "AMG-preconditioned solve)", None, (0, 1 << 30))
     # --- direct/smoother params (core.cu:418-439)
     R("dense_lu_num_rows", int, 128)
     R("dense_lu_max_rows", int, 0)

@@ -5,8 +5,18 @@ fill) and ``base/src/norm.cu`` (L1/L2/LMAX block norms).  In JAX these are
 one-liners that XLA fuses into surrounding computations; they exist as named
 functions so solver code reads like the reference and so the distributed
 layer can swap in psum-reduced variants.
+
+The psum-reduced variants live here too: :func:`fused_reduce` stacks all of
+an iteration's dot/norm accumulators into ONE reduction so GSPMD inserts a
+single all-reduce per Krylov iteration instead of one per scalar, and the
+:class:`CollectiveLedger` counts, at trace time, how many distinct
+reductions a region of solver code performs (each ``dot``/``norm`` on a
+sharded vector lowers to its own psum; the ledger is the host-side truth
+behind the ``amgx_krylov_collectives_total`` counters).
 """
 from __future__ import annotations
+
+import contextlib
 
 import jax
 import jax.numpy as jnp
@@ -15,6 +25,81 @@ NORM_L1 = "L1"
 NORM_L2 = "L2"
 NORM_LMAX = "LMAX"
 NORM_L1_SCALED = "L1_SCALED"
+
+
+# --------------------------------------------------------------------------
+# collective ledger — trace-time accounting of reduction ops
+# --------------------------------------------------------------------------
+
+class CollectiveLedger:
+    """Counts reduction ops issued while a :func:`count_collectives` scope
+    is active.  Keys are op labels ("dot", "norm", "fused", "gram"); the
+    ``replace`` bucket holds reductions inside a residual-replacement branch
+    (they run every ``ca_residual_replace`` iters, not every iter).
+
+    Counting happens while solver code is *traced*, so one traced iteration
+    body yields the steady-state per-iteration reduction profile.  On a
+    sharded vector each counted op lowers to exactly one GSPMD all-reduce.
+    """
+
+    def __init__(self):
+        self.counts: dict = {}
+        self.replace: dict = {}
+
+    def reset(self):
+        self.counts.clear()
+        self.replace.clear()
+
+    def total(self) -> int:
+        return int(sum(self.counts.values()))
+
+
+_LEDGER: CollectiveLedger | None = None
+_BUCKET = "counts"
+
+
+def _record(op: str) -> None:
+    if _LEDGER is not None:
+        d = getattr(_LEDGER, _BUCKET)
+        d[op] = d.get(op, 0) + 1
+
+
+@contextlib.contextmanager
+def count_collectives(ledger: CollectiveLedger):
+    """Route reduction-op records into ``ledger`` for the duration."""
+    global _LEDGER, _BUCKET
+    prev, prev_bucket = _LEDGER, _BUCKET
+    _LEDGER, _BUCKET = ledger, "counts"
+    try:
+        yield ledger
+    finally:
+        _LEDGER, _BUCKET = prev, prev_bucket
+
+
+@contextlib.contextmanager
+def replacement_scope():
+    """Records inside this scope land in the ledger's ``replace`` bucket —
+    used around the periodic true-residual recomputation so the amortised
+    cost is accounted separately from the steady-state per-iter profile."""
+    global _BUCKET
+    prev = _BUCKET
+    _BUCKET = "replace"
+    try:
+        yield
+    finally:
+        _BUCKET = prev
+
+
+@contextlib.contextmanager
+def uncounted():
+    """Suppress ledger recording (e.g. host-side diagnostics)."""
+    global _LEDGER
+    prev = _LEDGER
+    _LEDGER = None
+    try:
+        yield
+    finally:
+        _LEDGER = prev
 
 
 def axpy(y, x, alpha):
@@ -32,22 +117,30 @@ def axmb(a_x, b):
     return b - a_x
 
 
-def dot(x, y):
-    """Conjugated dot product (reference ``dotc``)."""
+def _dot_raw(x, y):
     if jnp.iscomplexobj(x):
         return jnp.vdot(x, y)
     return jnp.dot(x, y)
 
 
+def dot(x, y):
+    """Conjugated dot product (reference ``dotc``)."""
+    _record("dot")
+    return _dot_raw(x, y)
+
+
 def nrm2(x):
-    return jnp.sqrt(jnp.real(dot(x, x)))
+    _record("norm")
+    return jnp.sqrt(jnp.real(_dot_raw(x, x)))
 
 
 def nrm1(x):
+    _record("norm")
     return jnp.sum(jnp.abs(x))
 
 
 def nrmmax(x):
+    _record("norm")
     return jnp.max(jnp.abs(x))
 
 
@@ -72,6 +165,7 @@ def norm(v: jax.Array, norm_type: str = NORM_L2, block_dim: int = 1,
         if norm_type == NORM_LMAX:
             return nrmmax(v)
         return nrm2(v)
+    _record("norm")
     vb = v.reshape(-1, block_dim)
     if norm_type == NORM_L1 or norm_type == NORM_L1_SCALED:
         r = jnp.sum(jnp.abs(vb), axis=0)
@@ -81,3 +175,84 @@ def norm(v: jax.Array, norm_type: str = NORM_L2, block_dim: int = 1,
     if norm_type == NORM_LMAX:
         return jnp.max(jnp.abs(vb), axis=0)
     return jnp.sqrt(jnp.sum(jnp.abs(vb) ** 2, axis=0))
+
+
+# --------------------------------------------------------------------------
+# fused reductions — one collective for a whole iteration's scalars
+# --------------------------------------------------------------------------
+
+def fused_reduce(terms):
+    """Reduce several same-length term vectors in ONE stacked sum.
+
+    ``terms`` is a sequence of (n,) elementwise product vectors (e.g.
+    ``conj(r)*u``); the result is a (k,) array of their sums.  Stacking
+    first means XLA sees a single (k, n)→(k,) reduction, so GSPMD inserts
+    exactly one all-reduce on sharded inputs — the communication-avoiding
+    contract: every scalar the iteration needs rides the same psum.
+    """
+    _record("fused")
+    return jnp.sum(jnp.stack(terms), axis=-1)
+
+
+def norm_terms(v, norm_type: str = NORM_L2, block_dim: int = 1,
+               use_scalar_norm: bool = True):
+    """Elementwise accumulator vectors for :func:`norm`, suitable for
+    :func:`fused_reduce`.  Returns a list of (n,) term vectors, or ``None``
+    when the norm is not expressible as a sum (LMAX needs a max-reduce and
+    cannot share the fused psum).
+
+    Scalar norms yield one term; block norms yield ``block_dim`` masked
+    terms (component c's magnitudes, zero elsewhere) so the per-component
+    accumulators still travel in the single stacked reduction.
+    """
+    if norm_type == NORM_LMAX:
+        return None
+    if norm_type == NORM_L2:
+        base = jnp.abs(v) ** 2
+    else:
+        base = jnp.abs(v)
+    if use_scalar_norm or block_dim == 1:
+        return [base]
+    comp = jnp.arange(v.shape[0]) % block_dim
+    return [jnp.where(comp == c, base, 0.0) for c in range(block_dim)]
+
+
+def finish_norm(acc, norm_type: str, n_rows: int, block_dim: int = 1,
+                use_scalar_norm: bool = True):
+    """Turn reduced :func:`norm_terms` accumulators back into the value
+    :func:`norm` would return.  ``acc`` is the (1,) or (block_dim,) slice of
+    a :func:`fused_reduce` result; ``n_rows`` is the vector length."""
+    acc = jnp.real(acc)
+    scalar = use_scalar_norm or block_dim == 1
+    r = acc[0] if scalar else acc
+    if norm_type == NORM_L2:
+        return jnp.sqrt(r)
+    if norm_type == NORM_L1_SCALED:
+        return r / (n_rows if scalar else n_rows // block_dim)
+    return r
+
+
+def gram_dots(V, w, row_ok):
+    """Masked Gram–Schmidt projections ``h = (conj(V) @ w) * row_ok``.
+
+    One matmul → one collective on sharded columns; the mask keeps rows
+    beyond the current Arnoldi column inert.
+    """
+    _record("gram")
+    return (jnp.conj(V) @ w) * row_ok
+
+
+def gram_dots_with_norm(V, w, row_ok):
+    """Fused Gram–Schmidt pass: projections of ``w`` onto the rows of ``V``
+    *and* ``‖w‖²`` from the same stacked matmul.
+
+    Returns ``(h, ww)`` where ``h = (conj(V)@w)*row_ok`` and
+    ``ww = ‖w‖²``.  Appending ``conj(w)`` as an extra row makes the norm
+    accumulator ride the projection matmul's single reduction — this is
+    what turns the CGS2 second pass + normalisation (two collectives) into
+    one.
+    """
+    _record("fused")
+    stacked = jnp.concatenate([jnp.conj(V), jnp.conj(w)[None, :]], axis=0)
+    out = stacked @ w
+    return out[:-1] * row_ok, jnp.real(out[-1])

@@ -202,6 +202,13 @@ class Solver:
         self.print_solve_stats = bool(g("print_solve_stats"))
         self.obtain_timings = bool(g("obtain_timings"))
         self.relaxation_factor = float(g("relaxation_factor"))
+        # communication-avoiding Krylov (ops/blas.py): the knob picks
+        # the reduction layout (CLASSIC / CA / PIPELINED); the ledger
+        # counts, at trace time, the reduction ops one iteration body
+        # performs — the truth behind amgx_krylov_collectives_total
+        self.krylov_comm = str(g("krylov_comm"))
+        self.ca_residual_replace = int(g("ca_residual_replace"))
+        self._collective_ledger = blas.CollectiveLedger()
         # structured telemetry (amgx_tpu/telemetry/): the knob enables
         # the process-global recorder; keeping the residual history is
         # what makes per-iteration residual records available post-solve
@@ -576,11 +583,17 @@ class Solver:
         fault = faultinject.trace_mode()
         if fault != getattr(self, "_fault_trace", None):
             self._fault_trace = fault
-            self._solve_fn = None
-            self._refined_fn = None
-            self._solve_multi = None
-            self._solve_multi_refined = None
+            self._invalidate_solve_fns()
         return fault
+
+    def _invalidate_solve_fns(self):
+        """Drop every cached jitted solve body — anything compiled INTO
+        the loop (fault points, the krylov_comm reduction layout) must
+        call this when it changes."""
+        self._solve_fn = None
+        self._refined_fn = None
+        self._solve_multi = None
+        self._solve_multi_refined = None
 
     def _tolerance_floor(self, dtype) -> float:
         """Smallest relative residual honestly reachable in ``dtype``
@@ -1431,8 +1444,52 @@ class Solver:
                 for i, row in enumerate(np.atleast_2d(history)):
                     telemetry.event("residual", iteration=i,
                                     norm=float(np.max(row)))
+        self._emit_krylov_comm_telemetry(iters)
         if self.telemetry_path:
             telemetry.flush_jsonl(self.telemetry_path)
+
+    def _emit_krylov_comm_telemetry(self, iters: int):
+        """Per-solve communication accounting: the trace-time reduction
+        profile (ops/blas.py ledger) scaled by executed iterations.  The
+        counters are the measured truth ISSUE 16 gates on; the event
+        additionally carries the modelled SpMV-vs-reduction split the
+        doctor's latency-bound hint keys on.  Silent when the loop body
+        was never traced this session (pure AOT-load path)."""
+        led = getattr(self, "_collective_ledger", None)
+        if led is None or not led.counts:
+            return
+        prof = {op: int(c) for op, c in led.counts.items()}
+        iters = max(int(iters), 0)
+        for op, c in prof.items():
+            if iters > 0:
+                telemetry.counter_inc("amgx_krylov_collectives_total",
+                                      float(c * iters), op=op)
+        rep = int(getattr(self, "ca_residual_replace", 0) or 0)
+        n_rep = (iters - 1) // rep if (rep > 0 and iters > 1
+                                       and led.replace) else 0
+        if n_rep > 0:
+            telemetry.counter_inc(
+                "amgx_krylov_collectives_total",
+                float(sum(led.replace.values()) * n_rep), op="replace")
+        mode = (self._comm_mode() if hasattr(self, "_comm_mode")
+                else "CLASSIC")
+        ev = {
+            "solver": self.config_name,
+            "mode": mode,
+            "iterations": iters,
+            "per_iter": prof,
+            "collectives_per_iter": int(sum(prof.values())),
+            "fused": bool("fused" in prof),
+        }
+        model = telemetry.costmodel.krylov_reduction_cost(
+            self.Ad, ev["collectives_per_iter"]) \
+            if self.Ad is not None else None
+        if model is not None:
+            ev.update(model)
+        else:
+            ev["n_parts"] = int(getattr(self.Ad, "n_parts", 1) or 1) \
+                if self.Ad is not None else 1
+        telemetry.event("krylov_comm", **ev)
 
     def _host_norm(self, v: np.ndarray):
         """Numpy twin of ops.blas.norm — outer refinement norms must match
@@ -1783,6 +1840,7 @@ class Solver:
         # krylov_zero point mutates the iteration state at one target
         # iteration (solve() invalidates this body on arming changes)
         fault = getattr(self, "_fault_trace", None)
+        ledger = self._collective_ledger
 
         def solve_fn(b, x0, tol, it_limit):
             r0 = b - spmv(self.Ad, x0)
@@ -1800,13 +1858,21 @@ class Solver:
 
             def body(carry):
                 x, state, it, nrm, nmax, done, brk, bad_it, hist = carry
-                x, state = self.solve_iteration(b, x, state, it)
-                if fault is not None:
-                    x, state = _inject_fault(fault, it, x, state)
+                # collective ledger: this body traces ONCE per compile,
+                # so resetting here and counting through the iteration +
+                # monitor estimate yields the steady-state per-iteration
+                # reduction profile (host-side; adds nothing to the jaxpr)
+                ledger.reset()
+                with blas.count_collectives(ledger):
+                    x, state = self.solve_iteration(b, x, state, it)
+                    if fault is not None:
+                        x, state = _inject_fault(fault, it, x, state)
+                    est = None
+                    if monitor:
+                        est = self.residual_norm_estimate(b, x, state)
+                        if est is None:
+                            est = self.compute_residual_norm(b, x)
                 if monitor:
-                    est = self.residual_norm_estimate(b, x, state)
-                    if est is None:
-                        est = self.compute_residual_norm(b, x)
                     nrm = jnp.atleast_1d(est)
                     # device-side breakdown flag: the solver's in-loop
                     # guards (CG pAp/rho) carry a code in their state;

@@ -87,11 +87,58 @@ class _CGState(NamedTuple):
     brk: jax.Array      # int32 breakdown code (errors.BREAKDOWN_*)
 
 
+class _CACGState(NamedTuple):
+    """Chronopoulos–Gear single-reduction CG state.  Invariants:
+    u = M·r, w = A·u, s = A·p; gamma = (r,u), delta = (w,u) for the
+    CURRENT vectors (the fused reduction runs at the end of the
+    iteration, so the carried scalars are always up to date)."""
+    r: jax.Array
+    u: jax.Array
+    w: jax.Array
+    p: jax.Array
+    s: jax.Array
+    gamma: jax.Array        # (r, u) of current state
+    gamma_prev: jax.Array   # previous gamma (for beta)
+    delta: jax.Array        # (w, u) of current state
+    alpha_prev: jax.Array   # previous step length (for the alpha recurrence)
+    rr: jax.Array           # raw norm accumulators of current r, (k,) real
+    brk: jax.Array          # int32 breakdown code (errors.BREAKDOWN_*)
+
+
+class _PipeCGState(NamedTuple):
+    """Ghysels–Vanroose pipelined CG state.  Extra auxiliaries keep
+    q = M·s and z = A·q so the single fused reduction at the TOP of an
+    iteration is independent of the m = M·w / n = A·m applications that
+    follow — XLA overlaps the collective with the SpMV + precond.  The
+    carried ``rr`` is therefore the norm of the INCOMING residual (lags
+    one iteration — the documented price of the overlap)."""
+    r: jax.Array
+    u: jax.Array
+    w: jax.Array
+    p: jax.Array
+    s: jax.Array
+    q: jax.Array
+    z: jax.Array
+    gamma_prev: jax.Array
+    alpha_prev: jax.Array
+    rr: jax.Array
+    brk: jax.Array
+
+
 @register_solver("CG")
 class CGSolver(Solver):
-    """Plain conjugate gradient (reference ``cg_solver.cu``)."""
+    """Plain conjugate gradient (reference ``cg_solver.cu``).
+
+    The ``krylov_comm`` knob (or a ``forced_comm`` subclass override)
+    selects the communication variant: CLASSIC (two blocking reductions
+    per iteration), CA (Chronopoulos–Gear, ONE fused reduction at the end
+    of the iteration) or PIPELINED (Ghysels–Vanroose, one fused reduction
+    overlapped with the next SpMV + preconditioner apply).  Both CA modes
+    recompute the TRUE residual every ``ca_residual_replace`` iterations
+    so recurrence drift never fakes convergence."""
 
     use_preconditioner = False
+    forced_comm: Optional[str] = None
 
     def solver_setup(self):
         if getattr(self, "use_preconditioner", False):
@@ -100,14 +147,56 @@ class CGSolver(Solver):
     def _M(self, r):
         return r
 
-    def solve_init(self, b, x):
-        r = b - spmv(self.Ad, x)
-        z = self._M(r)
-        rz = blas.dot(r, z)
-        return _CGState(r=r, p=z, rz=rz,
-                        brk=jnp.zeros((), jnp.int32))
+    # ---------------------------------------------- communication mode
+    def _comm_mode(self) -> str:
+        if getattr(self, "_force_krylov_classic", False):
+            return "CLASSIC"        # recovery-ladder CA→CLASSIC fallback
+        mode = self.forced_comm or self.krylov_comm
+        if mode != "CLASSIC" and self.norm_type == blas.NORM_LMAX:
+            # LMAX needs a max-reduce and cannot ride the fused psum
+            return "CLASSIC"
+        return mode
 
+    def _fused_scalars(self, r, u, w):
+        """gamma = (r,u), delta = (w,u) and the monitor-norm accumulators
+        of r, all from ONE stacked reduction."""
+        terms = [jnp.conj(r) * u, jnp.conj(w) * u]
+        terms += blas.norm_terms(r, self.norm_type, self.Ad.block_dim,
+                                 self.use_scalar_norm)
+        acc = blas.fused_reduce(terms)
+        return acc[0], acc[1], jnp.real(acc[2:])
+
+    # ------------------------------------------------------------ init
+    def solve_init(self, b, x):
+        mode = self._comm_mode()
+        if mode == "CLASSIC":
+            r = b - spmv(self.Ad, x)
+            z = self._M(r)
+            rz = blas.dot(r, z)
+            return _CGState(r=r, p=z, rz=rz,
+                            brk=jnp.zeros((), jnp.int32))
+        r = b - spmv(self.Ad, x)
+        u = self._M(r)
+        w = spmv(self.Ad, u)
+        gamma, delta, rr = self._fused_scalars(r, u, w)
+        one = jnp.ones((), gamma.dtype)
+        zero_v = jnp.zeros_like(r)
+        brk = jnp.zeros((), jnp.int32)
+        if mode == "CA":
+            return _CACGState(r=r, u=u, w=w, p=zero_v, s=zero_v,
+                              gamma=gamma, gamma_prev=one, delta=delta,
+                              alpha_prev=one, rr=rr, brk=brk)
+        return _PipeCGState(r=r, u=u, w=w, p=zero_v, s=zero_v,
+                            q=zero_v, z=zero_v, gamma_prev=one,
+                            alpha_prev=one, rr=rr, brk=brk)
+
+    # -------------------------------------------------------- iteration
     def solve_iteration(self, b, x, state, iter_idx):
+        mode = self._comm_mode()
+        if mode == "CA":
+            return self._ca_iteration(b, x, state, iter_idx)
+        if mode == "PIPELINED":
+            return self._pipe_iteration(b, x, state, iter_idx)
         r, p, rz, brk = state
         # breakdown guards: incoming rho collapse / new pAp sign
         # (provisional — the base monitor block validates against the
@@ -124,7 +213,114 @@ class CGSolver(Solver):
         p = z + beta * p
         return x, _CGState(r=r, p=p, rz=rz_new, brk=brk)
 
+    def _cg_scalar_step(self, gamma, gamma_prev, delta, alpha_prev, brk,
+                        iter_idx):
+        """Shared CA/pipelined scalar recurrence:
+        beta_i = gamma_i/gamma_{i-1} (0 at i=0),
+        pAp    = delta_i − beta_i·gamma_i/alpha_{i-1}  (== (p_i, A p_i)),
+        alpha_i = gamma_i/pAp — with the same breakdown guards the
+        classic loop applies to (rho, pAp)."""
+        first = iter_idx == 0
+        beta = jnp.where(
+            first, 0.0,
+            gamma / jnp.where(gamma_prev == 0, 1.0, gamma_prev))
+        pap = delta - beta * gamma \
+            / jnp.where(alpha_prev == 0, 1.0, alpha_prev)
+        # gamma_{i-1} == 0 is a true Krylov breakdown the recurrence
+        # would otherwise divide through (the classic loop sees it as
+        # rho == 0 one iteration earlier) — flag it BEFORE the generic
+        # guard so the code is deterministic under krylov_zero injection
+        brk = jnp.where((brk == 0) & ~first & (gamma_prev == 0),
+                        jnp.asarray(BREAKDOWN_KRYLOV, jnp.int32), brk)
+        brk = _cg_breakdown(brk, gamma, pap)
+        alpha = jnp.where(pap != 0,
+                          gamma / jnp.where(pap == 0, 1.0, pap), 0.0)
+        return beta, alpha, brk
+
+    def _ca_iteration(self, b, x, state, iter_idx):
+        (r, u, w, p, s, gamma, gamma_prev, delta, alpha_prev, rr,
+         brk) = state
+        rep = self.ca_residual_replace
+        if rep > 0:
+            do_rep = (iter_idx > 0) & (jnp.mod(iter_idx, rep) == 0)
+
+            def replace(_):
+                # true-residual replacement: recompute r, u = M r,
+                # w = A u and s = A p from scratch, plus the carried
+                # scalars, so accumulated recurrence drift is flushed
+                with blas.replacement_scope():
+                    r_t = b - spmv(self.Ad, x)
+                    u_t = self._M(r_t)
+                    w_t = spmv(self.Ad, u_t)
+                    s_t = spmv(self.Ad, p)
+                    g_t, d_t, rr_t = self._fused_scalars(r_t, u_t, w_t)
+                return r_t, u_t, w_t, s_t, g_t, d_t, rr_t
+
+            def keep(_):
+                return r, u, w, s, gamma, delta, rr
+
+            r, u, w, s, gamma, delta, rr = \
+                jax.lax.cond(do_rep, replace, keep, None)
+        beta, alpha, brk = self._cg_scalar_step(
+            gamma, gamma_prev, delta, alpha_prev, brk, iter_idx)
+        p = u + beta * p
+        s = w + beta * s        # s = A p by linearity
+        x = x + alpha * p
+        r = r - alpha * s
+        u = self._M(r)
+        w = spmv(self.Ad, u)
+        gamma_new, delta_new, rr_new = self._fused_scalars(r, u, w)
+        return x, _CACGState(r=r, u=u, w=w, p=p, s=s, gamma=gamma_new,
+                             gamma_prev=gamma, delta=delta_new,
+                             alpha_prev=alpha, rr=rr_new, brk=brk)
+
+    def _pipe_iteration(self, b, x, state, iter_idx):
+        (r, u, w, p, s, q, z, gamma_prev, alpha_prev, rr, brk) = state
+        rep = self.ca_residual_replace
+        if rep > 0:
+            do_rep = (iter_idx > 0) & (jnp.mod(iter_idx, rep) == 0)
+
+            def replace(_):
+                with blas.replacement_scope():
+                    r_t = b - spmv(self.Ad, x)
+                    u_t = self._M(r_t)
+                    w_t = spmv(self.Ad, u_t)
+                    s_t = spmv(self.Ad, p)
+                    q_t = self._M(s_t)
+                    z_t = spmv(self.Ad, q_t)
+                return r_t, u_t, w_t, s_t, q_t, z_t
+
+            def keep(_):
+                return r, u, w, s, q, z
+
+            r, u, w, s, q, z = jax.lax.cond(do_rep, replace, keep, None)
+        # ONE fused reduction on the incoming state; m = M·w and
+        # n = A·m below do not depend on it, so the collective's latency
+        # hides behind the precond apply + SpMV
+        gamma, delta, rr_new = self._fused_scalars(r, u, w)
+        m_vec = self._M(w)
+        n_vec = spmv(self.Ad, m_vec)
+        beta, alpha, brk = self._cg_scalar_step(
+            gamma, gamma_prev, delta, alpha_prev, brk, iter_idx)
+        z = n_vec + beta * z    # z = A q
+        q = m_vec + beta * q    # q = M s
+        s = w + beta * s        # s = A p
+        p = u + beta * p
+        x = x + alpha * p
+        r = r - alpha * s
+        u = u - alpha * q
+        w = w - alpha * z
+        return x, _PipeCGState(r=r, u=u, w=w, p=p, s=s, q=q, z=z,
+                               gamma_prev=gamma, alpha_prev=alpha,
+                               rr=rr_new, brk=brk)
+
     def residual_norm_estimate(self, b, x, state):
+        if isinstance(state, (_CACGState, _PipeCGState)):
+            # the fused reduction already carried the norm accumulators —
+            # finishing them is collective-free
+            return blas.finish_norm(state.rr, self.norm_type,
+                                    state.r.shape[0], self.Ad.block_dim,
+                                    self.use_scalar_norm)
         return blas.norm(state.r, self.norm_type, self.Ad.block_dim,
                          self.use_scalar_norm)
 
@@ -137,6 +333,22 @@ class PCGSolver(_PrecondMixin, CGSolver):
 
     def _M(self, r):
         return self._apply_M(r)
+
+
+@register_solver("PCG_CA")
+class PCGCASolver(PCGSolver):
+    """Single-reduction (Chronopoulos–Gear) PCG: ``PCG`` with
+    ``krylov_comm=CA`` baked in."""
+
+    forced_comm = "CA"
+
+
+@register_solver("PCG_PIPE")
+class PCGPipeSolver(PCGSolver):
+    """Pipelined (Ghysels–Vanroose) PCG: ``PCG`` with
+    ``krylov_comm=PIPELINED`` baked in."""
+
+    forced_comm = "PIPELINED"
 
 
 class _PCGFState(NamedTuple):
@@ -281,6 +493,14 @@ class _GMRESBase(Solver):
     def _M(self, r):
         return self._apply_M(r)
 
+    def _comm_mode(self) -> str:
+        """CA/PIPELINED both select the fused Arnoldi pass (the second
+        CGS2 projection and the normalisation norm share one stacked
+        collective); CLASSIC keeps the three reductions per column."""
+        if getattr(self, "_force_krylov_classic", False):
+            return "CLASSIC"
+        return self.krylov_comm
+
     def solve_init(self, b, x):
         m, n = self.restart, b.shape[0]
         dt = b.dtype
@@ -329,8 +549,11 @@ class _GMRESBase(Solver):
         # cost at 256³); stale basis rows are instead neutralised by the
         # row masks on the CGS2 coefficients below.
         def fresh_v0(_):
-            r = b - spmv(self.Ad, x)
-            beta = blas.nrm2(r)
+            # ledger: the restart recompute is amortised over the cycle,
+            # not part of the steady-state per-iteration profile
+            with blas.replacement_scope():
+                r = b - spmv(self.Ad, x)
+                beta = blas.nrm2(r)
             v0 = jnp.where(beta > 0, r / jnp.where(beta == 0, 1, beta), 0.0)
             # g rides in the basis dtype (complex modes store the real
             # |r| as a complex scalar)
@@ -358,12 +581,22 @@ class _GMRESBase(Solver):
         w = spmv(self.Ad, z_j)
         # projections h_i = <v_i, w> are CONJUGATED (complex modes:
         # jnp.conj of a real array is a no-op XLA folds away)
-        h1 = (jnp.conj(state.V) @ w) * row_ok
+        h1 = blas.gram_dots(state.V, w, row_ok)
         w = w - state.V.T @ h1
-        h2 = (jnp.conj(state.V) @ w) * row_ok
-        w = w - state.V.T @ h2
+        if self._comm_mode() != "CLASSIC":
+            # fused Arnoldi: the second CGS2 pass and ‖w‖² ride ONE
+            # stacked matmul (3 → 2 collectives per column); after the
+            # first pass h2 is O(ε)·‖w‖, so the Pythagorean downdate
+            # ‖w − V·h2‖² = ‖w‖² − ‖h2‖² loses no accuracy in practice
+            h2, ww = blas.gram_dots_with_norm(state.V, w, row_ok)
+            w = w - state.V.T @ h2
+            h_next = jnp.sqrt(jnp.maximum(
+                ww - jnp.sum(jnp.abs(h2) ** 2), 0.0))
+        else:
+            h2 = blas.gram_dots(state.V, w, row_ok)
+            w = w - state.V.T @ h2
+            h_next = blas.nrm2(w)
         hcol = h1 + h2              # (m+1,)
-        h_next = blas.nrm2(w)
         V = state.V.at[j + 1].set(
             jnp.where(h_next > 0, w / jnp.where(h_next == 0, 1, h_next), 0.0))
         hcol = hcol.at[j + 1].set(h_next)

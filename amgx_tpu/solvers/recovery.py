@@ -6,6 +6,11 @@ stagnation — see :class:`~amgx_tpu.errors.FailureKind`) and the
 ladder** of increasingly expensive repairs instead of handing the
 caller a dead result:
 
+0. **krylov_classic** — a communication-avoiding (CA/PIPELINED)
+   recurrence that broke down falls back to the CLASSIC reduction
+   layout first (PR 16): same operator and hierarchy, only the loop
+   body re-traces — cheaper than any rung below and targeted at the
+   one thing the reordered recurrences changed;
 1. **restart** — re-run the Krylov loop from the last finite iterate
    (a fresh Krylov space sheds the poisoned/collapsed basis; costs one
    more solve, reuses every compiled executable);
@@ -40,8 +45,21 @@ from .. import telemetry
 from ..errors import FailureInfo, FailureKind, SolveStatus
 
 #: ladder rungs, cheapest first — the vocabulary of the
-#: ``recovery_attempt`` event and the amgx_recovery_total action label
-ACTIONS = ("restart", "promote", "conservative", "resetup")
+#: ``recovery_attempt`` event and the amgx_recovery_total action label.
+#: krylov_classic comes before restart: a breakdown in a
+#: communication-avoiding recurrence (PR 16) may be an artifact of the
+#: reordered scalar recurrences rather than the operator — re-running
+#: with the CLASSIC reduction layout reuses every setup product and is
+#: cheaper than burning a restart rung on a possibly-poisoned basis
+ACTIONS = ("krylov_classic", "restart", "promote", "conservative",
+           "resetup")
+
+#: failure kinds the krylov_classic rung can plausibly repair — the
+#: recurrence-sensitive breakdowns; a stagnated or diverged solve is
+#: not a reduction-layout problem
+_KRYLOV_KINDS = (FailureKind.KRYLOV_BREAKDOWN,
+                 FailureKind.INDEFINITE_OPERATOR,
+                 FailureKind.NAN_POISON)
 
 #: smoother knobs swapped by the conservative rung (any non-Jacobi
 #: smoother — Chebyshev with a bad spectrum estimate, an aggressive
@@ -53,7 +71,15 @@ _SAFE_SMOOTHERS = ("BLOCK_JACOBI", "JACOBI_L1", "CF_JACOBI")
 class _Skip(Exception):
     """A rung that cannot apply to this solver/config (no wider rung to
     promote to, already-conservative smoother) — audited as outcome
-    ``skipped``, burns no attempt budget."""
+    ``skipped``, burns no attempt budget.  ``audit=False`` marks a rung
+    that is *structurally absent* for this solver (a CLASSIC-mode solve
+    has no CA fallback rung): it skips silently, so the rung 0
+    krylov_classic check does not prepend a noise event to every
+    recovery of a default-config solver."""
+
+    def __init__(self, msg: str, audit: bool = True):
+        super().__init__(msg)
+        self.audit = audit
 
 
 def _failure_kind(result) -> FailureKind:
@@ -80,6 +106,33 @@ def _finite_start(result, x0):
 
 def _solve_again(solver, b, x0, zero_initial_guess):
     return solver.solve(b, x0=x0, zero_initial_guess=zero_initial_guess)
+
+
+def _act_krylov_classic(solver, b, x0, zero_initial_guess, last):
+    """CA/PIPELINED → CLASSIC fallback: re-run with the two-reduction
+    classic recurrence (same operator, same hierarchy — only the jitted
+    loop body re-traces).  Sticky on success: a recurrence that broke
+    once is not re-trusted; reverted on failure so an unrelated
+    breakdown does not permanently slow the solver down."""
+    mode = solver._comm_mode() if hasattr(solver, "_comm_mode") \
+        else "CLASSIC"
+    if mode == "CLASSIC":
+        raise _Skip("solver already runs the CLASSIC reduction layout",
+                    audit=False)
+    if _failure_kind(last) not in _KRYLOV_KINDS:
+        raise _Skip("failure kind is not a recurrence breakdown")
+    solver._force_krylov_classic = True
+    solver._invalidate_solve_fns()
+    try:
+        res = _solve_again(solver, b, x0, zero_initial_guess)
+    except Exception:
+        solver._force_krylov_classic = False
+        solver._invalidate_solve_fns()
+        raise
+    if res is None or res.status != SolveStatus.SUCCESS:
+        solver._force_krylov_classic = False
+        solver._invalidate_solve_fns()
+    return res
 
 
 def _act_restart(solver, b, x0, zero_initial_guess, last):
@@ -169,7 +222,8 @@ def _act_resetup(solver, b, x0, zero_initial_guess, last):
     return _solve_again(solver, b, x0, zero_initial_guess)
 
 
-_ACTION_FN = {"restart": _act_restart, "promote": _act_promote,
+_ACTION_FN = {"krylov_classic": _act_krylov_classic,
+              "restart": _act_restart, "promote": _act_promote,
               "conservative": _act_conservative,
               "resetup": _act_resetup}
 
@@ -220,9 +274,11 @@ def maybe_recover(solver, b, x0, zero_initial_guess: bool, result):
                                           zero_initial_guess, last)
             except _Skip as sk:
                 # an inapplicable rung burns no budget — audit and
-                # escalate
-                _audit(kind, action, attempt, "skipped", solver,
-                       time.perf_counter() - t0, detail=str(sk))
+                # escalate (unless the rung is structurally absent
+                # for this solver, which skips silently)
+                if getattr(sk, "audit", True):
+                    _audit(kind, action, attempt, "skipped", solver,
+                           time.perf_counter() - t0, detail=str(sk))
                 continue
             except Exception as e:  # noqa: BLE001 — the ladder must
                 # never raise past the solve that invoked it; the

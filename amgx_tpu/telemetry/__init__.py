@@ -39,7 +39,7 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import (costmodel, export, forensics, metrics, recorder,
+from . import (costmodel, export, forensics, metrics, overlap, recorder,
                runstate, setup_profile, slo, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,

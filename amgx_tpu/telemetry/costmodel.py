@@ -37,6 +37,10 @@ HBM_PEAK_GBS = 819.0
 #: per-link ICI bandwidth class of a v5e (one direction, GB/s) — used
 #: for halo-exchange roofline fractions; override per topology
 ICI_PEAK_GBS = 186.0
+#: launch+sync latency of one small all-reduce on an ICI ring — the
+#: per-collective floor that dominates few-scalar psums (dot products);
+#: what the communication-avoiding Krylov variants amortise
+PSUM_LATENCY_S = 5e-6
 
 _INDEX_BYTES = 4          # int32 column/row ids
 
@@ -232,10 +236,38 @@ def dist_overlap(Ad, nnz: Optional[int] = None,
         "est_halo_s": round(est_halo_s, 9),
         "overlap_fraction": round(overlap, 4),
         "halo_bound": bool(est_halo_s > est_interior_s),
+        # static model by default; telemetry/overlap.py flips this to
+        # True when a profiler trace supplied a measured fraction
+        "measured": False,
     }
     if level is not None:
         out["level"] = int(level)
     return out
+
+
+def krylov_reduction_cost(Ad, coll_per_iter: int) -> Optional[dict]:
+    """Modelled per-iteration cost split of a sharded Krylov solve:
+    interior-SpMV seconds vs dot-product all-reduce seconds.
+
+    A few-scalar all-reduce on an ICI ring is latency-bound — its cost
+    is ~:data:`PSUM_LATENCY_S` per collective regardless of payload —
+    so ``est_reduction_s`` scales with the reduction COUNT, which is
+    exactly what the communication-avoiding variants shrink.  None for
+    non-sharded packs (single-device reductions are register traffic).
+    """
+    if getattr(Ad, "fmt", "") != "sharded-ell":
+        return None
+    c = spmv_cost(Ad)
+    P = int(Ad.n_parts)
+    local_bytes = int(c.get("bytes_per_apply") or 0)
+    est_spmv_s = local_bytes / P / (HBM_PEAK_GBS * 1e9)
+    est_reduction_s = float(coll_per_iter) * PSUM_LATENCY_S
+    return {
+        "n_parts": P,
+        "est_spmv_s": round(est_spmv_s, 9),
+        "est_reduction_s": round(est_reduction_s, 9),
+        "reduction_bound": bool(est_reduction_s > est_spmv_s),
+    }
 
 
 # ------------------------------------------------------------- rollups

@@ -230,6 +230,7 @@ def diagnose(paths: List[str]) -> dict:
     # costmodel.dist_overlap events + distributed/agglomerate.py)
     dist_levels: Dict[str, dict] = {}
     agglomerations: List[dict] = []
+    krylov_events: List[dict] = []
     for s in agg["sessions"]:
         for r in s["records"]:
             if r["kind"] != "event":
@@ -239,6 +240,8 @@ def diagnose(paths: List[str]) -> dict:
                     dict(r["attrs"])
             elif r["name"] == "dist_agglomerate":
                 agglomerations.append(dict(r["attrs"]))
+            elif r["name"] == "krylov_comm":
+                krylov_events.append(dict(r["attrs"]))
     local_bytes = sum(float(d.get("bytes_per_apply") or 0)
                       for d in levels.values())
     if not local_bytes and op_cost:
@@ -249,6 +252,25 @@ def diagnose(paths: List[str]) -> dict:
     halo_local_ratio = None
     if halo_per_apply and local_bytes:
         halo_local_ratio = round(halo_per_apply / local_bytes, 4)
+
+    # ---- communication-avoiding Krylov (PR 16: krylov_comm events) --
+    # keys on SHARDED solves only — single-device reductions are
+    # register traffic and a collectives table there is noise
+    krylov = None
+    sharded_kc = [e for e in krylov_events
+                  if int(e.get("n_parts") or 1) > 1]
+    if sharded_kc:
+        by_mode: Dict[str, dict] = {}
+        for e in sharded_kc:       # last event per (solver, mode) wins
+            by_mode[f"{e.get('solver')}/{e.get('mode')}"] = e
+        krylov = {
+            "solves": by_mode,
+            # profiler-measured overlap fractions (telemetry/overlap.py)
+            # vs the modelled ones still in the distributed table
+            "measured_overlap": {
+                lvl: d.get("overlap_fraction")
+                for lvl, d in dist_levels.items() if d.get("measured")},
+        }
 
     # ---- serving (amgx_tpu/serve/) ----------------------------------
     req_total, req_by = csum("amgx_serve_requests_total")
@@ -558,11 +580,24 @@ def diagnose(paths: List[str]) -> dict:
         else:
             hints.append(
                 f"{len(halo_bound)} distributed level(s) are "
-                "halo-bound (modelled halo time exceeds the interior "
+                "halo-bound (halo time exceeds the interior "
                 "SpMV even with perfect overlap) — set "
                 f"dist_agglomerate_min_rows above {worst} rows/device "
                 "to agglomerate those levels onto a shrinking "
                 "sub-mesh")
+    if krylov:
+        for _key, e in sorted(krylov["solves"].items()):
+            if e.get("mode") == "CLASSIC" and e.get("reduction_bound"):
+                hints.append(
+                    f"dot-product reductions dominate the sharded "
+                    f"{e.get('solver')} solve (modelled "
+                    f"{float(e.get('est_reduction_s') or 0)*1e6:.1f} us"
+                    f"/iter across {int(e.get('collectives_per_iter') or 0)}"
+                    " collectives vs "
+                    f"{float(e.get('est_spmv_s') or 0)*1e6:.1f} us "
+                    "interior SpMV) — try krylov_comm=PIPELINED to fuse "
+                    "them into one collective overlapped with the SpMV")
+                break
     if plateau:
         hints.append(
             f"residual plateaued for {plateau['iterations']} iterations "
@@ -721,6 +756,7 @@ def diagnose(paths: List[str]) -> dict:
             "levels": dist_levels,
             "agglomerations": agglomerations,
         },
+        "krylov": krylov,
         "serving": serving,
         "serving_lanes": lanes_diag,
         "slo": slo,
@@ -1083,6 +1119,33 @@ def render(d: dict) -> str:
                 f" ({a.get('rows')} rows"
                 + (", replicated" if a.get("replicated") else "")
                 + (", pack reused" if a.get("reused") else "") + ")")
+
+    kry = d.get("krylov")
+    if kry:
+        L.append("")
+        L.append("Krylov communication (sharded solves)")
+        L.append("-" * 40)
+        L.append(f"  {'solver':<10}{'mode':<11}{'coll/iter':>10}"
+                 f"{'fused':>7}{'iters':>7}  per-iter profile")
+        for _key, e in sorted(kry["solves"].items()):
+            prof = ", ".join(f"{k}: {v}" for k, v
+                             in sorted((e.get("per_iter") or {}).items()))
+            L.append(
+                f"  {str(e.get('solver', '?')):<10}"
+                f"{str(e.get('mode', '?')):<11}"
+                f"{int(e.get('collectives_per_iter') or 0):>10}"
+                + f"{'yes' if e.get('fused') else 'no':>7}"
+                + f"{int(e.get('iterations') or 0):>7}"
+                + f"  {prof}")
+        if kry.get("measured_overlap"):
+            for lvl, f in sorted(kry["measured_overlap"].items(),
+                                 key=lambda kv: str(kv[0])):
+                L.append(f"  measured overlap [level {lvl}]: "
+                         f"{float(f or 0):.2f} (profiler trace)")
+        else:
+            L.append("  overlap fractions above are MODELLED — supply "
+                     "a jax.profiler trace (telemetry/overlap.py) for "
+                     "measured ones")
 
     srv = d.get("serving")
     if srv:

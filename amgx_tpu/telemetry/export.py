@@ -222,6 +222,10 @@ def validate_record(rec: dict):
                      f"dist_overlap event missing numeric {k}")
             need(isinstance(a.get("halo_bound"), bool),
                  "dist_overlap event missing halo_bound bool")
+            # modelled vs profiler-measured provenance (PR 16): every
+            # overlap number must say which it is
+            need(isinstance(a.get("measured"), bool),
+                 "dist_overlap event missing measured bool")
         if rec["name"] == "dist_agglomerate":
             # agglomeration decisions (distributed/agglomerate.py):
             # the doctor's sub-mesh lifecycle input
@@ -243,9 +247,9 @@ def validate_record(rec: dict):
             need(a.get("kind") in kinds,
                  f"recovery_attempt event has unknown kind "
                  f"{a.get('kind')!r}")
-            need(a.get("action") in ("restart", "promote",
-                                     "conservative", "resetup",
-                                     "ladder"),
+            need(a.get("action") in ("krylov_classic", "restart",
+                                     "promote", "conservative",
+                                     "resetup", "ladder"),
                  f"recovery_attempt event has unknown action "
                  f"{a.get('action')!r}")
             need(isinstance(a.get("attempt"), int) and a["attempt"] >= 0,
@@ -254,6 +258,31 @@ def validate_record(rec: dict):
                                       "skipped", "exhausted"),
                  f"recovery_attempt event has unknown outcome "
                  f"{a.get('outcome')!r}")
+        if rec["name"] == "krylov_comm":
+            # communication-avoiding Krylov accounting (PR 16): the
+            # per-iteration reduction profile the perf gate's
+            # collectives_per_iter ceiling and the doctor's "Krylov
+            # communication" section read
+            a = rec["attrs"]
+            need(isinstance(a.get("solver"), str) and a["solver"],
+                 "krylov_comm event missing solver")
+            need(a.get("mode") in ("CLASSIC", "CA", "PIPELINED"),
+                 f"krylov_comm event has unknown mode {a.get('mode')!r}")
+            need(isinstance(a.get("iterations"), int)
+                 and a["iterations"] >= 0,
+                 "krylov_comm event missing iterations")
+            per = a.get("per_iter")
+            need(isinstance(per, dict) and all(
+                isinstance(k, str) and isinstance(v, int)
+                for k, v in per.items()),
+                 "krylov_comm event missing per_iter op->count dict")
+            need(isinstance(a.get("collectives_per_iter"), int)
+                 and a["collectives_per_iter"] >= 0,
+                 "krylov_comm event missing collectives_per_iter")
+            need(isinstance(a.get("fused"), bool),
+                 "krylov_comm event missing fused bool")
+            need(isinstance(a.get("n_parts"), int) and a["n_parts"] >= 1,
+                 "krylov_comm event missing n_parts")
         if rec["name"] == "fault_injected":
             # chaos-run provenance: every synthetic failure in a trace
             # must name its injection point

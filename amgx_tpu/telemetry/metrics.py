@@ -82,9 +82,16 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("gauge", "active ranks of the sub-mesh one distributed "
                   "hierarchy level lives on {level}"),
     "amgx_dist_overlap_fraction":
-        ("gauge", "modelled fraction of one level's halo exchange "
-                  "hideable under its interior SpMV (1 = fully "
-                  "hidden) {level}"),
+        ("gauge", "fraction of one level's halo exchange hideable "
+                  "under its interior SpMV (1 = fully hidden); "
+                  "modelled, or profiler-measured when a trace was "
+                  "supplied (telemetry/overlap.py) {level}"),
+    # ---- communication-avoiding Krylov (ops/blas.py fused
+    # reductions + solvers/krylov.py CA/PIPELINED variants; PR 16) ----
+    "amgx_krylov_collectives_total":
+        ("counter", "reduction collectives executed by Krylov solve "
+                    "loops: trace-time per-iteration profile x executed "
+                    "iterations {op=dot|norm|gram|fused|replace}"),
     # ---- convergence forensics (telemetry/forensics.py) ------------
     "amgx_forensics_nullspace":
         ("gauge", "near-nullspace preservation |A*1|inf/|A|inf of one "

@@ -1,0 +1,183 @@
+"""Measured (not modelled) interior-vs-halo overlap from profiler traces.
+
+:func:`costmodel.dist_overlap` predicts how much of a level's halo
+exchange the interior SpMV can hide from shape arithmetic alone.  This
+module replaces that prediction with TRUTH when a ``jax.profiler``
+capture of a real multi-chip run is available: it parses the chrome
+trace, classifies device ops into communication (all-reduce /
+all-gather / reduce-scatter / collective-permute / all-to-all) vs
+compute, and measures the fraction of communication wall time that ran
+CONCURRENTLY with compute on the same device — the achieved overlap.
+
+Events refined through :func:`measured_event` carry ``measured=True``
+so every downstream consumer (doctor, perf gate, dashboards) can tell
+an honest measurement from a model (the ``dist_overlap`` schema's
+``measured`` bool).
+
+Host-side file parsing only — safe without any profiler plugin
+installed; every entry point degrades to ``None`` when the trace has
+no communication ops (single-device or CPU runs keep the modelled
+numbers).
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+from typing import Iterable, List, Optional
+
+#: XLA op-name fragments that mean inter-chip communication.  HLO names
+#: keep their kind as a prefix ("all-reduce.1", "fusion.all_gather", …)
+#: across XLA versions; matching fragments is robust to the separators.
+_COMM_RE = re.compile(
+    r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|"
+    r"collective[-_]?permute|all[-_]?to[-_]?all|ppermute|psum",
+    re.IGNORECASE)
+
+#: trace-viewer metadata / host-side bookkeeping phases that are not
+#: device work at all
+_SKIP_PH = {"M", "I", "C"}
+
+
+def _load_json(path: str) -> Optional[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def find_trace_file(path: str) -> Optional[str]:
+    """Resolve a trace argument to a concrete chrome-trace file.
+
+    Accepts the file itself (``.trace.json`` / ``.trace.json.gz`` or any
+    ``.json``) or a profiler log directory, which is searched recursively
+    (``jax.profiler.trace`` writes ``plugins/profile/<run>/
+    <host>.trace.json.gz``); the newest match wins.
+    """
+    if os.path.isfile(path):
+        return path
+    if not os.path.isdir(path):
+        return None
+    hits: List[str] = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f.endswith((".trace.json", ".trace.json.gz")):
+                hits.append(os.path.join(root, f))
+    if not hits:
+        return None
+    return max(hits, key=lambda p: os.path.getmtime(p))
+
+
+def _merge_intervals(iv: List[tuple]) -> List[tuple]:
+    iv = sorted(iv)
+    out: List[tuple] = []
+    for s, e in iv:
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _overlap_len(s: float, e: float, merged: List[tuple]) -> float:
+    total = 0.0
+    for ms, me in merged:
+        if me <= s:
+            continue
+        if ms >= e:
+            break
+        total += min(e, me) - max(s, ms)
+    return total
+
+
+def measure(trace: "str | dict | Iterable[dict]") -> Optional[dict]:
+    """Measured overlap numbers from a profiler capture.
+
+    ``trace``: a path (file or profiler logdir), a loaded chrome-trace
+    dict, or an iterable of trace events.  Returns ``None`` when no
+    communication ops appear (nothing to measure — keep the model);
+    otherwise a dict with ``overlap_fraction`` (fraction of comm wall
+    time concurrent with same-device compute), ``comm_s`` /
+    ``compute_s`` totals, ``n_comm_events`` and ``n_devices``.
+    """
+    if isinstance(trace, str):
+        f = find_trace_file(trace)
+        data = _load_json(f) if f else None
+        if data is None:
+            return None
+        events = data.get("traceEvents", [])
+    elif isinstance(trace, dict):
+        events = trace.get("traceEvents", [])
+    else:
+        events = list(trace)
+
+    comm: dict = {}      # pid -> [(start, end)]
+    compute: dict = {}   # pid -> [(start, end)]
+    for ev in events:
+        if ev.get("ph", "X") in _SKIP_PH:
+            continue
+        dur = ev.get("dur")
+        ts = ev.get("ts")
+        if dur is None or ts is None or dur <= 0:
+            continue
+        pid = ev.get("pid", 0)
+        name = str(ev.get("name", ""))
+        bucket = comm if _COMM_RE.search(name) else compute
+        bucket.setdefault(pid, []).append((float(ts), float(ts) + float(dur)))
+    if not comm:
+        return None
+
+    comm_us = 0.0
+    hidden_us = 0.0
+    compute_us = 0.0
+    for pid, spans in comm.items():
+        merged = _merge_intervals(compute.get(pid, []))
+        compute_us += sum(e - s for s, e in merged)
+        for s, e in spans:
+            comm_us += e - s
+            hidden_us += _overlap_len(s, e, merged)
+    # devices that only computed still count toward the device tally
+    n_devices = len(set(comm) | set(compute))
+    frac = hidden_us / comm_us if comm_us > 0 else 1.0
+    return {
+        "overlap_fraction": round(min(frac, 1.0), 4),
+        "comm_s": round(comm_us * 1e-6, 9),
+        "compute_s": round(compute_us * 1e-6, 9),
+        "n_comm_events": sum(len(v) for v in comm.values()),
+        "n_devices": n_devices,
+    }
+
+
+def measured_event(base: dict, measured: dict) -> dict:
+    """A ``dist_overlap`` event payload with the modelled overlap numbers
+    replaced by profiler truth (``measured=True``).
+
+    ``base`` is a modelled event dict (:func:`costmodel.dist_overlap`
+    output — its structural fields n_parts/rows/bytes stay authoritative);
+    ``measured`` is a :func:`measure` result.
+    """
+    out = dict(base)
+    est_halo_s = measured["comm_s"]
+    est_interior_s = measured["compute_s"]
+    out.update(
+        overlap_fraction=measured["overlap_fraction"],
+        est_interior_s=round(est_interior_s, 9),
+        est_halo_s=round(est_halo_s, 9),
+        halo_bound=bool(est_halo_s * (1.0 - measured["overlap_fraction"])
+                        > est_interior_s),
+        measured=True,
+    )
+    return out
+
+
+def refine_captured(dist_events: List[dict], trace) -> List[dict]:
+    """Refine captured modelled ``dist_overlap`` event payloads with one
+    trace's measured overlap; returns the refined payloads (empty when the
+    trace yields nothing — callers then keep the modelled events)."""
+    m = measure(trace)
+    if m is None:
+        return []
+    return [measured_event(ev, m) for ev in dist_events]

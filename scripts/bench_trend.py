@@ -84,6 +84,11 @@ CASES = (
     ("weak_eff", _x(("extras", "distributed", "weak_eff_8"))),
     ("halo%", lambda d: _pct(_x(
         ("extras", "distributed", "halo_frac_8"))(d))),
+    # communication-avoiding Krylov (ISSUE 16): measured collectives
+    # per iteration of the 8-part CA solve (the single fused reduction
+    # contract) — pre-PR-16 rounds lack the A/B block and render "-"
+    ("coll/iter", _x(("extras", "distributed", "krylov_ab_8",
+                      "coll_per_iter_ca"))),
     # breakdown recovery (ISSUE 13, AMGX_BENCH_CHAOS=1 rounds): the
     # recovered-solve overhead of one injected NaN-poison fault vs the
     # clean headline solve; non-chaos rounds render "-"

@@ -79,6 +79,13 @@ FLOOR_SLACK = 0.05
 #: scalar-expansion pack on the same operator — a pinned ≥1.5×
 #: contract that --update never ratchets: the block micro-tile layout
 #: must keep beating the expansion it replaced)
+#: coll_per_iter_ca / coll_ratio come from the distributed block's
+#: 8-part CLASSIC-vs-CA Krylov A/B (ISSUE 16): the CA path's measured
+#: collectives per iteration is a pinned CEILING (one fused reduction
+#: per CG iteration — creeping back up means someone un-fused a dot),
+#: and the CLASSIC/CA collectives ratio is a pinned ≥2.0 scaling floor
+#: (the "halved" acceptance).  Both are contracts --update never
+#: ratchets
 TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("iterations", "iters"),
            ("cold_start_s", "time"), ("warm_start_s", "time"),
@@ -86,7 +93,9 @@ TRACKED = (("setup_s", "time"), ("solve_s", "time"),
            ("bf16_effective_speedup", "floor"),
            ("lane_speedup", "scaling"),
            ("weak_eff", "scaling"),
-           ("block_spmv_speedup", "scaling"))
+           ("block_spmv_speedup", "scaling"),
+           ("coll_per_iter_ca", "ceiling"),
+           ("coll_ratio", "scaling"))
 
 
 def _extract_parsed(rec: dict):
@@ -193,6 +202,15 @@ def load_round(path: str) -> dict:
             and ds.get("parts_max") == 8 \
             and isinstance(ds.get("weak_eff_8"), (int, float)):
         cases["distributed"] = {"weak_eff": ds["weak_eff_8"]}
+    # communication-avoiding Krylov A/B (ISSUE 16): only the full
+    # 8-part measurement feeds the gate — the ceiling/floor are
+    # 8-shard contracts, a narrower mesh measures different collectives
+    ab = ds.get("krylov_ab_8") if isinstance(ds, dict) else None
+    if isinstance(ab, dict) and "error" not in ab:
+        vals = {k: ab[k] for k in ("coll_per_iter_ca", "coll_ratio")
+                if isinstance(ab.get(k), (int, float))}
+        if vals:
+            cases["krylov_comm"] = vals
     return cases
 
 
@@ -221,6 +239,18 @@ def compare(baseline: dict, cases: dict, time_ratio=None,
                     not isinstance(v, (int, float)):
                 continue
             checked += 1
+            if kind == "ceiling":
+                # lower-is-better ABSOLUTE pinned ceiling (measured
+                # collectives per iteration): exceeding it means the
+                # fused-reduction contract broke, whatever the timings
+                # did.  No slack — collectives are counted, not timed —
+                # and --update never ratchets it (see main())
+                if v > b:
+                    regressions.append({
+                        "case": case, "metric": key, "baseline": b,
+                        "value": v, "ratio": round(v / b, 3)
+                        if b else None, "limit": b})
+                continue
             if kind in ("floor", "scaling"):
                 # higher-is-better metrics.  "floor" (measured speedup
                 # factors) regresses by FALLING more than FLOOR_SLACK
@@ -325,15 +355,16 @@ def main(argv=None) -> int:
         try:
             # an operator-tuned thresholds block survives the update —
             # --update refreshes the NUMBERS, not the policy.  So do
-            # "scaling"-kind values: they are pinned CONTRACTS (4-lane
-            # ≥ 3.0×), not measurements to ratchet — a lucky 3.8× round
-            # must not turn the floor into 3.8
+            # "scaling"/"ceiling"-kind values: they are pinned
+            # CONTRACTS (4-lane ≥ 3.0×, ≤ 1 collective/iter), not
+            # measurements to ratchet — a lucky 3.8× round must not
+            # turn the floor into 3.8
             with open(baseline_path) as f:
                 prev = json.load(f)
             if isinstance(prev.get("thresholds"), dict):
                 new_baseline["thresholds"] = prev["thresholds"]
             scaling_keys = {k for k, kind in TRACKED
-                            if kind == "scaling"}
+                            if kind in ("scaling", "ceiling")}
             for case, vals in (prev.get("cases") or {}).items():
                 if not isinstance(vals, dict):
                     continue

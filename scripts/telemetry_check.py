@@ -735,7 +735,8 @@ def main():
                     rows_per_part=32, interior_bytes=10000,
                     halo_wire_bytes=90000, halo_local_ratio=9.0,
                     est_interior_s=1e-8, est_halo_s=6e-8,
-                    overlap_fraction=0.17, halo_bound=True)
+                    overlap_fraction=0.17, halo_bound=True,
+                    measured=False)
     telemetry.flush_jsonl(path_db)
     telemetry.disable()
     diag_db = doctor.diagnose([path_db])
@@ -754,7 +755,8 @@ def main():
                     rows_per_part=25000, interior_bytes=9000000,
                     halo_wire_bytes=90000, halo_local_ratio=0.01,
                     est_interior_s=1e-5, est_halo_s=6e-8,
-                    overlap_fraction=1.0, halo_bound=False)
+                    overlap_fraction=1.0, halo_bound=False,
+                    measured=False)
     telemetry.flush_jsonl(path_dbal)
     telemetry.disable()
     diag_dbal = doctor.diagnose([path_dbal])
@@ -846,13 +848,106 @@ def main():
         fail(f"recovery hint fired on a clean trace: "
              f"{diag_clean.get('hints')}")
 
+    # 17. communication-avoiding Krylov (ISSUE 16): a PCG_CA solve's
+    # trace carries a schema-valid krylov_comm event (single fused
+    # reduction per iteration) plus the collectives counter; the
+    # validator rejects broken shapes BOTH WAYS; and dist_overlap
+    # provenance works both ways too — modelled events say
+    # measured=false, overlap.measured_event flips them to true and
+    # they still validate
+    import copy
+    telemetry.reset()
+    telemetry.disable()
+    path_k = path + ".krylov"
+    if os.path.exists(path_k):
+        os.unlink(path_k)
+    cfg_k = amgx.AMGConfig(
+        "config_version=2, solver(out)=PCG_CA, out:max_iters=120, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=2, "
+        f"out:telemetry=1, out:telemetry_path={path_k}")
+    slv_k = amgx.create_solver(cfg_k)
+    slv_k.setup(amgx.Matrix(A))
+    res_k = slv_k.solve(np.ones(A.shape[0]))
+    telemetry.disable()
+    if int(res_k.status) != 0:
+        fail(f"PCG_CA solve did not converge: status {res_k.status}")
+    with open(path_k) as f:
+        lines_k = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_k)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"krylov_comm trace: {e}")
+    recs_k = [json.loads(l) for l in lines_k if l.strip()]
+    kc_k = [r for r in recs_k if r["kind"] == "event"
+            and r["name"] == "krylov_comm"]
+    if not kc_k:
+        fail("PCG_CA trace has no krylov_comm event")
+    a_k = kc_k[-1]["attrs"]
+    if a_k["mode"] != "CA" or a_k["collectives_per_iter"] != 1 \
+            or not a_k["fused"]:
+        fail(f"krylov_comm event wrong for a CA solve (want mode=CA, "
+             f"one fused collective/iter): {a_k}")
+    if not any(r["kind"] == "counter"
+               and r["name"] == "amgx_krylov_collectives_total"
+               and r["labels"].get("op") == "fused"
+               for r in recs_k):
+        fail("PCG_CA trace never counted "
+             "amgx_krylov_collectives_total{op=fused}")
+    # … and the validator rejects broken krylov_comm shapes
+    for mutate, what in (
+            (lambda a: a.__setitem__("mode", "TURBO"), "unknown mode"),
+            (lambda a: a.__setitem__("collectives_per_iter", -1),
+             "negative collectives_per_iter"),
+            (lambda a: a.__setitem__("per_iter", "3"),
+             "non-dict per_iter profile")):
+        bad_k = copy.deepcopy(kc_k[-1])
+        mutate(bad_k["attrs"])
+        try:
+            telemetry.validate_record(bad_k)
+            fail(f"validator accepted a krylov_comm event with {what}")
+        except ValueError:
+            pass
+    # dist_overlap provenance both ways: the real distributed trace's
+    # modelled events must say measured=false …
+    if not all(a.get("measured") is False for a in ov_dd):
+        fail(f"modelled dist_overlap events must carry measured=false: "
+             f"{[a.get('measured') for a in ov_dd]}")
+    ov_rec = next(r for r in recs_dd if r["kind"] == "event"
+                  and r["name"] == "dist_overlap")
+    # … dropping the flag fails validation …
+    bad_ov = copy.deepcopy(ov_rec)
+    bad_ov["attrs"].pop("measured", None)
+    try:
+        telemetry.validate_record(bad_ov)
+        fail("validator accepted a dist_overlap event without the "
+             "measured provenance bool")
+    except ValueError:
+        pass
+    # … and a profiler-refined event flips to measured=true and still
+    # validates (synthetic measure() result — the real-trace path is
+    # covered by overlap.measure unit tests)
+    meas_ov = telemetry.overlap.measured_event(
+        ov_rec["attrs"], {"overlap_fraction": 0.8, "comm_s": 2e-7,
+                          "compute_s": 1e-5, "n_comm_events": 4,
+                          "n_devices": 8})
+    if meas_ov.get("measured") is not True:
+        fail(f"measured_event did not set measured=true: {meas_ov}")
+    good_ov = copy.deepcopy(ov_rec)
+    good_ov["attrs"] = meas_ov
+    try:
+        telemetry.validate_record(good_ov)
+    except ValueError as e:
+        fail(f"profiler-measured dist_overlap failed validation: {e}")
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
           f"{n_ev} chrome-trace events, doctor OK, forensics OK, "
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
           f"serving-obs OK, mixed-precision OK, serving-lanes OK, "
-          f"distributed OK, failures-recovery OK)")
+          f"distributed OK, failures-recovery OK, krylov-comm OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -869,6 +964,7 @@ def main():
         os.unlink(path_db)
         os.unlink(path_dbal)
         os.unlink(path_r)
+        os.unlink(path_k)
 
 
 def dist_child(trace_path: str) -> int:

@@ -73,6 +73,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "block: block-native kernel / gauntlet fast tests "
                    "(tier-1; pytest -m block selects just these)")
+    config.addinivalue_line(
+        "markers", "krylov_comm: communication-avoiding Krylov fast "
+                   "tests (tier-1; pytest -m krylov_comm selects "
+                   "just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

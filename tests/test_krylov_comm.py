@@ -1,0 +1,419 @@
+"""Communication-avoiding Krylov tests (ISSUE 16).
+
+The contract under test: PCG_CA (Chronopoulos–Gear single-reduction CG)
+and PCG_PIPE (Ghysels–Vanroose pipelined CG) produce the same answers
+as classic PCG within tolerance and an iteration band, while issuing
+ONE fused collective per iteration instead of three (two dots + the
+monitor norm) — measured by the ``amgx_krylov_collectives_total``
+ledger, not modelled; the s-step FGMRES pass fuses the second
+Gram–Schmidt sweep with the new column's norm; breakdown detection and
+the recovery ladder's ``krylov_classic`` rung keep the fast recurrences
+honest; and ``telemetry.overlap`` turns a profiler capture into
+measured (``measured=True``) overlap numbers.
+"""
+import gzip
+import json
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import capi, telemetry
+from amgx_tpu.errors import RC, FailureKind, SolveStatus
+from amgx_tpu.io import poisson5pt, poisson7pt
+from amgx_tpu.io.gauntlet import gauntlet_cases
+from amgx_tpu.telemetry import overlap
+from amgx_tpu.utils import faultinject
+
+pytestmark = pytest.mark.krylov_comm
+
+#: the iteration band of the acceptance: the fast recurrences may pay a
+#: little numerical drift, never a different convergence story
+ITER_BAND = 1.2
+
+BASE = (
+    "config_version=2, solver(out)={solver}, out:max_iters=300, "
+    "out:monitor_residual=1, out:tolerance=1e-9, "
+    "out:convergence=RELATIVE_INI, "
+    "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=2{extra}")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _solve(solver, A, b, extra=""):
+    slv = amgx.create_solver(amgx.AMGConfig(
+        BASE.format(solver=solver, extra=extra)))
+    slv.setup(amgx.Matrix(A))
+    return slv.solve(b), slv
+
+
+def _relres(A, b, x):
+    x = np.asarray(x, np.float64)
+    return float(np.linalg.norm(b - A @ x) / np.linalg.norm(b))
+
+
+# ------------------------------------------------------------- parity
+def test_ca_and_pipe_match_classic_poisson():
+    A = sp.csr_matrix(poisson5pt(24, 24))
+    b = np.ones(A.shape[0])
+    ref, _ = _solve("PCG", A, b)
+    assert ref.status == SolveStatus.SUCCESS
+    for solver in ("PCG_CA", "PCG_PIPE"):
+        res, _ = _solve(solver, A, b)
+        assert res.status == SolveStatus.SUCCESS, solver
+        assert _relres(A, b, res.x) < 1e-8, solver
+        np.testing.assert_allclose(np.asarray(res.x),
+                                   np.asarray(ref.x),
+                                   rtol=1e-6, atol=1e-10)
+        assert res.iterations <= ref.iterations * ITER_BAND, solver
+
+
+def test_knob_aliases_solver_name():
+    """``out:krylov_comm=CA`` on plain PCG is the same solve as the
+    PCG_CA alias — one switch, two spellings."""
+    A = sp.csr_matrix(poisson5pt(16, 16))
+    b = np.ones(A.shape[0])
+    via_knob, _ = _solve("PCG", A, b, extra=", out:krylov_comm=CA")
+    via_alias, _ = _solve("PCG_CA", A, b)
+    assert via_knob.iterations == via_alias.iterations
+    np.testing.assert_allclose(np.asarray(via_knob.x),
+                               np.asarray(via_alias.x), rtol=1e-12)
+
+
+@pytest.mark.parametrize("case_name", ["aniso3", "jump2"])
+@pytest.mark.parametrize("mode", ["CA", "PIPELINED"])
+def test_gauntlet_parity(case_name, mode):
+    """The fast recurrences hold up on real block operators (the
+    blocked per-component norm rides the fused reduction as masked
+    partial sums): same answer, iterations within the band."""
+    case = next(c for c in gauntlet_cases(scale=0.4)
+                if c.name == case_name)
+    A, bd = case.build()
+    m = amgx.Matrix(A, block_dim=bd)
+    b = np.ones(m.shape[0])
+    Ac = sp.csr_matrix(A)
+
+    def run(extra=""):
+        slv = amgx.create_solver(amgx.AMGConfig(case.cfg + extra))
+        slv.setup(amgx.Matrix(A, block_dim=bd))
+        return slv.solve(b)
+
+    ref = run()
+    res = run(f", out:krylov_comm={mode}")
+    assert ref.status == SolveStatus.SUCCESS
+    assert res.status == SolveStatus.SUCCESS
+    assert _relres(Ac, b, ref.x) < 1e-6
+    assert _relres(Ac, b, res.x) < 1e-6
+    assert res.iterations <= max(ref.iterations * ITER_BAND,
+                                 ref.iterations + 2)
+
+
+def test_residual_replacement_still_converges():
+    """An aggressive replacement interval (every 5 iterations the true
+    residual r = b - Ax replaces the recurrence) converges to the same
+    answer and shows up in the replace bucket of the collectives
+    counter."""
+    A = sp.csr_matrix(poisson5pt(24, 24))
+    b = np.ones(A.shape[0])
+    ref, _ = _solve("PCG", A, b)
+    for solver in ("PCG_CA", "PCG_PIPE"):
+        with telemetry.capture() as cap:
+            res, _ = _solve(solver, A, b,
+                            extra=", out:ca_residual_replace=5")
+        assert res.status == SolveStatus.SUCCESS, solver
+        assert _relres(A, b, res.x) < 1e-8, solver
+        assert res.iterations <= ref.iterations * ITER_BAND + 2
+        tot = cap.counter_totals("amgx_krylov_collectives_total",
+                                 label="op")
+        if solver == "PCG_CA":
+            # CA's replacement recomputes the carried scalars → an
+            # extra fused reduction in the replace bucket
+            assert tot.get("replace", 0) > 0, tot
+        else:
+            # pipelined replacement rebuilds vectors only: its scalars
+            # are recomputed by the top-of-loop fused reduction anyway,
+            # so the honest count of extra collectives is ZERO
+            assert tot.get("replace", 0) == 0, tot
+
+
+# -------------------------------------------------- measured collectives
+def test_collectives_per_iter_halved():
+    """The measured acceptance: classic PCG issues three collectives
+    per iteration (two dots + the monitor norm), CA and pipelined issue
+    ONE fused reduction — counted by the ledger behind
+    ``amgx_krylov_collectives_total``, and at least halved."""
+    A = sp.csr_matrix(poisson5pt(24, 24))
+    b = np.ones(A.shape[0])
+    per_iter = {}
+    for solver in ("PCG", "PCG_CA", "PCG_PIPE"):
+        with telemetry.capture() as cap:
+            res, _ = _solve(solver, A, b)
+        assert res.status == SolveStatus.SUCCESS
+        evs = cap.events("krylov_comm")
+        assert evs, f"{solver}: no krylov_comm event"
+        telemetry.validate_record(evs[-1])
+        ev = evs[-1]["attrs"]
+        per_iter[solver] = ev["collectives_per_iter"]
+        tot = cap.counter_totals("amgx_krylov_collectives_total",
+                                 label="op")
+        # the replacement bucket is OFF the steady-state per-iter
+        # profile (it fires every ca_residual_replace iterations)
+        steady = {k: v for k, v in tot.items() if k != "replace"}
+        assert sum(steady.values()) == \
+            ev["collectives_per_iter"] * res.iterations
+        if solver == "PCG":
+            assert ev["mode"] == "CLASSIC" and not ev["fused"]
+            assert set(steady) == {"dot", "norm"}
+        else:
+            assert ev["fused"] and set(steady) == {"fused"}
+    assert per_iter["PCG"] == 3
+    assert per_iter["PCG_CA"] == 1
+    assert per_iter["PCG_PIPE"] == 1
+    assert per_iter["PCG"] >= 2 * per_iter["PCG_CA"]
+
+
+def test_collectives_halved_on_8part_mesh():
+    """Same count on the real sharded path (the forced 8-device CPU
+    mesh the whole test tier runs on): one GSPMD all-reduce per fused
+    stack, n_parts recorded, and the event carries the modelled
+    SpMV-vs-reduction split for the doctor."""
+    import jax
+
+    from amgx_tpu.distributed.matrix import make_mesh, shard_vector
+    assert len(jax.devices()) == 8
+    cfg = (
+        "config_version=2, solver(out)={s}, out:max_iters=100, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, out:preconditioner(amg)=AMG, "
+        "amg:algorithm=CLASSICAL, amg:selector=PMIS, "
+        "amg:interpolator=D1, amg:max_iters=1, amg:max_row_sum=0.9, "
+        "amg:max_levels=6, amg:smoother(sm)=JACOBI_L1, sm:max_iters=1, "
+        "amg:presweeps=1, amg:postsweeps=1, amg:min_coarse_rows=8, "
+        "amg:coarse_solver=DENSE_LU_SOLVER, determinism_flag=1, "
+        "device_setup_min_rows=0, dist_agglomerate_min_rows=64")
+    A = poisson7pt(8, 8, 8)
+    b = np.ones(A.shape[0])
+    evs = {}
+    for solver in ("PCG", "PCG_CA"):
+        m = amgx.Matrix(A)
+        m.set_distribution(make_mesh(8))
+        slv = amgx.create_solver(amgx.AMGConfig(cfg.format(s=solver)))
+        slv.setup(m)
+        bd = shard_vector(m.device(), b)
+        with telemetry.capture() as cap:
+            res = slv.solve(bd)
+        assert res.status == SolveStatus.SUCCESS
+        ev = [e["attrs"] for e in cap.events("krylov_comm")][-1]
+        assert ev["n_parts"] == 8
+        evs[solver] = ev
+    assert evs["PCG"]["collectives_per_iter"] == 3
+    assert evs["PCG_CA"]["collectives_per_iter"] == 1
+    # the sharded event carries the modelled latency split the doctor's
+    # "try krylov_comm=PIPELINED" hint reads
+    for ev in evs.values():
+        assert "est_reduction_s" in ev and "reduction_bound" in ev
+
+
+def test_fgmres_fused_arnoldi_parity_and_counts():
+    """s-step FGMRES: the second Gram–Schmidt pass and the new column
+    norm fuse into one stacked collective (3 → 2 per Arnoldi column),
+    same answer as the classic sweep."""
+    A = sp.csr_matrix(poisson7pt(10, 10, 10))
+    b = np.ones(A.shape[0])
+    cfg = (
+        "config_version=2, solver(out)=FGMRES, out:max_iters=150, "
+        "out:monitor_residual=1, out:tolerance=1e-8, "
+        "out:convergence=RELATIVE_INI, "
+        "out:preconditioner(pre)=BLOCK_JACOBI, pre:max_iters=2{extra}")
+
+    def run(extra=""):
+        slv = amgx.create_solver(amgx.AMGConfig(cfg.format(extra=extra)))
+        slv.setup(amgx.Matrix(A))
+        with telemetry.capture() as cap:
+            res = slv.solve(b)
+        return res, [e["attrs"] for e in cap.events("krylov_comm")][-1]
+
+    ref, ev_ref = run()
+    res, ev_ca = run(", out:krylov_comm=CA")
+    assert ref.status == SolveStatus.SUCCESS
+    assert res.status == SolveStatus.SUCCESS
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                               rtol=1e-6, atol=1e-10)
+    assert res.iterations <= ref.iterations * ITER_BAND
+    assert ev_ref["per_iter"] == {"gram": 2, "norm": 1}
+    assert ev_ca["per_iter"] == {"gram": 1, "fused": 1}
+    assert ev_ca["collectives_per_iter"] < \
+        ev_ref["collectives_per_iter"]
+
+
+# ------------------------------------------------- breakdown + recovery
+@pytest.mark.parametrize("solver", ["PCG_CA", "PCG_PIPE"])
+def test_krylov_zero_flags_breakdown(solver):
+    """The single-reduction recurrences keep PR-13's failure taxonomy:
+    a zeroed Krylov scalar is KRYLOV_BREAKDOWN, detected in-loop, and
+    the next (clean) solve succeeds."""
+    A = sp.csr_matrix(poisson5pt(16, 16))
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(
+        BASE.format(solver=solver, extra=", out:store_res_history=1")))
+    slv.setup(amgx.Matrix(A))
+    faultinject.configure("krylov_zero:iter=3:count=1")
+    res = slv.solve(b)
+    assert res.status == SolveStatus.FAILED
+    assert res.failure is not None
+    assert res.failure.kind == FailureKind.KRYLOV_BREAKDOWN
+    assert res.iterations <= 3 + 5      # in-loop early detection
+    assert slv.solve(b).status == SolveStatus.SUCCESS
+
+
+def test_recovery_falls_back_to_classic_before_restart():
+    """Rung 0 of the ladder: a Krylov breakdown in CA mode re-solves
+    with the classic recurrence BEFORE burning a restart rung, and the
+    fallback is sticky for later solves on the same handle."""
+    A = sp.csr_matrix(poisson5pt(16, 16))
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(
+        BASE.format(solver="PCG_CA",
+                    extra=", out:recovery_policy=AUTO, "
+                          "out:store_res_history=1")))
+    slv.setup(amgx.Matrix(A))
+    faultinject.configure("krylov_zero:iter=3:count=1")
+    with telemetry.capture() as cap:
+        res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    assert res.recovery is not None
+    assert res.recovery["action"] == "krylov_classic"
+    assert res.recovery["outcome"] == "recovered"
+    assert _relres(A, b, res.x) < 1e-8
+    evs = [e["attrs"] for e in cap.events("recovery_attempt")]
+    assert [e["action"] for e in evs] == ["krylov_classic"]
+    # sticky: the handle keeps solving CLASSIC afterwards
+    assert slv._force_krylov_classic is True
+    assert slv._comm_mode() == "CLASSIC"
+    assert slv.solve(b).status == SolveStatus.SUCCESS
+
+
+def test_recovery_rung_skipped_for_classic_mode():
+    """The rung only exists for the fast recurrences: a classic-PCG
+    breakdown must not burn an attempt on it."""
+    A = sp.csr_matrix(poisson5pt(16, 16))
+    b = np.ones(A.shape[0])
+    slv = amgx.create_solver(amgx.AMGConfig(
+        BASE.format(solver="PCG",
+                    extra=", out:recovery_policy=AUTO, "
+                          "out:store_res_history=1")))
+    slv.setup(amgx.Matrix(A))
+    faultinject.configure("krylov_zero:iter=3:count=1")
+    with telemetry.capture() as cap:
+        res = slv.solve(b)
+    assert res.status == SolveStatus.SUCCESS
+    engaged = [e["attrs"] for e in cap.events("recovery_attempt")
+               if e["attrs"]["action"] == "krylov_classic"
+               and e["attrs"]["outcome"] != "skipped"]
+    assert not engaged
+
+
+# ------------------------------------------------------------- resetup
+def test_values_only_resetup_zero_retrace():
+    """A values-only resetup of a CA solver reuses the traced
+    single-reduction body: zero retraces/recompiles once warm, and the
+    refreshed solve is the scaled solution."""
+    A = sp.csr_matrix(poisson7pt(10, 10, 10))
+    m = amgx.Matrix(A)
+    slv = amgx.create_solver(amgx.AMGConfig(
+        BASE.format(solver="PCG_CA", extra="")))
+    slv.setup(m)
+    b = np.ones(A.shape[0])
+    x0 = np.asarray(slv.solve(b).x, np.float64)
+
+    def refreshed(scale):
+        m2 = amgx.Matrix(A)
+        m2.replace_coefficients(A.data * scale)
+        return m2
+
+    slv.resetup(refreshed(2.0))       # warm: refresh fns trace once
+    slv.solve(b)
+    with telemetry.capture() as cap:
+        slv.resetup(refreshed(3.0))
+        res = slv.solve(b)
+    assert cap.counter_total("amgx_jit_trace_total") == 0
+    assert cap.counter_total("amgx_jit_compile_total") == 0
+    assert res.status == SolveStatus.SUCCESS
+    np.testing.assert_allclose(np.asarray(res.x, np.float64),
+                               x0 / 3.0, rtol=1e-6, atol=1e-10)
+
+
+# ---------------------------------------------------------------- capi
+def test_capi_knob_passthrough():
+    assert capi.AMGX_initialize() == RC.OK
+    rc, _ = capi.AMGX_config_create(
+        "config_version=2, solver(out)=PCG, out:krylov_comm=PIPELINED, "
+        "out:ca_residual_replace=25")
+    assert rc == RC.OK
+    rc, _ = capi.AMGX_config_create(
+        "config_version=2, solver(out)=PCG, out:krylov_comm=TURBO")
+    assert rc == RC.BAD_CONFIGURATION
+
+
+# ------------------------------------------------------ measured overlap
+def _trace_events():
+    # pid 0: compute [0, 100) us, all-reduce [50, 150) us → half the
+    # comm wall time is hidden behind compute
+    return [
+        {"ph": "X", "pid": 0, "tid": 1, "name": "fusion.23",
+         "ts": 0.0, "dur": 100.0},
+        {"ph": "X", "pid": 0, "tid": 2, "name": "all-reduce.1",
+         "ts": 50.0, "dur": 100.0},
+        {"ph": "M", "pid": 0, "name": "process_name"},
+    ]
+
+
+def test_overlap_measure_synthetic_trace():
+    m = overlap.measure({"traceEvents": _trace_events()})
+    assert m is not None
+    assert m["overlap_fraction"] == pytest.approx(0.5)
+    assert m["comm_s"] == pytest.approx(100e-6)
+    assert m["compute_s"] == pytest.approx(100e-6)
+    assert m["n_comm_events"] == 1 and m["n_devices"] == 1
+    # no comm ops → nothing to measure, keep the model
+    assert overlap.measure({"traceEvents": _trace_events()[:1]}) is None
+    assert overlap.refine_captured([{"level": 0}],
+                                   {"traceEvents": []}) == []
+
+
+def test_overlap_trace_file_discovery(tmp_path):
+    """find_trace_file digs the newest .trace.json.gz out of a profiler
+    logdir layout and measure() parses it."""
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    p = run / "host.trace.json.gz"
+    with gzip.open(p, "wt") as f:
+        json.dump({"traceEvents": _trace_events()}, f)
+    found = overlap.find_trace_file(str(tmp_path))
+    assert found == str(p)
+    m = overlap.measure(str(tmp_path))
+    assert m and m["overlap_fraction"] == pytest.approx(0.5)
+
+
+def test_measured_event_flips_provenance_and_validates():
+    base = {"level": 0, "n_parts": 8, "active_parts": 8,
+            "submesh_parts": 8, "rows": 4096, "rows_per_part": 512,
+            "interior_bytes": 1 << 20, "halo_wire_bytes": 1 << 14,
+            "halo_local_ratio": 0.02, "est_interior_s": 1e-5,
+            "est_halo_s": 2e-6, "overlap_fraction": 0.4,
+            "halo_bound": False, "measured": False}
+    meas = overlap.measured_event(
+        base, overlap.measure({"traceEvents": _trace_events()}))
+    assert meas["measured"] is True
+    assert meas["overlap_fraction"] == pytest.approx(0.5)
+    telemetry.validate_record(
+        {"kind": "event", "name": "dist_overlap", "seq": 1, "t": 0.0,
+         "tid": 0, "sid": None, "attrs": meas})
+    # …and the un-measured original still says so
+    assert base["measured"] is False
