@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.spmv import spmv
+from ..telemetry import scopes as _tscopes
 
 
 def build_cycle(hierarchy, cycle_type: str = None):
@@ -124,14 +125,15 @@ def build_cycle(hierarchy, cycle_type: str = None):
         ``fixed_cycle.cu:52`` (host markers can't see inside the fused
         executable; named scopes can)."""
         if i == len(levels):
-            with jax.named_scope("amg_coarse_solve"):
+            with _tscopes.scope("cycle", "coarse_solve"):
                 return coarse_solve_inst(b, x)
         lvl = levels[i]
         inst = _fore_at(i)
         if inst:
             n_entry = _rnorm(b - spmv(lvl.Ad, x))
-        with jax.named_scope(f"amg_level_{i}"):
+        with _tscopes.scope("cycle", f"level{i}/pre_smooth"):
             x = smooth(lvl, b, x, presweeps_at(i))
+        with _tscopes.scope("cycle", f"level{i}/restrict"):
             r = b - spmv(lvl.Ad, x)
             if inst:
                 n_pre = _rnorm(r)
@@ -157,7 +159,7 @@ def build_cycle(hierarchy, cycle_type: str = None):
             xc = _kcycle(i + 1, bc, xc, flavor)
         else:
             raise ValueError(f"unknown cycle {flavor!r}")
-        with jax.named_scope(f"amg_level_{i}_post"):
+        with _tscopes.scope("cycle", f"level{i}/prolong"):
             es = getattr(h, "error_scaling", 0)
             if es in (2, 3) and lvl.kind != "classical":
                 # scaled coarse correction x += λ·e (reference
@@ -184,12 +186,13 @@ def build_cycle(hierarchy, cycle_type: str = None):
                 x = lvl.prolongate_and_correct(x, xc)
             if inst:
                 n_coarse = _rnorm(b - spmv(lvl.Ad, x))
+        with _tscopes.scope("cycle", f"level{i}/post_smooth"):
             x = smooth(lvl, b, x, postsweeps_at(i))
-            if inst:
-                jax.debug.callback(
-                    partial(_forensics.emit_cycle_level, i, flavor),
-                    n_entry, n_pre, n_coarse,
-                    _rnorm(b - spmv(lvl.Ad, x)), ordered=False)
+        if inst:
+            jax.debug.callback(
+                partial(_forensics.emit_cycle_level, i, flavor),
+                n_entry, n_pre, n_coarse,
+                _rnorm(b - spmv(lvl.Ad, x)), ordered=False)
         return x
 
     def _kcycle(i, b, x, flavor):
@@ -201,12 +204,12 @@ def build_cycle(hierarchy, cycle_type: str = None):
 
     def _kcycle_body(i, b, x, flavor):
         if i == len(levels):
-            with jax.named_scope("amg_coarse_solve"):
+            with _tscopes.scope("cycle", "coarse_solve"):
                 return coarse_solve_inst(b, x)
         inner_flavor = "V" if flavor == "CGF" else flavor
         Ad = levels[i].Ad
 
-        with jax.named_scope(f"amg_kcycle_{i}"):
+        with _tscopes.scope("cycle", f"kcycle{i}"):
             r = b - spmv(Ad, x)
             p = None
             z_prev = None

@@ -564,11 +564,13 @@ def exchange_halo(A: ShardedMatrix, x: jax.Array, ring: int = 1
             got = _exchange(buf, dists, axis, A.n_parts)
             return got[hs[0]][None]
 
-        return _shard_map(
-            local, mesh=A.mesh,
-            in_specs=(P(axis, None), P(axis, None), P(axis)),
-            out_specs=P(axis, None),
-        )(send_idx, halo_src, x)
+        from ..telemetry import scopes as _tscopes
+        with _tscopes.scope("dist", "halo_exchange"):
+            return _shard_map(
+                local, mesh=A.mesh,
+                in_specs=(P(axis, None), P(axis, None), P(axis)),
+                out_specs=P(axis, None),
+            )(send_idx, halo_src, x)
     finally:
         _trecorder.span_end(sid, "exchange_halo")
 

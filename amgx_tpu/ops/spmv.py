@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from ..core.matrix import DeviceMatrix
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import recorder as _trecorder
+from ..telemetry import scopes as _tscopes
 
 
 #: operators whose cost descriptor was already emitted — id-keyed WEAK
@@ -41,26 +42,32 @@ def _tel_pack(pack: str, fallback: str = None, A=None):
     When the dispatched matrix is passed, its static cost descriptor
     (telemetry/costmodel.py: bytes/FLOPs per apply, padding waste) is
     emitted once per operator as an ``op_cost`` event — the doctor's
-    roofline arithmetic reads these straight from the trace."""
-    if not _trecorder.is_enabled():
-        return
-    _tmetrics.counter_inc("amgx_spmv_dispatch_total", pack=pack)
-    if fallback is not None:
-        _tmetrics.counter_inc("amgx_spmv_fallback_total", pack=pack,
-                              reason=fallback)
-    if A is None:
-        return
-    if _COST_SEEN.get(id(A)) is A:
-        return
-    try:
-        _COST_SEEN[id(A)] = A
-    except TypeError:
-        return          # non-weakref-able operator type: skip the event
-    try:
-        from ..telemetry import costmodel
-        _trecorder.event("op_cost", **costmodel.spmv_cost(A))
-    except Exception:
-        pass      # a cost-model gap must never break SpMV dispatch
+    roofline arithmetic reads these straight from the trace.
+
+    Returns the pack's contract ``jax.named_scope``
+    (``amgx/spmv/<pack>``, telemetry/scopes.py) — every dispatch site
+    builds its compute inside ``with _tel_pack(...):`` so the profiler
+    trace can attribute device time back to the pack
+    (telemetry/deviceprof.py).  The scope is always on: named scopes
+    only rename XLA metadata at trace time, the compiled program is
+    unchanged."""
+    if _trecorder.is_enabled():
+        _tmetrics.counter_inc("amgx_spmv_dispatch_total", pack=pack)
+        if fallback is not None:
+            _tmetrics.counter_inc("amgx_spmv_fallback_total", pack=pack,
+                                  reason=fallback)
+        if A is not None and _COST_SEEN.get(id(A)) is not A:
+            try:
+                _COST_SEEN[id(A)] = A
+            except TypeError:
+                A = None  # non-weakref-able operator type: no event
+            if A is not None:
+                try:
+                    from ..telemetry import costmodel
+                    _trecorder.event("op_cost", **costmodel.spmv_cost(A))
+                except Exception:
+                    pass   # cost-model gap must never break dispatch
+    return _tscopes.scope("spmv", pack)
 
 
 # sub-f32 floating STORAGE dtype (bf16/f16): arithmetic over it must
@@ -99,18 +106,18 @@ def spmv(A, x: jax.Array) -> jax.Array:
     """
     if A.fmt == "sharded-ell":
         from ..distributed.matrix import dist_spmv
-        _tel_pack("sharded", A=A)
-        return dist_spmv(A, x)
+        with _tel_pack("sharded", A=A):
+            return dist_spmv(A, x)
     if A.fmt == "dia3":
         # Galerkin composition R·(A·(P·x)) — three DIA streams instead
         # of one low-fill embedded matrix (core.matrix.ComposedDIA)
-        _tel_pack("dia3")
-        return spmv(A.R, spmv(A.A, spmv(A.P, x)))
+        with _tel_pack("dia3"):
+            return spmv(A.R, spmv(A.A, spmv(A.P, x)))
     if A.fmt == "op":
         # implicit operator (operators.ImplicitOperator — the
         # operator.h:37-80 Operator::apply analog)
-        _tel_pack("op")
-        return A.apply(x)
+        with _tel_pack("op"):
+            return A.apply(x)
     if A.fmt == "dia":
         if A.block_dim > 1:
             return _bdia_spmv(A, x)
@@ -123,112 +130,119 @@ def spmv(A, x: jax.Array) -> jax.Array:
                 and jnp.dtype(x.dtype).itemsize <= 4):
             # the kernel takes an f32 x window and accumulates f32 even
             # for bf16 value planes (halved HBM value bytes)
-            _tel_pack("dia/kernel", A=A)
-            return _narrow_to(dia_spmv(A, _widen(x)), A, x)
-        _tel_pack("dia/slices", A=A)
-        # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of one
-        # padded copy of x — no gathers (reference SpMV kernel dispatch
-        # multiply.cu:94-110; this is the TPU-optimal stencil path)
-        n = A.n_rows
-        offs = A.dia_offsets
-        maxo = max(max(abs(o) for o in offs), 1)
-        xp = jnp.pad(_widen(x), (maxo, maxo))
-        acc = _widen(A.vals[0]) * jax.lax.slice(xp, (maxo + offs[0],),
-                                                (maxo + offs[0] + n,))
-        for k in range(1, len(offs)):
-            acc = acc + _widen(A.vals[k]) * jax.lax.slice(
-                xp, (maxo + offs[k],), (maxo + offs[k] + n,))
-        return _narrow_to(acc, A, x)
+            with _tel_pack("dia/kernel", A=A):
+                return _narrow_to(dia_spmv(A, _widen(x)), A, x)
+        with _tel_pack("dia/slices", A=A):
+            # y = Σ_k vals[k] ⊙ x[· + off_k]: static shifted slices of
+            # one padded copy of x — no gathers (reference SpMV kernel
+            # dispatch multiply.cu:94-110; the TPU-optimal stencil path)
+            n = A.n_rows
+            offs = A.dia_offsets
+            maxo = max(max(abs(o) for o in offs), 1)
+            xp = jnp.pad(_widen(x), (maxo, maxo))
+            acc = _widen(A.vals[0]) * jax.lax.slice(
+                xp, (maxo + offs[0],), (maxo + offs[0] + n,))
+            for k in range(1, len(offs)):
+                acc = acc + _widen(A.vals[k]) * jax.lax.slice(
+                    xp, (maxo + offs[k],), (maxo + offs[k] + n,))
+            return _narrow_to(acc, A, x)
     b = A.block_dim
     if A.fmt == "dense":
         # small scattered coarse operator: one MXU matvec (HIGHEST
         # precision keeps the f32 product exact — the matrices are tiny)
-        _tel_pack("dense", A=A)
-        return _narrow_to(jnp.dot(_widen(A.vals), _widen(x),
-                                  precision=jax.lax.Precision.HIGHEST),
-                          A, x)
+        with _tel_pack("dense", A=A):
+            return _narrow_to(
+                jnp.dot(_widen(A.vals), _widen(x),
+                        precision=jax.lax.Precision.HIGHEST),
+                A, x)
     if A.fmt == "ell":
         if b == 1:
             from .pallas_shift import shift_spmv, shift_supported
             if shift_supported(A):
                 # tile-DIA shift kernel: VPU shift-aligned streams, no
                 # per-entry column data (locally-banded matrices)
-                _tel_pack("ell/shift", A=A)
-                return shift_spmv(A, x)
+                with _tel_pack("ell/shift", A=A):
+                    return shift_spmv(A, x)
             from .pallas_ell import ell_window_spmv, ell_window_supported
             if ell_window_supported(A):
                 # gather-free windowed one-hot kernel (XLA lowers the
                 # x[cols] gather to a scalar loop — ~100× slower)
-                _tel_pack("ell/window", A=A)
-                return ell_window_spmv(A, x)
+                with _tel_pack("ell/window", A=A):
+                    return ell_window_spmv(A, x)
             from .pallas_csr import binned_spmv, binned_supported
             if binned_supported(A):
                 # general-sparsity binned sliced-ELL kernel: scattered
                 # matrices past the shift/window gates stay off the
                 # gather cliff (ops/pallas_csr.py)
-                _tel_pack("ell/binned", A=A)
-                return binned_spmv(A, x)
+                with _tel_pack("ell/binned", A=A):
+                    return binned_spmv(A, x)
             # cols: (n, K); vals: (n, K); x: (m,) — via the views so a
             # LEAN shift/window pack (vals/cols deleted; the kernel
             # layouts carry them) still falls back correctly when the
             # kernel gate rejects it (advisor finding, round 4)
-            _tel_pack("ell/gather",
-                      fallback="kernel_gate_rejected"
-                      if (getattr(A, "sh_vals", None) is not None
-                          or getattr(A, "win_codes", None) is not None
-                          or getattr(A, "bn_codes", None) is not None)
-                      else None, A=A)
-            prod = _widen(A.ell_vals_view()) * _widen(x)[A.ell_cols_view()]
-            return _narrow_to(jnp.sum(prod, axis=1), A, x)
+            with _tel_pack("ell/gather",
+                           fallback="kernel_gate_rejected"
+                           if (getattr(A, "sh_vals", None) is not None
+                               or getattr(A, "win_codes", None)
+                               is not None
+                               or getattr(A, "bn_codes", None)
+                               is not None)
+                           else None, A=A):
+                prod = _widen(A.ell_vals_view()) \
+                    * _widen(x)[A.ell_cols_view()]
+                return _narrow_to(jnp.sum(prod, axis=1), A, x)
         from .pallas_csr import bn_block_dim, binned_spmv, binned_supported
         if binned_supported(A):
             # block-NATIVE planes (one code per b×b block, b-lane MXU
             # pick) — or the legacy scalar expansion behind the
             # AMGX_BLOCK_NATIVE=0 knob, where x is already flat scalar
             native = bn_block_dim(A.bn_dims) > 1
-            _tel_pack("ell/binned-block" if native else "ell/binned",
-                      A=A)
-            return _narrow_to(binned_spmv(A, x), A, x)
-        _tel_pack("ell/block-gather",
-                  fallback="kernel_gate_rejected"
-                  if getattr(A, "bn_codes", None) is not None else None,
-                  A=A)
-        return _block_gather_spmv(A, x)
+            with _tel_pack("ell/binned-block" if native
+                           else "ell/binned", A=A):
+                return _narrow_to(binned_spmv(A, x), A, x)
+        with _tel_pack("ell/block-gather",
+                       fallback="kernel_gate_rejected"
+                       if getattr(A, "bn_codes", None) is not None
+                       else None, A=A):
+            return _block_gather_spmv(A, x)
     # CSR path: binned sliced-ELL kernel first, segment-sum fallback
     from .pallas_csr import (binned_entries_view, bn_block_dim,
                              binned_spmv, binned_supported)
     if binned_supported(A):
-        _tel_pack("csr/binned-block"
-                  if bn_block_dim(A.bn_dims) > 1 else "csr/binned", A=A)
-        return _narrow_to(binned_spmv(A, x), A, x)
+        with _tel_pack("csr/binned-block"
+                       if bn_block_dim(A.bn_dims) > 1
+                       else "csr/binned", A=A):
+            return _narrow_to(binned_spmv(A, x), A, x)
     if b == 1:
         if A.vals is None:
             # lean binned pack on a backend the kernel cannot serve:
             # reconstruct the gather-form triplets from the planes
-            _tel_pack("csr/segsum-lean",
-                      fallback="kernel_gate_rejected", A=A)
-            rows, cols, vals = binned_entries_view(A)
-            prod = _widen(vals) * _widen(x)[cols]
+            with _tel_pack("csr/segsum-lean",
+                           fallback="kernel_gate_rejected", A=A):
+                rows, cols, vals = binned_entries_view(A)
+                prod = _widen(vals) * _widen(x)[cols]
+                return _narrow_to(
+                    jax.ops.segment_sum(prod, rows,
+                                        num_segments=A.n_rows),
+                    A, x)
+        with _tel_pack("csr/segsum",
+                       fallback="kernel_gate_rejected"
+                       if getattr(A, "bn_codes", None) is not None
+                       else None, A=A):
+            prod = _widen(A.vals) * _widen(x)[A.cols]
             return _narrow_to(
-                jax.ops.segment_sum(prod, rows, num_segments=A.n_rows),
+                jax.ops.segment_sum(prod, A.row_ids,
+                                    num_segments=A.n_rows),
                 A, x)
-        _tel_pack("csr/segsum",
-                  fallback="kernel_gate_rejected"
-                  if getattr(A, "bn_codes", None) is not None else None,
-                  A=A)
-        prod = _widen(A.vals) * _widen(x)[A.cols]
-        return _narrow_to(
-            jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows),
-            A, x)
-    _tel_pack("csr/block-segsum", A=A)
-    xb = x.reshape(A.n_cols, b)
-    xg = xb[A.cols]
-    pet = jnp.float32 if (_sub_f32(A.vals.dtype) or _sub_f32(xg.dtype)) \
-        else A.vals.dtype
-    prod = jnp.einsum("eab,eb->ea", A.vals, xg,
-                      preferred_element_type=pet)
-    y = jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
-    return _narrow_to(y.reshape(-1), A, x)
+    with _tel_pack("csr/block-segsum", A=A):
+        xb = x.reshape(A.n_cols, b)
+        xg = xb[A.cols]
+        pet = jnp.float32 if (_sub_f32(A.vals.dtype)
+                              or _sub_f32(xg.dtype)) else A.vals.dtype
+        prod = jnp.einsum("eab,eb->ea", A.vals, xg,
+                          preferred_element_type=pet)
+        y = jax.ops.segment_sum(prod, A.row_ids, num_segments=A.n_rows)
+        return _narrow_to(y.reshape(-1), A, x)
 
 
 #: element budget of one materialised (n, Kc, b) x-gather in the block
@@ -282,30 +296,30 @@ def _bdia_spmv(A, x: jax.Array) -> jax.Array:
     if ((jax.default_backend() == "tpu" or _INTERPRET)
             and dia_spmv_supported(n, offs, A.dtype)
             and jnp.dtype(x.dtype).itemsize <= 4):
-        _tel_pack("dia/block-kernel", A=A)
-        out_cols = []
-        for a in range(b):
-            acc = None
-            for c in range(b):
-                comp = dataclasses.replace(
-                    A, vals=A.vals[:, :, a, c], diag=A.diag[:, a, a],
-                    block_dim=1)
-                ya = dia_spmv(comp, xb[:, c])
-                acc = ya if acc is None else acc + ya
-            out_cols.append(acc)
-        y = jnp.stack(out_cols, axis=1)
-        return _narrow_to(y.reshape(-1), A, x)
-    _tel_pack("dia/block-slices", A=A)
-    maxo = max(max(abs(o) for o in offs), 1)
-    xp = jnp.pad(xb, ((maxo, maxo), (0, 0)))
-    pet = jnp.float32 if _sub_f32(A.dtype) else \
-        jnp.promote_types(A.dtype, xb.dtype)
-    acc = jnp.zeros((n, b), dtype=pet)
-    for k, o in enumerate(offs):
-        xs = jax.lax.slice(xp, (maxo + o, 0), (maxo + o + n, b))
-        acc = acc + jnp.einsum("nab,nb->na", _widen(A.vals[k]), xs,
-                               preferred_element_type=pet)
-    return _narrow_to(acc.reshape(-1), A, x)
+        with _tel_pack("dia/block-kernel", A=A):
+            out_cols = []
+            for a in range(b):
+                acc = None
+                for c in range(b):
+                    comp = dataclasses.replace(
+                        A, vals=A.vals[:, :, a, c], diag=A.diag[:, a, a],
+                        block_dim=1)
+                    ya = dia_spmv(comp, xb[:, c])
+                    acc = ya if acc is None else acc + ya
+                out_cols.append(acc)
+            y = jnp.stack(out_cols, axis=1)
+            return _narrow_to(y.reshape(-1), A, x)
+    with _tel_pack("dia/block-slices", A=A):
+        maxo = max(max(abs(o) for o in offs), 1)
+        xp = jnp.pad(xb, ((maxo, maxo), (0, 0)))
+        pet = jnp.float32 if _sub_f32(A.dtype) else \
+            jnp.promote_types(A.dtype, xb.dtype)
+        acc = jnp.zeros((n, b), dtype=pet)
+        for k, o in enumerate(offs):
+            xs = jax.lax.slice(xp, (maxo + o, 0), (maxo + o + n, b))
+            acc = acc + jnp.einsum("nab,nb->na", _widen(A.vals[k]), xs,
+                                   preferred_element_type=pet)
+        return _narrow_to(acc.reshape(-1), A, x)
 
 
 def abs_rowsum(A) -> jax.Array:

@@ -558,9 +558,20 @@ class Solver:
         ``Solver::smooth`` / preconditioner ``solve`` with small max_iters).
 
         Must be called inside a trace; assumes :meth:`setup` has run.
+
+        Smoother applications carry the ``amgx/smoother/<config_name>``
+        named scope (telemetry/scopes.py contract) so the profiler-trace
+        correlator can attribute their device time.
         """
         n = self.max_iters if n_iters is None else n_iters
         x = jnp.zeros_like(b) if x0 is None else x0
+        if self.is_smoother:
+            with telemetry.scopes.scope("smoother", self.config_name):
+                state = self.solve_init(b, x)
+                for i in range(n):
+                    x, state = self.solve_iteration(b, x, state,
+                                                    jnp.asarray(i))
+            return x
         state = self.solve_init(b, x)
         for i in range(n):
             x, state = self.solve_iteration(b, x, state, jnp.asarray(i))

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..errors import BREAKDOWN_INDEFINITE, BREAKDOWN_KRYLOV
 from ..ops import blas
 from ..ops.spmv import spmv
+from ..telemetry import scopes as _tscopes
 from .base import Solver, SolverFactory, register_solver
 
 
@@ -77,7 +78,8 @@ class _PrecondMixin:
     def _apply_M(self, r):
         if self.preconditioner is None:
             return r
-        return self.preconditioner.apply(r)
+        with _tscopes.scope("krylov", "precond"):
+            return self.preconditioner.apply(r)
 
 
 class _CGState(NamedTuple):
@@ -160,11 +162,12 @@ class CGSolver(Solver):
     def _fused_scalars(self, r, u, w):
         """gamma = (r,u), delta = (w,u) and the monitor-norm accumulators
         of r, all from ONE stacked reduction."""
-        terms = [jnp.conj(r) * u, jnp.conj(w) * u]
-        terms += blas.norm_terms(r, self.norm_type, self.Ad.block_dim,
-                                 self.use_scalar_norm)
-        acc = blas.fused_reduce(terms)
-        return acc[0], acc[1], jnp.real(acc[2:])
+        with _tscopes.scope("krylov", "reduce"):
+            terms = [jnp.conj(r) * u, jnp.conj(w) * u]
+            terms += blas.norm_terms(r, self.norm_type, self.Ad.block_dim,
+                                     self.use_scalar_norm)
+            acc = blas.fused_reduce(terms)
+            return acc[0], acc[1], jnp.real(acc[2:])
 
     # ------------------------------------------------------------ init
     def solve_init(self, b, x):
@@ -524,18 +527,19 @@ class _GMRESBase(Solver):
         solve is exact for any cycle position j.
         """
         m = self.restart
-        R = state.R[:m, :m]
-        mask = jnp.arange(m) > j
-        R = jnp.where(mask[None, :] | mask[:, None], 0.0, R)
-        R = R + jnp.diag(jnp.where(mask, 1.0, 0.0))
-        g = jnp.where(jnp.arange(m) <= j, state.g[:m], 0.0)
-        y = jax.scipy.linalg.solve_triangular(R, g, lower=False)
-        if self.flexible:
-            dx = state.Z.T @ y
-        else:
-            y = jnp.where(jnp.arange(m) <= j, y, 0.0)
-            dx = self._M(state.V[:m].T @ y)
-        return state.x_base + dx
+        with _tscopes.scope("krylov", "update"):
+            R = state.R[:m, :m]
+            mask = jnp.arange(m) > j
+            R = jnp.where(mask[None, :] | mask[:, None], 0.0, R)
+            R = R + jnp.diag(jnp.where(mask, 1.0, 0.0))
+            g = jnp.where(jnp.arange(m) <= j, state.g[:m], 0.0)
+            y = jax.scipy.linalg.solve_triangular(R, g, lower=False)
+            if self.flexible:
+                dx = state.Z.T @ y
+            else:
+                y = jnp.where(jnp.arange(m) <= j, y, 0.0)
+                dx = self._M(state.V[:m].T @ y)
+            return state.x_base + dx
 
     def solve_iteration(self, b, x, state, iter_idx):
         m = self.restart
@@ -578,28 +582,30 @@ class _GMRESBase(Solver):
         row_ok = (jnp.arange(m + 1) <= j).astype(state.V.real.dtype)
         v_j = state.V[j]
         z_j = self._M(v_j)
-        w = spmv(self.Ad, z_j)
-        # projections h_i = <v_i, w> are CONJUGATED (complex modes:
-        # jnp.conj of a real array is a no-op XLA folds away)
-        h1 = blas.gram_dots(state.V, w, row_ok)
-        w = w - state.V.T @ h1
-        if self._comm_mode() != "CLASSIC":
-            # fused Arnoldi: the second CGS2 pass and ‖w‖² ride ONE
-            # stacked matmul (3 → 2 collectives per column); after the
-            # first pass h2 is O(ε)·‖w‖, so the Pythagorean downdate
-            # ‖w − V·h2‖² = ‖w‖² − ‖h2‖² loses no accuracy in practice
-            h2, ww = blas.gram_dots_with_norm(state.V, w, row_ok)
-            w = w - state.V.T @ h2
-            h_next = jnp.sqrt(jnp.maximum(
-                ww - jnp.sum(jnp.abs(h2) ** 2), 0.0))
-        else:
-            h2 = blas.gram_dots(state.V, w, row_ok)
-            w = w - state.V.T @ h2
-            h_next = blas.nrm2(w)
-        hcol = h1 + h2              # (m+1,)
-        V = state.V.at[j + 1].set(
-            jnp.where(h_next > 0, w / jnp.where(h_next == 0, 1, h_next), 0.0))
-        hcol = hcol.at[j + 1].set(h_next)
+        with _tscopes.scope("krylov", "arnoldi"):
+            w = spmv(self.Ad, z_j)
+            # projections h_i = <v_i, w> are CONJUGATED (complex modes:
+            # jnp.conj of a real array is a no-op XLA folds away)
+            h1 = blas.gram_dots(state.V, w, row_ok)
+            w = w - state.V.T @ h1
+            if self._comm_mode() != "CLASSIC":
+                # fused Arnoldi: the second CGS2 pass and ‖w‖² ride ONE
+                # stacked matmul (3 → 2 collectives per column); after the
+                # first pass h2 is O(ε)·‖w‖, so the Pythagorean downdate
+                # ‖w − V·h2‖² = ‖w‖² − ‖h2‖² loses no accuracy in practice
+                h2, ww = blas.gram_dots_with_norm(state.V, w, row_ok)
+                w = w - state.V.T @ h2
+                h_next = jnp.sqrt(jnp.maximum(
+                    ww - jnp.sum(jnp.abs(h2) ** 2), 0.0))
+            else:
+                h2 = blas.gram_dots(state.V, w, row_ok)
+                w = w - state.V.T @ h2
+                h_next = blas.nrm2(w)
+            hcol = h1 + h2              # (m+1,)
+            V = state.V.at[j + 1].set(
+                jnp.where(h_next > 0,
+                          w / jnp.where(h_next == 0, 1, h_next), 0.0))
+            hcol = hcol.at[j + 1].set(h_next)
         Z = state.Z.at[j].set(z_j) if self.flexible else state.Z
 
         # --- apply previous Givens rotations to the new column
@@ -615,22 +621,23 @@ class _GMRESBase(Solver):
             new_i1 = jnp.where(active, -si * hi + ci * hi1, hi1)
             return hc.at[i].set(new_i).at[i + 1].set(new_i1)
 
-        hcol = jax.lax.fori_loop(0, m, rot_body, hcol)
+        with _tscopes.scope("krylov", "givens"):
+            hcol = jax.lax.fori_loop(0, m, rot_body, hcol)
 
-        # --- new Givens rotation zeroing h[j+1]
-        hj, hj1 = hcol[j], hcol[j + 1]
-        denom = jnp.sqrt(jnp.abs(hj) ** 2 + jnp.abs(hj1) ** 2)
-        safe = jnp.where(denom == 0, 1.0, denom)
-        c = jnp.where(denom == 0, jnp.ones((), hcol.dtype), hj / safe)
-        s = jnp.where(denom == 0, jnp.zeros((), hcol.dtype), hj1 / safe)
-        hcol = hcol.at[j].set(jnp.conj(c) * hj + jnp.conj(s) * hj1) \
-                   .at[j + 1].set(0.0)
-        cs = state.cs.at[j].set(c)
-        sn = state.sn.at[j].set(s)
-        gj = state.g[j]
-        g = state.g.at[j].set(jnp.conj(c) * gj).at[j + 1].set(-s * gj)
-        R = state.R.at[:, j].set(hcol)
-        quasi = jnp.abs(g[j + 1])
+            # --- new Givens rotation zeroing h[j+1]
+            hj, hj1 = hcol[j], hcol[j + 1]
+            denom = jnp.sqrt(jnp.abs(hj) ** 2 + jnp.abs(hj1) ** 2)
+            safe = jnp.where(denom == 0, 1.0, denom)
+            c = jnp.where(denom == 0, jnp.ones((), hcol.dtype), hj / safe)
+            s = jnp.where(denom == 0, jnp.zeros((), hcol.dtype), hj1 / safe)
+            hcol = hcol.at[j].set(jnp.conj(c) * hj + jnp.conj(s) * hj1) \
+                       .at[j + 1].set(0.0)
+            cs = state.cs.at[j].set(c)
+            sn = state.sn.at[j].set(s)
+            gj = state.g[j]
+            g = state.g.at[j].set(jnp.conj(c) * gj).at[j + 1].set(-s * gj)
+            R = state.R.at[:, j].set(hcol)
+            quasi = jnp.abs(g[j + 1])
 
         new_state = _GMRESState(V=V, Z=Z, R=R, g=g, cs=cs, sn=sn,
                                 x_base=state.x_base, quasi_res=quasi,

@@ -26,6 +26,15 @@ the grid-stats table:
   anatomy (residual norms at the four cut points of every cycle),
   hierarchy quality probes at setup, asymptotic convergence-factor
   estimates — gated by the ``forensics`` config knob;
+* **device-time attribution** (PR 17): :mod:`.scopes` (the versioned
+  ``amgx/<area>/<name>`` ``jax.named_scope`` contract every
+  instrumented kernel carries), :mod:`.proftrace` (shared chrome-trace
+  parsing/discovery plumbing), :mod:`.deviceprof` (the profiler-trace
+  correlator: per-level / per-pack / per-stage **measured device
+  seconds** + measured SpMV bandwidth vs the modelled roofline,
+  emitted as the ``device_anatomy`` event and
+  ``amgx_device_time_seconds_total{scope}``), and :mod:`.overlap`
+  (measured interior/halo overlap riding the same plumbing);
 * **live serving observability**: :mod:`.slo` (time-windowed
   request-outcome reservoir → attainment / error-budget burn rate /
   overload detection) and :mod:`.httpd` (in-process
@@ -39,8 +48,9 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import (costmodel, export, forensics, metrics, overlap, recorder,
-               runstate, setup_profile, slo, tracefile)
+from . import (costmodel, deviceprof, export, forensics, metrics, overlap,
+               proftrace, recorder, runstate, scopes, setup_profile, slo,
+               tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -63,6 +73,7 @@ __all__ = [
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
     "costmodel", "forensics", "setup_profile", "runstate",
     "slo", "httpd",
+    "proftrace", "scopes", "deviceprof", "overlap",
     "reset",
 ]
 

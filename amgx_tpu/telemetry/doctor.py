@@ -231,6 +231,7 @@ def diagnose(paths: List[str]) -> dict:
     dist_levels: Dict[str, dict] = {}
     agglomerations: List[dict] = []
     krylov_events: List[dict] = []
+    device_anatomy: Optional[dict] = None
     for s in agg["sessions"]:
         for r in s["records"]:
             if r["kind"] != "event":
@@ -242,6 +243,9 @@ def diagnose(paths: List[str]) -> dict:
                 agglomerations.append(dict(r["attrs"]))
             elif r["name"] == "krylov_comm":
                 krylov_events.append(dict(r["attrs"]))
+            elif r["name"] == "device_anatomy":
+                # last anatomy wins — one capture per profiled solve
+                device_anatomy = dict(r["attrs"])
     local_bytes = sum(float(d.get("bytes_per_apply") or 0)
                       for d in levels.values())
     if not local_bytes and op_cost:
@@ -733,6 +737,44 @@ def diagnose(paths: List[str]) -> dict:
                 "congestion, not compute: add serve_workers, shorten "
                 "serve_batch_window_ms, or shed earlier")
 
+    # ---- device anatomy (PR 17: telemetry/deviceprof.py) ------------
+    # host-vs-device skew: the solve span measures host DISPATCH under
+    # JAX's async execution, the anatomy measures the device — a large
+    # ratio either way is a diagnosis in itself
+    if device_anatomy and device_anatomy.get("measured"):
+        host_solve = (agg["spans"].get("solve") or {}).get("total_s")
+        dev_total = device_anatomy.get("total_device_s")
+        if isinstance(host_solve, (int, float)) \
+                and isinstance(dev_total, (int, float)) \
+                and host_solve > 0 and dev_total > 0:
+            skew = host_solve / dev_total
+            if skew > 3.0:
+                hints.append(
+                    f"host-vs-device skew: the solve span measured "
+                    f"{host_solve:.3f}s on the host but the profiler "
+                    f"saw only {dev_total:.3f}s of device time "
+                    f"({skew:.1f}×) — the solve is host/dispatch-bound "
+                    "(python overhead, retraces, blocking transfers), "
+                    "not device-bound; check amgx_jit_trace_total "
+                    "before tuning kernels")
+            elif skew < 1.0 / 3.0:
+                hints.append(
+                    f"host-vs-device skew: {dev_total:.3f}s of device "
+                    f"time behind a {host_solve:.3f}s host solve span "
+                    f"({1 / skew:.1f}×) — async dispatch returned "
+                    "before the device finished; host spans understate "
+                    "the real cost, trust the device anatomy")
+        un = device_anatomy.get("unattributed_s")
+        tot = device_anatomy.get("total_device_s")
+        if isinstance(un, (int, float)) and isinstance(tot, (int, float)) \
+                and tot > 0 and un / tot > 0.5:
+            hints.append(
+                f"device anatomy: {un / tot:.0%} of device time is "
+                "outside every amgx/* scope — work is running that the "
+                "taxonomy does not name (transfers, setup leftovers, "
+                "or an uninstrumented kernel; scripts/telemetry_check "
+                "lints registered kernels)")
+
     return {
         "files": list(paths),
         "sessions": agg["n_sessions"], "records": agg["n_records"],
@@ -757,6 +799,7 @@ def diagnose(paths: List[str]) -> dict:
             "agglomerations": agglomerations,
         },
         "krylov": krylov,
+        "device": device_anatomy,
         "serving": serving,
         "serving_lanes": lanes_diag,
         "slo": slo,
@@ -1147,6 +1190,65 @@ def render(d: dict) -> str:
                      "a jax.profiler trace (telemetry/overlap.py) for "
                      "measured ones")
 
+    dev = d.get("device")
+    if dev:
+        L.append("")
+        L.append("Device anatomy (profiler-measured device time)")
+        L.append("-" * 40)
+        if not dev.get("measured"):
+            L.append("  measured: NO — the trace carried no amgx/* "
+                     "scoped device ops (CPU backend or no profiler "
+                     "capture); numbers below are a stub")
+        tot = float(dev.get("total_device_s") or 0)
+        att = float(dev.get("attributed_s") or 0)
+        pct = f"{att / tot:.0%}" if tot > 0 else "-"
+        L.append(f"  device total {tot * 1e3:.3f} ms   attributed "
+                 f"{att * 1e3:.3f} ms ({pct})   unattributed "
+                 f"{float(dev.get('unattributed_s') or 0) * 1e3:.3f} ms"
+                 f"   [{int(dev.get('n_devices') or 0)} device(s), "
+                 f"scope contract v{dev.get('scope_version', '?')}]")
+        lv = dev.get("levels") or {}
+        if lv:
+            L.append(f"  {'level':<7}{'pre':>9}{'restrict':>10}"
+                     f"{'prolong':>9}{'post':>9}{'total':>9}  (ms)")
+
+            def _ms(row, key):
+                v = row.get(key)
+                return f"{float(v) * 1e3:>{10 if key == 'restrict' else 9}.3f}" \
+                    if isinstance(v, (int, float)) else \
+                    f"{'-':>{10 if key == 'restrict' else 9}}"
+
+            for lvl in sorted(lv, key=lambda k: int(k)):
+                row = lv[lvl]
+                L.append(f"  {lvl:<7}" + _ms(row, "pre_smooth")
+                         + _ms(row, "restrict") + _ms(row, "prolong")
+                         + _ms(row, "post_smooth") + _ms(row, "total_s"))
+        if dev.get("coarse_s"):
+            L.append(f"  coarse solve: "
+                     f"{float(dev['coarse_s']) * 1e3:.3f} ms")
+        sp = dev.get("spmv") or {}
+        if sp:
+            L.append(f"  {'spmv pack':<22}{'device ms':>11}"
+                     f"{'GB/s':>9}{'roofline':>10}")
+            for pack in sorted(sp):
+                e = sp[pack]
+                gbs = e.get("measured_gbs")
+                rf = e.get("roofline_fraction")
+                L.append(
+                    f"  {pack:<22}"
+                    f"{float(e.get('device_s') or 0) * 1e3:>11.3f}"
+                    + (f"{gbs:>9.1f}" if isinstance(gbs, (int, float))
+                       else f"{'-':>9}")
+                    + (f"{rf:>10.1%}" if isinstance(rf, (int, float))
+                       else f"{'-':>10}"))
+        for section, label in (("smoothers", "smoother"),
+                               ("krylov", "krylov stage"),
+                               ("dist", "dist")):
+            rows = dev.get(section) or {}
+            for name in sorted(rows):
+                L.append(f"  {label} {name}: "
+                         f"{float(rows[name]) * 1e3:.3f} ms")
+
     srv = d.get("serving")
     if srv:
         L.append("")
@@ -1480,11 +1582,33 @@ def diff(da: dict, db: dict) -> dict:
             f"weakest component moved: level {wa['level']} "
             f"{_COMPONENT_LABEL[wa['component']]} → level "
             f"{wb['level']} {_COMPONENT_LABEL[wb['component']]}")
+    # device anatomy A/B: per-scope measured device seconds side by
+    # side (only when BOTH traces carry a measured anatomy — comparing
+    # a measurement against a stub would read as a regression)
+    device = None
+    deva, devb = da.get("device") or {}, db.get("device") or {}
+    if deva.get("measured") and devb.get("measured"):
+        sa, sb = deva.get("scopes") or {}, devb.get("scopes") or {}
+        device = {
+            "total_device_s": {"a": deva.get("total_device_s"),
+                               "b": devb.get("total_device_s")},
+            "scopes": {s: {"a": sa.get(s), "b": sb.get(s)}
+                       for s in sorted(set(sa) | set(sb))},
+        }
+        for s, v in device["scopes"].items():
+            a, b = v["a"], v["b"]
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                    and a > 0 and (b / a >= 1.5 or b / a <= 1 / 1.5) \
+                    and max(a, b) * 1e3 >= 1.0:    # ignore sub-ms noise
+                word = "worsened" if b > a else "improved"
+                drifts.append(f"device time {s} {word} "
+                              f"{a * 1e3:.2f} → {b * 1e3:.2f} ms")
     return {"a": da["files"], "b": db["files"],
             "convergence": {k: pair(k) for k in
                             ("iterations", "final_relres", "rate",
                              "asymptotic_rate")},
             "rows": rows, "phases": phases, "levels": levels,
+            "device": device,
             "drifts": drifts}
 
 
@@ -1547,6 +1671,19 @@ def render_diff(dd: dict) -> str:
         for k, v in dd["phases"].items():
             L.append(f"  {k:<10} {_fmt_num(v['a'], '.4f'):>10} vs "
                      f"{_fmt_num(v['b'], '.4f'):>10}")
+    if dd.get("device"):
+        L.append("")
+        L.append("device anatomy (A vs B, measured device ms)")
+        L.append("-" * 40)
+        t = dd["device"]["total_device_s"]
+        L.append(f"  {'total':<34}"
+                 f"{_fmt_num((t['a'] or 0) * 1e3):>10} vs "
+                 f"{_fmt_num((t['b'] or 0) * 1e3):>10}")
+        for s, v in dd["device"]["scopes"].items():
+            a = (v["a"] or 0) * 1e3 if v["a"] is not None else None
+            b = (v["b"] or 0) * 1e3 if v["b"] is not None else None
+            L.append(f"  {s:<34}{_fmt_num(a):>10} vs "
+                     f"{_fmt_num(b):>10}")
     L.append("")
     if dd["drifts"]:
         L.append("drifts")

@@ -226,6 +226,38 @@ def validate_record(rec: dict):
             # overlap number must say which it is
             need(isinstance(a.get("measured"), bool),
                  "dist_overlap event missing measured bool")
+        if rec["name"] == "device_anatomy":
+            # the device-time cycle anatomy (telemetry/deviceprof.py):
+            # every scope key must honour the naming contract, and the
+            # event must say whether it is profiler truth or a stub
+            a = rec["attrs"]
+            need(isinstance(a.get("measured"), bool),
+                 "device_anatomy event missing measured bool")
+            need(isinstance(a.get("scope_version"), int)
+                 and a["scope_version"] >= 1,
+                 "device_anatomy event missing scope_version")
+            for k in ("total_device_s", "attributed_s",
+                      "unattributed_s"):
+                need(isinstance(a.get(k), (int, float))
+                     and not isinstance(a.get(k), bool)
+                     and a[k] >= 0,
+                     f"device_anatomy event missing numeric {k}")
+            sc = a.get("scopes")
+            need(isinstance(sc, dict),
+                 "device_anatomy event missing scopes dict")
+            if isinstance(sc, dict):
+                from . import scopes as _scopes
+                for name, sec in sc.items():
+                    need(_scopes.validate(name),
+                         f"device_anatomy scope {name!r} violates the "
+                         f"amgx/<area>/<name> contract")
+                    need(isinstance(sec, (int, float))
+                         and not isinstance(sec, bool) and sec >= 0,
+                         f"device_anatomy scope {name!r} has "
+                         f"non-numeric seconds")
+            for k in ("levels", "spmv"):
+                need(isinstance(a.get(k), dict),
+                     f"device_anatomy event missing {k} dict")
         if rec["name"] == "dist_agglomerate":
             # agglomeration decisions (distributed/agglomerate.py):
             # the doctor's sub-mesh lifecycle input

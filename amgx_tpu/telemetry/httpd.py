@@ -95,12 +95,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "/statusz": self._statusz,
                 "/debug/trace": self._debug_trace,
                 "/debug/profile": self._debug_profile,
+                "/debug/deviceprof": self._debug_deviceprof,
             }.get(url.path)
             if route is None:
                 self._json(404, {"error": f"no route {url.path}",
                                  "routes": ["/metrics", "/healthz",
                                             "/statusz", "/debug/trace",
-                                            "/debug/profile"]})
+                                            "/debug/profile",
+                                            "/debug/deviceprof"]})
                 return
             route(q)
         except BrokenPipeError:
@@ -162,14 +164,32 @@ class _Handler(BaseHTTPRequestHandler):
                     "application/x-ndjson")
 
     def _debug_profile(self, q):
+        out = self._capture_profile(q)
+        self._json(out.pop("_code", 200), out)
+
+    def _debug_deviceprof(self, q):
+        # the device anatomy riding the same one-shot capture: profile,
+        # correlate, return JUST the anatomy (plus the trace dir so a
+        # deeper offline look stays possible)
+        out = self._capture_profile(q)
+        code = out.pop("_code", 200)
+        if code != 200:
+            self._json(code, out)
+            return
+        self._json(200, {"dir": out["dir"], "seconds": out["seconds"],
+                         "device_anatomy": out.get("device_anatomy")})
+
+    def _capture_profile(self, q) -> dict:
+        """One-shot profiler capture + parsed summaries.  Returns the
+        reply dict (``_code`` carries a non-200 status)."""
         seconds = _qfloat(q, "seconds")
         if seconds is None:          # absent/unparsable — NOT ?seconds=0,
             seconds = 1.0            # which clamps to PROFILE_MIN_S below
         seconds = min(max(seconds, PROFILE_MIN_S), PROFILE_MAX_S)
         if not _profile_lock.acquire(blocking=False):
-            self._json(409, {"error": "a profiler capture is already "
-                                      "running; retry when it ends"})
-            return
+            return {"_code": 409,
+                    "error": "a profiler capture is already "
+                             "running; retry when it ends"}
         try:
             import jax
             out_dir = tempfile.mkdtemp(prefix="amgx_profile_")
@@ -182,10 +202,22 @@ class _Handler(BaseHTTPRequestHandler):
                 time.sleep(seconds)
             finally:
                 jax.profiler.stop_trace()
-            self._json(200, {"dir": out_dir,
-                             "seconds": round(seconds, 3),
-                             "wall_s": round(time.perf_counter() - t0,
-                                             3)})
+            out = {"dir": out_dir,
+                   "seconds": round(seconds, 3),
+                   "wall_s": round(time.perf_counter() - t0, 3)}
+            # inline parsed views of the capture (best-effort: a trace
+            # with no device ops yields the measured=False stub, and a
+            # parse failure must never take the endpoint down)
+            try:
+                from . import deviceprof, overlap
+                trace = overlap.find_trace_file(out_dir)
+                out["device_anatomy"] = deviceprof.capture_anatomy(
+                    trace if trace is not None else {"traceEvents": []})
+                out["overlap"] = overlap.measure(
+                    trace if trace is not None else {"traceEvents": []})
+            except Exception as e:   # noqa: BLE001 — summary is extra
+                out["parse_error"] = f"{type(e).__name__}: {e}"
+            return out
         finally:
             _profile_lock.release()
 

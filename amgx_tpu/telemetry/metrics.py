@@ -28,6 +28,10 @@ METRICS: Dict[str, Tuple[str, str]] = {
                     "to a generic path {pack,reason}"),
     "amgx_jit_trace_total":
         ("counter", "jax.jit python-cache misses (retraces), process-wide"),
+    "amgx_device_time_seconds_total":
+        ("counter", "profiler-measured device seconds attributed to a "
+                    "named-scope contract scope (telemetry/deviceprof.py) "
+                    "{scope}"),
     "amgx_jit_compile_total":
         ("counter", "XLA backend compiles (jit recompiles), process-wide"),
     "amgx_solves_total":

@@ -21,76 +21,19 @@ numbers).
 """
 from __future__ import annotations
 
-import gzip
-import json
-import os
-import re
 from typing import Iterable, List, Optional
 
-#: XLA op-name fragments that mean inter-chip communication.  HLO names
-#: keep their kind as a prefix ("all-reduce.1", "fusion.all_gather", …)
-#: across XLA versions; matching fragments is robust to the separators.
-_COMM_RE = re.compile(
-    r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|"
-    r"collective[-_]?permute|all[-_]?to[-_]?all|ppermute|psum",
-    re.IGNORECASE)
+from . import proftrace
 
-#: trace-viewer metadata / host-side bookkeeping phases that are not
-#: device work at all
-_SKIP_PH = {"M", "I", "C"}
-
-
-def _load_json(path: str) -> Optional[dict]:
-    opener = gzip.open if path.endswith(".gz") else open
-    try:
-        with opener(path, "rt") as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
-
-
-def find_trace_file(path: str) -> Optional[str]:
-    """Resolve a trace argument to a concrete chrome-trace file.
-
-    Accepts the file itself (``.trace.json`` / ``.trace.json.gz`` or any
-    ``.json``) or a profiler log directory, which is searched recursively
-    (``jax.profiler.trace`` writes ``plugins/profile/<run>/
-    <host>.trace.json.gz``); the newest match wins.
-    """
-    if os.path.isfile(path):
-        return path
-    if not os.path.isdir(path):
-        return None
-    hits: List[str] = []
-    for root, _dirs, files in os.walk(path):
-        for f in files:
-            if f.endswith((".trace.json", ".trace.json.gz")):
-                hits.append(os.path.join(root, f))
-    if not hits:
-        return None
-    return max(hits, key=lambda p: os.path.getmtime(p))
-
-
-def _merge_intervals(iv: List[tuple]) -> List[tuple]:
-    iv = sorted(iv)
-    out: List[tuple] = []
-    for s, e in iv:
-        if out and s <= out[-1][1]:
-            out[-1] = (out[-1][0], max(out[-1][1], e))
-        else:
-            out.append((s, e))
-    return out
-
-
-def _overlap_len(s: float, e: float, merged: List[tuple]) -> float:
-    total = 0.0
-    for ms, me in merged:
-        if me <= s:
-            continue
-        if ms >= e:
-            break
-        total += min(e, me) - max(s, ms)
-    return total
+# the discovery/parsing mechanics live in the shared proftrace module
+# (deviceprof consumes the same plumbing); these aliases keep this
+# module's historical private names working
+_COMM_RE = proftrace.COMM_RE
+_SKIP_PH = proftrace.SKIP_PH
+_load_json = proftrace.load_json
+find_trace_file = proftrace.find_trace_file
+_merge_intervals = proftrace.merge_intervals
+_overlap_len = proftrace.overlap_len
 
 
 def measure(trace: "str | dict | Iterable[dict]") -> Optional[dict]:
@@ -103,26 +46,12 @@ def measure(trace: "str | dict | Iterable[dict]") -> Optional[dict]:
     time concurrent with same-device compute), ``comm_s`` /
     ``compute_s`` totals, ``n_comm_events`` and ``n_devices``.
     """
-    if isinstance(trace, str):
-        f = find_trace_file(trace)
-        data = _load_json(f) if f else None
-        if data is None:
-            return None
-        events = data.get("traceEvents", [])
-    elif isinstance(trace, dict):
-        events = trace.get("traceEvents", [])
-    else:
-        events = list(trace)
+    events = proftrace.trace_events(trace)
 
     comm: dict = {}      # pid -> [(start, end)]
     compute: dict = {}   # pid -> [(start, end)]
-    for ev in events:
-        if ev.get("ph", "X") in _SKIP_PH:
-            continue
-        dur = ev.get("dur")
-        ts = ev.get("ts")
-        if dur is None or ts is None or dur <= 0:
-            continue
+    for ev in proftrace.complete_slices(events):
+        ts, dur = ev["ts"], ev["dur"]
         pid = ev.get("pid", 0)
         name = str(ev.get("name", ""))
         bucket = comm if _COMM_RE.search(name) else compute

@@ -1,0 +1,136 @@
+"""Shared ``jax.profiler`` chrome-trace plumbing.
+
+Both profiler-trace consumers — :mod:`amgx_tpu.telemetry.overlap`
+(measured comm-vs-compute overlap) and
+:mod:`amgx_tpu.telemetry.deviceprof` (device-time cycle anatomy) —
+need the same mechanics: resolve a profiler logdir to its newest
+``plugins/profile/<run>/<host>.trace.json[.gz]`` capture, load the
+(possibly gzipped) JSON, normalise the three accepted trace spellings
+(path / loaded dict / raw event iterable) to an event list, and do
+interval arithmetic over complete ("X") slices.  This module is that
+single copy; host-side file parsing only, safe without any profiler
+plugin installed.
+"""
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+from typing import Iterable, Iterator, List, Optional
+
+#: XLA op-name fragments that mean inter-chip communication.  HLO names
+#: keep their kind as a prefix ("all-reduce.1", "fusion.all_gather", …)
+#: across XLA versions; matching fragments is robust to the separators.
+COMM_RE = re.compile(
+    r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|"
+    r"collective[-_]?permute|all[-_]?to[-_]?all|ppermute|psum",
+    re.IGNORECASE)
+
+#: trace-viewer metadata / host-side bookkeeping phases that are not
+#: device work at all
+SKIP_PH = {"M", "I", "C"}
+
+
+def load_json(path: str) -> Optional[dict]:
+    """Load a chrome-trace JSON file (gzip-aware); None on any error."""
+    opener = gzip.open if path.endswith(".gz") else open
+    try:
+        with opener(path, "rt") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def find_trace_file(path: str) -> Optional[str]:
+    """Resolve a trace argument to a concrete chrome-trace file.
+
+    Accepts the file itself (``.trace.json`` / ``.trace.json.gz`` or any
+    ``.json``) or a profiler log directory, which is searched recursively
+    (``jax.profiler.trace`` writes ``plugins/profile/<run>/
+    <host>.trace.json.gz``); the newest match wins.
+    """
+    if os.path.isfile(path):
+        return path
+    if not os.path.isdir(path):
+        return None
+    hits: List[str] = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            if f.endswith((".trace.json", ".trace.json.gz")):
+                hits.append(os.path.join(root, f))
+    if not hits:
+        return None
+    return max(hits, key=lambda p: os.path.getmtime(p))
+
+
+def trace_events(trace: "str | dict | Iterable[dict]") -> List[dict]:
+    """Normalise any accepted trace spelling to its event list.
+
+    ``trace``: a path (file or profiler logdir), a loaded chrome-trace
+    dict, or an iterable of trace events.  Returns ``[]`` when the path
+    resolves to nothing or the file is unreadable/malformed — callers
+    then degrade the same way they would on an empty capture.
+    """
+    if isinstance(trace, str):
+        f = find_trace_file(trace)
+        data = load_json(f) if f else None
+        if data is None:
+            return []
+        ev = data.get("traceEvents", [])
+        return ev if isinstance(ev, list) else []
+    if isinstance(trace, dict):
+        ev = trace.get("traceEvents", [])
+        return ev if isinstance(ev, list) else []
+    try:
+        return list(trace)
+    except TypeError:           # None, int, ... — nothing to measure
+        return []
+
+
+def complete_slices(events: Iterable[dict]) -> Iterator[dict]:
+    """The complete ("X") duration slices of a trace: every event that
+    carries real wall extent (metadata/instant/counter phases and
+    zero/None-duration rows are dropped).  Malformed rows (non-dict, or
+    non-numeric ts/dur) are skipped rather than raised — profiler traces
+    in the wild carry junk."""
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        if ev.get("ph", "X") in SKIP_PH:
+            continue
+        dur = ev.get("dur")
+        ts = ev.get("ts")
+        if not isinstance(dur, (int, float)) or \
+                not isinstance(ts, (int, float)) or dur <= 0:
+            continue
+        yield ev
+
+
+def merge_intervals(iv: List[tuple]) -> List[tuple]:
+    """Coalesce (start, end) intervals into a sorted disjoint cover."""
+    iv = sorted(iv)
+    out: List[tuple] = []
+    for s, e in iv:
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def overlap_len(s: float, e: float, merged: List[tuple]) -> float:
+    """Length of [s, e) covered by a :func:`merge_intervals` result."""
+    total = 0.0
+    for ms, me in merged:
+        if me <= s:
+            continue
+        if ms >= e:
+            break
+        total += min(e, me) - max(s, ms)
+    return total
+
+
+def union_len(iv: List[tuple]) -> float:
+    """Total length of the union of (start, end) intervals."""
+    return sum(e - s for s, e in merge_intervals(iv))

@@ -102,6 +102,22 @@ def _session_events(records: List[dict], pid: int, offset_s: float,
                 "tid": r["tid"], "ts": ts(r["t"]), "s": "t",
                 "args": _args(r.get("attrs", {})),
             })
+            if r["name"] == "device_anatomy":
+                # the device-time anatomy additionally draws one counter
+                # track per attributed scope (seconds of measured device
+                # time) so the split is plottable next to the host spans
+                sc = (r.get("attrs") or {}).get("scopes")
+                if isinstance(sc, dict):
+                    for sname, sec in sorted(sc.items()):
+                        if isinstance(sec, (int, float)) \
+                                and not isinstance(sec, bool):
+                            out.append({
+                                "ph": "C",
+                                "name": f"device_s {sname}",
+                                "pid": pid, "tid": 0,
+                                "ts": ts(r["t"]),
+                                "args": {"value": sec},
+                            })
         elif kind in ("counter", "gauge", "hist"):
             v = r["value"]
             if isinstance(v, str):      # "Infinity" tokens: not plottable

@@ -195,8 +195,30 @@ def load_rounds(repo_dir: str):
                     "values": {label: fn(parsed)
                                for label, fn in CASES},
                     "setup_profile": _setup_detail(parsed),
-                    "warm_start": _warm_detail(parsed)})
+                    "warm_start": _warm_detail(parsed),
+                    "device": _device_detail(parsed)})
     return out
+
+
+def _device_detail(parsed: dict):
+    """Top-2 device-time scopes of one round's profiler-measured
+    ``device_anatomy`` block (ISSUE 17); None on pre-PR-17 rounds, on
+    failed captures and on measured=false stubs (CPU rounds — there is
+    no device time to rank)."""
+    da = (parsed.get("extras") or {}).get("device_anatomy")
+    if not isinstance(da, dict) or "error" in da \
+            or da.get("measured") is not True:
+        return None
+    sc = da.get("scopes")
+    if not isinstance(sc, dict):
+        return None
+    top = sorted(((k, v) for k, v in sc.items()
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool)),
+                 key=lambda kv: -kv[1])[:2]
+    if not top:
+        return None
+    return {"top": top, "total_device_s": da.get("total_device_s")}
 
 
 def _warm_detail(parsed: dict):
@@ -260,6 +282,17 @@ def render(rounds) -> str:
                     isinstance(m_, (int, float)) and h + m_:
                 parts.append(f"cc-hit {h / (h + m_):.0%}")
             L.append("        warm_start: " + " · ".join(parts))
+        # device-time annotation (ISSUE-17 rounds with a profiler
+        # capture): where the accelerator actually spent the round —
+        # the top-2 measured scopes; CPU stub rounds have no line
+        dv = r.get("device")
+        if dv:
+            tops = " · ".join(f"{k} {v * 1e3:.3g} ms"
+                              for k, v in dv["top"])
+            tot = dv.get("total_device_s")
+            L.append(f"        device: {tops}"
+                     + (f" (total {tot * 1e3:.3g} ms)"
+                        if isinstance(tot, (int, float)) else ""))
     usable = [r for r in rounds if r["usable"]]
     L.append("")
     L.append(f"{len(usable)}/{len(rounds)} rounds usable")

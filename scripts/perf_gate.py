@@ -159,13 +159,14 @@ def load_round(path: str) -> dict:
                           "solve_s": parsed.get("value"),
                           "iterations": extras.get("iterations")}}
     for name, d in extras.items():
-        # telemetry/serving/distributed are per-round observability
-        # blocks, not solve cases — their numeric fields must not
-        # become baselines (distributed feeds the gate through its
-        # weak_eff floor below)
+        # telemetry/serving/distributed/device_anatomy are per-round
+        # observability blocks, not solve cases — their numeric fields
+        # must not become baselines (distributed feeds the gate through
+        # its weak_eff floor below; device_anatomy is checked for
+        # schema shape below, never ratcheted)
         if not isinstance(d, dict) or "error" in d or \
                 name in ("telemetry", "serving", "distributed",
-                         "spmv_gflops_by_format"):
+                         "spmv_gflops_by_format", "device_anatomy"):
             continue
         vals = {k: d.get(k) for k, _ in TRACKED
                 if isinstance(d.get(k), (int, float))}
@@ -211,7 +212,53 @@ def load_round(path: str) -> dict:
                 if isinstance(ab.get(k), (int, float))}
         if vals:
             cases["krylov_comm"] = vals
+    # device-time anatomy (ISSUE 17): best-effort — the block is never
+    # a baseline and --update never ratchets it (a CPU round honestly
+    # reports measured=false, and profiler availability varies).  But a
+    # PRESENT block must keep the device_anatomy schema shape, so a
+    # corrupted emitter cannot archive garbage unnoticed
+    da = extras.get("device_anatomy")
+    if isinstance(da, dict) and "error" not in da:
+        probs = device_anatomy_problems(da)
+        if probs:
+            raise ValueError(f"{path}: device_anatomy block violates "
+                             f"its schema: {'; '.join(probs)}")
     return cases
+
+
+#: contract shape of a device-time scope name (telemetry/scopes.py):
+#: amgx/<area>/<segment...> in the [a-z0-9_] segment alphabet
+_SCOPE_SHAPE_RE = re.compile(r"\Aamgx(?:/[a-z0-9_]+){2,}\Z")
+
+
+def device_anatomy_problems(da: dict) -> list:
+    """Structural problems of a round's ``device_anatomy`` extras block
+    (empty list when sound).  Mirrors the telemetry validator's event
+    schema without importing the package: ``measured`` provenance bool,
+    non-negative second totals, contract-shaped scope keys with numeric
+    values."""
+    probs = []
+    if not isinstance(da.get("measured"), bool):
+        probs.append("measured is not a bool")
+    for k in ("total_device_s", "attributed_s", "unattributed_s"):
+        v = da.get(k)
+        if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                or v < 0:
+            probs.append(f"{k} is not a non-negative number")
+    sc = da.get("scopes")
+    if sc is not None and not isinstance(sc, dict):
+        probs.append("scopes is not a dict")
+    elif isinstance(sc, dict):
+        bad = sorted(str(s) for s in sc
+                     if not _SCOPE_SHAPE_RE.match(str(s)))
+        if bad:
+            probs.append(f"non-contract scope keys: {bad[:4]}")
+        badv = sorted(str(s) for s, v in sc.items()
+                      if isinstance(v, bool)
+                      or not isinstance(v, (int, float)))
+        if badv:
+            probs.append(f"non-numeric scope seconds: {badv[:4]}")
+    return probs
 
 
 def compare(baseline: dict, cases: dict, time_ratio=None,

@@ -77,6 +77,9 @@ def pytest_configure(config):
         "markers", "krylov_comm: communication-avoiding Krylov fast "
                    "tests (tier-1; pytest -m krylov_comm selects "
                    "just these)")
+    config.addinivalue_line(
+        "markers", "deviceprof: device-time attribution fast tests "
+                   "(tier-1; pytest -m deviceprof selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.
@@ -108,3 +111,70 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(1234)
+
+
+def synthetic_trace_events():
+    """One synthetic ``jax.profiler`` chrome trace, shared by the
+    overlap tests (test_krylov_comm.py) and the device-time attribution
+    tests (test_deviceprof.py).
+
+    Shape (all on pid 0, times in µs):
+
+    * scoped device ops covering two OVERLAPPING cycle levels (level 1
+      runs on tid 2 concurrently with level 0's prolong/post work),
+      a coarse solve, a nested smoother+SpMV annotation stack, a
+      scope-annotated all-reduce (krylov/reduce) and collective-permute
+      (dist/halo_exchange);
+    * one UNscoped compute op (``copy.9`` — the missing-scope case);
+    * malformed entries every parser must skip: a sliceless metadata
+      event, a counter event, an event without ``dur``, one with
+      non-numeric times, and a non-dict entry.
+
+    Ground truth: total device time 330 µs (union), attributed 320 µs;
+    level 0 {pre 100, restrict 50, prolong 60, post 40, union 250},
+    level 1 {pre 40, post 30, union 70}, coarse 20; spmv dia/slices
+    100, smoother block_jacobi 100, krylov reduce 30, dist
+    halo_exchange 20.  Overlap view: comm 50 µs of which 30 hidden
+    under compute → fraction 0.6, compute 310 µs.
+    """
+    pre0 = ("amgx/cycle/level0/pre_smooth/amgx/smoother/block_jacobi/"
+            "amgx/spmv/dia/slices/fusion.1")
+    return [
+        {"ph": "M", "pid": 0, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "C", "pid": 0, "ts": 0, "name": "counter",
+         "args": {"v": 1}},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 0, "dur": 100,
+         "name": "fusion.1", "args": {"name": pre0}},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 100, "dur": 50,
+         "name": "amgx/cycle/level0/restrict/fusion.2"},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 150, "dur": 60,
+         "name": "amgx/cycle/level0/prolong/fusion.3"},
+        {"ph": "X", "pid": 0, "tid": 2, "ts": 150, "dur": 40,
+         "name": "amgx/cycle/level1/pre_smooth/fusion.4"},
+        {"ph": "X", "pid": 0, "tid": 2, "ts": 190, "dur": 30,
+         "name": "amgx/cycle/level1/post_smooth/fusion.5"},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 210, "dur": 40,
+         "name": "amgx/cycle/level0/post_smooth/fusion.6"},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 250, "dur": 20,
+         "name": "amgx/cycle/coarse_solve/fusion.7"},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 270, "dur": 30,
+         "name": "all-reduce.8",
+         "args": {"name": "amgx/krylov/reduce/all-reduce.8"}},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 280, "dur": 40,
+         "name": "copy.9"},
+        {"ph": "X", "pid": 0, "tid": 1, "ts": 310, "dur": 20,
+         "name": "collective-permute.10",
+         "args": {"name": "amgx/dist/halo_exchange/"
+                          "collective-permute.10"}},
+        {"ph": "X", "pid": 0, "ts": 1, "name": "no-dur"},
+        {"ph": "X", "pid": 0, "ts": "x", "dur": "y", "name": "bad"},
+        "not-a-dict",
+    ]
+
+
+@pytest.fixture
+def chrome_trace():
+    """The shared synthetic profiler trace as a loaded chrome-trace
+    dict (see :func:`synthetic_trace_events` for the ground truth)."""
+    return {"traceEvents": synthetic_trace_events()}

@@ -362,56 +362,49 @@ def test_capi_knob_passthrough():
 
 
 # ------------------------------------------------------ measured overlap
-def _trace_events():
-    # pid 0: compute [0, 100) us, all-reduce [50, 150) us → half the
-    # comm wall time is hidden behind compute
-    return [
-        {"ph": "X", "pid": 0, "tid": 1, "name": "fusion.23",
-         "ts": 0.0, "dur": 100.0},
-        {"ph": "X", "pid": 0, "tid": 2, "name": "all-reduce.1",
-         "ts": 50.0, "dur": 100.0},
-        {"ph": "M", "pid": 0, "name": "process_name"},
-    ]
-
-
-def test_overlap_measure_synthetic_trace():
-    m = overlap.measure({"traceEvents": _trace_events()})
+# the synthetic profiler capture is SHARED with test_deviceprof.py
+# (tests/conftest.py: chrome_trace / synthetic_trace_events) — ground
+# truth there: comm 50 µs, 30 µs hidden under compute → fraction 0.6,
+# compute 310 µs, 2 comm events, 1 device
+def test_overlap_measure_synthetic_trace(chrome_trace):
+    m = overlap.measure(chrome_trace)
     assert m is not None
-    assert m["overlap_fraction"] == pytest.approx(0.5)
-    assert m["comm_s"] == pytest.approx(100e-6)
-    assert m["compute_s"] == pytest.approx(100e-6)
-    assert m["n_comm_events"] == 1 and m["n_devices"] == 1
+    assert m["overlap_fraction"] == pytest.approx(0.6)
+    assert m["comm_s"] == pytest.approx(50e-6)
+    assert m["compute_s"] == pytest.approx(310e-6)
+    assert m["n_comm_events"] == 2 and m["n_devices"] == 1
     # no comm ops → nothing to measure, keep the model
-    assert overlap.measure({"traceEvents": _trace_events()[:1]}) is None
+    assert overlap.measure({"traceEvents": [
+        {"ph": "X", "pid": 0, "tid": 1, "name": "fusion.23",
+         "ts": 0.0, "dur": 100.0}]}) is None
     assert overlap.refine_captured([{"level": 0}],
                                    {"traceEvents": []}) == []
 
 
-def test_overlap_trace_file_discovery(tmp_path):
+def test_overlap_trace_file_discovery(tmp_path, chrome_trace):
     """find_trace_file digs the newest .trace.json.gz out of a profiler
     logdir layout and measure() parses it."""
     run = tmp_path / "plugins" / "profile" / "run1"
     run.mkdir(parents=True)
     p = run / "host.trace.json.gz"
     with gzip.open(p, "wt") as f:
-        json.dump({"traceEvents": _trace_events()}, f)
+        json.dump(chrome_trace, f)
     found = overlap.find_trace_file(str(tmp_path))
     assert found == str(p)
     m = overlap.measure(str(tmp_path))
-    assert m and m["overlap_fraction"] == pytest.approx(0.5)
+    assert m and m["overlap_fraction"] == pytest.approx(0.6)
 
 
-def test_measured_event_flips_provenance_and_validates():
+def test_measured_event_flips_provenance_and_validates(chrome_trace):
     base = {"level": 0, "n_parts": 8, "active_parts": 8,
             "submesh_parts": 8, "rows": 4096, "rows_per_part": 512,
             "interior_bytes": 1 << 20, "halo_wire_bytes": 1 << 14,
             "halo_local_ratio": 0.02, "est_interior_s": 1e-5,
             "est_halo_s": 2e-6, "overlap_fraction": 0.4,
             "halo_bound": False, "measured": False}
-    meas = overlap.measured_event(
-        base, overlap.measure({"traceEvents": _trace_events()}))
+    meas = overlap.measured_event(base, overlap.measure(chrome_trace))
     assert meas["measured"] is True
-    assert meas["overlap_fraction"] == pytest.approx(0.5)
+    assert meas["overlap_fraction"] == pytest.approx(0.6)
     telemetry.validate_record(
         {"kind": "event", "name": "dist_overlap", "seq": 1, "t": 0.0,
          "tid": 0, "sid": None, "attrs": meas})
