@@ -226,6 +226,7 @@ class AMGHierarchy:
             self.levels = []
             raise
         self.setup_time = time.perf_counter() - t0
+        self._register_memledger()
         if telemetry.is_enabled():
             self._emit_telemetry()
             if self.forensics:
@@ -1645,6 +1646,63 @@ class AMGHierarchy:
                         operator_complexity=round(op_cmpl, 6),
                         grid_complexity=round(grid_cmpl, 6),
                         setup_s=round(self.setup_time, 6))
+
+    def _register_memledger(self):
+        """HBM-ledger ownership registration (telemetry/memledger.py):
+        one entry per materialised level pack
+        (``amgx/hierarchy/level<N>``), per P/R transfer pack
+        (``amgx/transfer/level<N>``), per smoother's device state
+        (``amgx/smoother/level<N>`` — ``dinv``, DILU ``Einv``, ILU
+        factors) and the coarse solver's factors
+        (``amgx/coarse/solver``).  Re-registration on re-setup releases
+        the previous tokens first, so the register/release balance holds
+        across setup→resetup→teardown.  One attribute check when the
+        ledger is off; never triggers an upload (reads only packs that
+        already exist)."""
+        from ..telemetry import memledger as ml
+        if not ml.is_enabled():
+            return
+        for tok in getattr(self, "_ml_tokens", ()):
+            ml.release(tok)
+        toks = self._ml_tokens = []
+
+        def reg(owner, name, tree):
+            if tree:
+                try:
+                    toks.append(ml.register(ml.owner_name(owner, name),
+                                            tree))
+                except Exception:
+                    pass    # the ledger must never break setup
+
+        packs = self._materialized_packs()
+        for i, Ad in enumerate(packs[:-1]):
+            if Ad is not None:
+                reg("hierarchy", f"level{i}", Ad)
+        if packs and packs[-1] is not None:
+            reg("hierarchy", "coarse", packs[-1])
+        for i, lvl in enumerate(self.levels):
+            pr = {k: v for k, v in (("p", getattr(lvl, "_Pd", None)),
+                                    ("r", getattr(lvl, "_Rd", None)))
+                  if v is not None}
+            reg("transfer", f"level{i}", pr)
+            sm = lvl.smoother
+            if sm is not None:
+                st = {k: v for k in ("dinv", "Einv", "dinv_f")
+                      if (v := getattr(sm, k, None)) is not None}
+                reg("smoother", f"level{i}", st)
+        cs = self.coarse_solver
+        if cs is not None:
+            st = {k: v for k in ("_lu", "_piv", "dinv", "Einv",
+                                 "dinv_f")
+                  if (v := getattr(cs, k, None)) is not None}
+            reg("coarse", "solver", st)
+
+    def release_memledger(self):
+        """Drop this hierarchy's ledger registrations (teardown)."""
+        from ..telemetry import memledger as ml
+        for tok in getattr(self, "_ml_tokens", ()):
+            ml.release(tok)
+        self._ml_tokens = []
 
     def _materialized_packs(self) -> list:
         """Per-level device packs WHERE THEY ALREADY EXIST (never

@@ -340,6 +340,17 @@ def register_default_parameters():
     R("setup_profile", int, 0,
       "enable setup attribution (phase tree, compile/transfer split, "
       "HBM watermarks)", _BOOL)
+    # HBM ledger (telemetry/memledger.py): device-memory ownership
+    # attribution (registry + live-array census + backend memory_stats)
+    # with hbm_snapshot sampling and oom_postmortem bundles.  Off by
+    # default: registration sites then pay one attribute check and
+    # solve traces are byte-identical (zero-overhead contract)
+    R("memledger", int, 0,
+      "enable the HBM ledger (device-memory ownership attribution, "
+      "hbm_snapshot sampling, OOM post-mortems)", _BOOL)
+    R("memledger_sample_s", float, 0.5,
+      "min seconds between hbm_snapshot samples at phase boundaries "
+      "(0 = sample at every boundary)")
     # device-side setup engine (amg/device_setup/ + ops/spgemm.py):
     # pattern-keyed Galerkin RAP executables — host-symbolic once,
     # device-numeric under jit with zero recompiles on resetup.  Host

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import weakref
 from typing import Optional
 
 import jax
@@ -176,6 +177,25 @@ def _auto_mesh(mesh: Mesh) -> Mesh:
                 axis_types=(auto,) * len(mesh.axis_names))
 
 
+def _ml_register_pack(pack: "ShardedMatrix", kind: str) -> "ShardedMatrix":
+    """HBM-ledger registration of a freshly built sharded pack (owner
+    ``amgx/dist/<kind>`` — device values plus halo/B2L exchange maps).
+    A weakref finalizer releases the ledger entry when the pack dies,
+    so the builders need no explicit teardown hook; never raises."""
+    from .. import telemetry
+    ml = telemetry.memledger
+    if not ml.is_enabled():
+        return pack
+    tok = None
+    try:
+        tok = ml.register(ml.owner_name("dist", kind), pack)
+        if tok is not None:
+            weakref.finalize(pack, ml.release, tok)
+    except Exception:
+        ml.release(tok)
+    return pack
+
+
 def shard_matrix(A: sp.csr_matrix, mesh: Mesh, axis: str = "p",
                  dtype=None, offsets=None, n_loc: Optional[int] = None,
                  partition: Optional[Partition] = None) -> ShardedMatrix:
@@ -316,7 +336,7 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
                   halo_src=np.zeros((n_parts, 1), np.int32),
                   halo_count=np.zeros(n_parts, np.int32),
                   halo_global=[np.zeros(0, np.int64)] * n_parts)
-    return ShardedMatrix(
+    return _ml_register_pack(ShardedMatrix(
         cols=jax.device_put(cols, spec3),
         vals=jax.device_put(vals, spec3),
         diag=jax.device_put(diag.reshape(-1), spec1),
@@ -341,7 +361,8 @@ def shard_matrix_from_blocks(blocks, offsets, mesh: Mesh, axis: str = "p",
         send_counts=tuple(int(c) for c in part.send_count),
         halo_counts=tuple(int(c) for c in part.halo_count),
         halo_counts2=tuple(int(c) for c in r2.halo_count),
-        bnd_counts=tuple(int(c) for c in part.bnd_count))
+        bnd_counts=tuple(int(c) for c in part.bnd_count)),
+        "rect_pack" if rect else "shard_pack")
 
 
 def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
@@ -413,7 +434,7 @@ def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
     spec2 = NamedSharding(mesh, P(axis, None))
     spec1 = NamedSharding(mesh, P(axis))
     r2 = part.rings[1]
-    return ShardedMatrix(
+    return _ml_register_pack(ShardedMatrix(
         cols=jax.device_put(cols, spec3),
         vals=jax.device_put(vals, spec5),
         diag=jax.device_put(diag.reshape(-1, b, b), spec1),
@@ -429,7 +450,8 @@ def shard_block_matrix(host, block_dim: int, mesh: Mesh, axis: str = "p",
         send_counts=tuple(int(c) for c in part.send_count),
         halo_counts=tuple(int(c) for c in part.halo_count),
         halo_counts2=tuple(int(c) for c in r2.halo_count),
-        bnd_counts=tuple(int(c) for c in part.bnd_count))
+        bnd_counts=tuple(int(c) for c in part.bnd_count)),
+        "block_pack")
 
 
 # --------------------------------------------------------------------------

@@ -91,6 +91,11 @@ class AOTStore:
         os.makedirs(self.root, exist_ok=True)
         self._lock = threading.Lock()
         self._mem: dict = {}
+        #: serialized-size approximation of each resident executable —
+        #: what the HBM ledger's ``amgx/aot/cache`` host-byte owner
+        #: reports (remember()-only entries have no known size)
+        self._mem_nbytes: dict = {}
+        self._ml_tok = None
         self.loads = 0
         self.saves = 0
         self.misses = 0
@@ -194,7 +199,9 @@ class AOTStore:
             return None
         with self._lock:
             self._mem[key] = fn
+            self._mem_nbytes[key] = len(raw)
             self.loads += 1
+        self._ml_account()
         self._count("hit")
         return fn
 
@@ -232,9 +239,27 @@ class AOTStore:
         self._account_save(key, len(data), old_bytes, existed)
         with self._lock:
             self._mem[key] = compiled
+            self._mem_nbytes[key] = len(data)
             self.saves += 1
+        self._ml_account()
         self._gauges()
         return True
+
+    def _ml_account(self):
+        """Re-register the in-memory executable cache in the HBM
+        ledger (host-byte owner ``amgx/aot/cache`` — listed in the
+        owners table, excluded from the device invariant)."""
+        ml = telemetry.memledger
+        if not ml.is_enabled():
+            return
+        with self._lock:
+            nb = sum(self._mem_nbytes.values())
+            tok, self._ml_tok = self._ml_tok, None
+        ml.release(tok)
+        if nb > 0:
+            t = ml.register_bytes(ml.owner_name("aot", "cache"), nb)
+            with self._lock:
+                self._ml_tok = t
 
     # ------------------------------------------------------------- stats
     def _count(self, result: str):
@@ -325,6 +350,8 @@ def reset_store():
     """Forget the process store (test isolation; files stay on disk)."""
     global _STORE, _env_checked
     with _STORE_LOCK:
+        if _STORE is not None:
+            telemetry.memledger.release(_STORE._ml_tok)
         _STORE = None
         _env_checked = False
 

@@ -323,8 +323,15 @@ def execute_batch(session: SolverSession, requests: List[SolveRequest],
         telemetry.counter_inc("amgx_serve_setup_total", kind=kind)
         if cache is not None and kind in ("full", "resetup"):
             cache.account(session)
+        # HBM-ledger phase boundary: rate-limited snapshot after the
+        # batch (a full setup / resetup just changed what is resident)
+        telemetry.memledger.maybe_sample(phase="serve")
     except Exception as e:      # noqa: BLE001 — worker pool must survive
         msg = f"{type(e).__name__}: {e}"
+        # device OOM post-mortem (idempotent per exception: the solver
+        # layer underneath may already have emitted for this object)
+        if telemetry.memledger.is_oom_error(e):
+            telemetry.memledger.emit_postmortem(e, "serve")
         from ..errors import AMGXError, classify_exception
         rc = e.rc if isinstance(e, AMGXError) else RC.UNKNOWN
         # classify the raised failure into the taxonomy (setup_error /

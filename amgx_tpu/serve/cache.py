@@ -45,15 +45,21 @@ def cache_totals() -> dict:
 
 
 class SetupCache:
-    def __init__(self, max_bytes: int = 1 << 30, placement=None):
+    def __init__(self, max_bytes: int = 1 << 30, placement=None,
+                 lane=None):
         self.max_bytes = int(max_bytes)
         #: jax.Device sessions created by this cache pin to (multi-lane
         #: serving: each lane's cache slice builds lane-resident
         #: hierarchies); None = process default device
         self.placement = placement
+        #: lane index this cache serves (HBM-ledger owner label;
+        #: standalone caches show as lane "x")
+        self.lane = lane
         self._lock = threading.Lock()
         self._sessions: "collections.OrderedDict[SessionKey, SolverSession]" \
             = collections.OrderedDict()
+        #: HBM-ledger tokens per resident session (amgx/serve/…)
+        self._ml_tokens: dict = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -87,13 +93,41 @@ class SetupCache:
             return self._sessions.get(key)
 
     # ---------------------------------------------------------- accounting
+    def _ml_name(self, session: SolverSession) -> str:
+        ml = telemetry.memledger
+        lane = "x" if self.lane is None else self.lane
+        return ml.owner_name(
+            "serve", f"lane{lane}_{session.key.pattern[:12]}")
+
+    def _ml_register(self, session: SolverSession):
+        """Register the session's resident device tree in the HBM
+        ledger (aggregate owner ``amgx/serve/…`` — buffers a specific
+        owner like ``amgx/hierarchy/…`` already claims stay charged
+        there).  Never raises: the ledger must not break serving."""
+        ml = telemetry.memledger
+        if not ml.is_enabled():
+            return None
+        try:
+            b = session.solver._bindings
+            tree = b.collect() if b is not None else session.solver.Ad
+            if tree is None:
+                return None
+            return ml.register(self._ml_name(session), tree)
+        except Exception:
+            return None
+
     def account(self, session: SolverSession) -> int:
         """Refresh ``session``'s byte price, then evict LRU sessions
         until the resident total fits the budget (the session just used
         is never evicted — it is the MRU by construction).  Returns the
         resident total after eviction."""
         size = session.device_bytes()
+        tok = self._ml_register(session)
+        ml = telemetry.memledger
         with self._lock:
+            ml.release(self._ml_tokens.pop(session.key, None))
+            if tok is not None:
+                self._ml_tokens[session.key] = tok
             session.bytes = size
             if session.key in self._sessions:
                 self._sessions.move_to_end(session.key)
@@ -103,6 +137,7 @@ class SetupCache:
                 if victim is session:
                     break
                 del self._sessions[key]
+                ml.release(self._ml_tokens.pop(key, None))
                 total -= victim.bytes
                 self.evictions += 1
                 _totals_inc("evictions")
@@ -122,6 +157,9 @@ class SetupCache:
     def clear(self):
         with self._lock:
             self._sessions.clear()
+            for tok in self._ml_tokens.values():
+                telemetry.memledger.release(tok)
+            self._ml_tokens.clear()
 
     def stats(self) -> dict:
         with self._lock:

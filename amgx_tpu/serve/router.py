@@ -87,7 +87,8 @@ class ExecutorLane:
         #: the lane's SetupCache slice — its own LRU and DEVICE-byte
         #: budget: eviction pressure on a saturated lane never evicts
         #: another chip's resident hierarchies
-        self.cache = SetupCache(int(cache_bytes), placement=device)
+        self.cache = SetupCache(int(cache_bytes), placement=device,
+                                lane=self.index)
         from ..telemetry import slo as _slo
         #: per-lane SLO window (the service keeps the aggregate one);
         #: never emits events — the service window owns the trace
@@ -440,6 +441,10 @@ class ExecutorLane:
             "queue_capacity": self.queue_depth,
             "inflight": inflight,
             "sessions": len(self.cache),
+            # HBM-ledger leg of healthz: what evicting this lane's
+            # whole cache would free (device bytes of every resident
+            # prepared hierarchy)
+            "resident_bytes": self.cache.resident_bytes(),
             "overloaded": snap["overloaded"],
             "slo_attainment": snap["attainment"],
             # circuit breaker (serve_breaker_threshold): an open

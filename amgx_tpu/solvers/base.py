@@ -230,6 +230,13 @@ class Solver:
         # telemetry recorder — phase records live in the same ring)
         if int(g("setup_profile")):
             telemetry.setup_profile.enable()
+        # HBM ledger (telemetry/memledger.py): device-memory ownership
+        # attribution + OOM post-mortems.  Off by default — with the
+        # knob off every registration site is one attribute check and
+        # solve traces are byte-identical
+        if int(g("memledger")):
+            telemetry.memledger.enable(
+                sample_s=float(g("memledger_sample_s")))
         # zero cold-start (utils/jaxcompat.py + serve/aot.py): an
         # explicit compile_cache_dir disk-backs every jit in the stack;
         # aot_store_dir additionally serializes the hot executables so
@@ -295,10 +302,24 @@ class Solver:
         _sp = telemetry.setup_profile
         prof = _sp.profile_setup(self.config_name) if toplevel \
             else _sp.null()
-        with telemetry.span(phase, solver=self.config_name,
-                            scope=self.scope, toplevel=toplevel), prof:
-            self._setup_impl(A)
+        try:
+            with telemetry.span(phase, solver=self.config_name,
+                                scope=self.scope, toplevel=toplevel), \
+                    prof:
+                self._setup_impl(A)
+        except Exception as e:
+            # device OOM (real RESOURCE_EXHAUSTED or the injected
+            # fault_inject `oom` point): emit the ledger post-mortem
+            # before the failure propagates — what was resident is
+            # exactly the forensic record an OOM destroys
+            if telemetry.memledger.is_oom_error(e):
+                telemetry.memledger.emit_postmortem(
+                    e, "setup", in_recovery=bool(
+                        getattr(self, "_in_recovery", False)))
+            raise
         self.setup_time = time.perf_counter() - t0
+        if toplevel:
+            telemetry.memledger.maybe_sample(phase=phase)
         if toplevel and telemetry.is_enabled():
             telemetry.hist_observe(f"amgx_{phase}_seconds",
                                    self.setup_time)
@@ -431,6 +452,15 @@ class Solver:
         else:
             self.A = None
             self.Ad = A
+        ml = telemetry.memledger
+        if ml.is_enabled() and getattr(self, "_toplevel", False) \
+                and self.Ad is not None:
+            # the top-level operator pack; hierarchy/transfer/smoother
+            # packs register themselves (amg/hierarchy.py) and claim
+            # their buffers ahead of this aggregate-adjacent owner
+            ml.release(getattr(self, "_ml_matrix_tok", None))
+            self._ml_matrix_tok = ml.register(
+                ml.owner_name("matrix", self.config_name), self.Ad)
         with cpu_profiler(f"setup:{self.config_name}"):
             self.solver_setup()
         if getattr(self, "_numeric_resetup", False) \
@@ -823,48 +853,64 @@ class Solver:
             self._solve_fn = jax.jit(
                 bind_for_trace(self._bindings, self._packed_solve_fn()))
             self._refined_fn = None
+            self._ml_register_bindings()
 
         t0 = time.perf_counter()
-        with telemetry.span("solve", solver=self.config_name,
-                            scope=self.scope, refined=bool(refine)), \
-                cpu_profiler(f"solve:{self.config_name}"):
-            if refine:
-                # refinement must see the caller's full-precision
-                # rhs/guess — the dtype-cast b/x0 above would fold the
-                # fp32 rounding of b itself into the "converged" solution
-                x, iters, brk_code, first_bad, nrm, nrm_ini, history = \
-                    self._solve_refined(b_in, x0_in, wide)
-            else:
-                import contextlib
-                ctx = jax.default_device(pin) if pin is not None \
-                    else contextlib.nullcontext()
-                # tolerances compare against REAL norms (complex modes)
-                rdt = np.zeros((), dtype).real.dtype
-                with ctx:
-                    # the scalar operands are created INSIDE the pin
-                    # context — built outside they would land on the
-                    # default device and ship per solve
-                    call_args = (self._bindings.collect(), b, x0,
-                                 jnp.asarray(self.tolerance, rdt),
-                                 jnp.asarray(self.max_iters, jnp.int32))
-                    fn = self._solve_fn
-                    if not dist:
-                        # warm-start layer: load/compile-and-save the
-                        # AOT executable for these shapes (no-op
-                        # without a configured store); sharded packs
-                        # keep jit.  Pinned packs (multi-lane serving:
-                        # one executor lane per device) participate
-                        # with a device-qualified key — a serialized
-                        # executable bakes in its device assignment,
-                        # so lane 3's entry must never load on lane 0
-                        fn = self._maybe_aot("solve", fn, call_args,
-                                             device=pin)
-                    x, stats, history = fn(*call_args)
-                # ONE small host fetch for (iters, breakdown, norms) —
-                # per-transfer cost dominates on remote-attached TPUs
-                iters, brk_code, first_bad, nrm, nrm_ini = \
-                    self._decode_stats(np.asarray(stats))
+        try:
+            with telemetry.span("solve", solver=self.config_name,
+                                scope=self.scope, refined=bool(refine)), \
+                    cpu_profiler(f"solve:{self.config_name}"):
+                if refine:
+                    # refinement must see the caller's full-precision
+                    # rhs/guess — the dtype-cast b/x0 above would fold
+                    # the fp32 rounding of b itself into the
+                    # "converged" solution
+                    x, iters, brk_code, first_bad, nrm, nrm_ini, \
+                        history = self._solve_refined(b_in, x0_in, wide)
+                else:
+                    import contextlib
+                    ctx = jax.default_device(pin) if pin is not None \
+                        else contextlib.nullcontext()
+                    # tolerances compare against REAL norms (complex
+                    # modes)
+                    rdt = np.zeros((), dtype).real.dtype
+                    with ctx:
+                        # the scalar operands are created INSIDE the pin
+                        # context — built outside they would land on the
+                        # default device and ship per solve
+                        call_args = (self._bindings.collect(), b, x0,
+                                     jnp.asarray(self.tolerance, rdt),
+                                     jnp.asarray(self.max_iters,
+                                                 jnp.int32))
+                        fn = self._solve_fn
+                        if not dist:
+                            # warm-start layer: load/compile-and-save
+                            # the AOT executable for these shapes (no-op
+                            # without a configured store); sharded packs
+                            # keep jit.  Pinned packs (multi-lane
+                            # serving: one executor lane per device)
+                            # participate with a device-qualified key —
+                            # a serialized executable bakes in its
+                            # device assignment, so lane 3's entry must
+                            # never load on lane 0
+                            fn = self._maybe_aot("solve", fn, call_args,
+                                                 device=pin)
+                        x, stats, history = fn(*call_args)
+                    # ONE small host fetch for (iters, breakdown,
+                    # norms) — per-transfer cost dominates on
+                    # remote-attached TPUs
+                    iters, brk_code, first_bad, nrm, nrm_ini = \
+                        self._decode_stats(np.asarray(stats))
+        except Exception as e:
+            # device OOM mid-solve: the ledger post-mortem is the only
+            # record of what was resident when the allocator gave up
+            if telemetry.memledger.is_oom_error(e):
+                telemetry.memledger.emit_postmortem(
+                    e, "solve", in_recovery=bool(
+                        getattr(self, "_in_recovery", False)))
+            raise
         solve_time = time.perf_counter() - t0
+        telemetry.memledger.maybe_sample(phase="solve")
         # record the injection only when it actually PROVOKED something
         # (a solve converging before the target iteration — or a
         # solver whose recursion recomputes the zeroed scalar, like
@@ -945,6 +991,50 @@ class Solver:
             res = maybe_recover(self, b_caller, x0_caller,
                                 zero_initial_guess, res)
         return res
+
+    def _ml_register_bindings(self):
+        """HBM-ledger registration of the solve-loop binding pytree
+        (owner ``amgx/solve/bindings`` — an AGGREGATE owner: buffers the
+        hierarchy/smoother/matrix owners already claimed stay theirs,
+        so this names only the otherwise-unowned solve transients).
+        One attribute check when the ledger is off."""
+        ml = telemetry.memledger
+        if not ml.is_enabled() or self._bindings is None:
+            return
+        # binding discovery just FORCED the lazy device packs (P/R
+        # transfer operators materialize on first touch) — re-register
+        # the hierarchies so those buffers claim under amgx/transfer/…
+        # instead of falling through to this aggregate.  The hierarchy
+        # hangs off self for a standalone AMG solve and off the
+        # preconditioner chain for a Krylov-wrapped one
+        obj, seen = self, set()
+        while obj is not None and id(obj) not in seen:
+            seen.add(id(obj))
+            h = getattr(obj, "hierarchy", None)
+            if h is not None and hasattr(h, "_register_memledger"):
+                h._register_memledger()
+            obj = getattr(obj, "preconditioner", None)
+        ml.release(getattr(self, "_ml_bind_tok", None))
+        self._ml_bind_tok = ml.register(
+            ml.owner_name("solve", "bindings"), self._bindings.collect())
+
+    def release_memledger(self):
+        """Drop this solver's HBM-ledger registrations (teardown): the
+        operator pack, the solve bindings, and — for AMG solvers — the
+        hierarchy/transfer/smoother/coarse entries.  Weakref-backed
+        entries stop counting when the arrays die anyway; explicit
+        release keeps the register/release balance exact."""
+        ml = telemetry.memledger
+        ml.release(getattr(self, "_ml_matrix_tok", None))
+        ml.release(getattr(self, "_ml_bind_tok", None))
+        self._ml_matrix_tok = self._ml_bind_tok = None
+        obj, seen = self, set()
+        while obj is not None and id(obj) not in seen:
+            seen.add(id(obj))
+            h = getattr(obj, "hierarchy", None)
+            if h is not None and hasattr(h, "release_memledger"):
+                h.release_memledger()
+            obj = getattr(obj, "preconditioner", None)
 
     def _maybe_aot(self, tag: str, jit_fn: Callable, args: tuple,
                    device=None) -> Callable:
@@ -1211,6 +1301,7 @@ class Solver:
                         from ._bind import DeviceBindings, bind_for_trace
                         if self._bindings is None:
                             self._bindings = DeviceBindings(self)
+                            self._ml_register_bindings()
                         bindings = self._bindings
                         vm = jax.vmap(self._packed_solve_fn(),
                                       in_axes=(0, 0, None, None))
@@ -1356,6 +1447,7 @@ class Solver:
                 # executable right back — a retrace ping-pong for
                 # workloads alternating single- and multi-RHS solves
                 self._bindings = DeviceBindings(self)
+                self._ml_register_bindings()
                 self._solve_fn = None
                 self._refined_fn = None
                 self._solve_multi = None

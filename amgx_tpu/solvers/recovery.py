@@ -229,15 +229,22 @@ _ACTION_FN = {"krylov_classic": _act_krylov_classic,
 
 
 def _audit(kind: FailureKind, action: str, attempt: int, outcome: str,
-           solver, wall_s: float, detail: str = ""):
+           solver, wall_s: float, detail: str = "",
+           oom: bool = False):
     telemetry.counter_inc("amgx_recovery_total", kind=kind.value,
                           action=action, outcome=outcome)
     if telemetry.is_enabled():
+        extra = {"detail": detail[:200]} if detail else {}
+        if oom:
+            # HBM-ledger cross-reference: this rung died on a device
+            # OOM, whose oom_postmortem event (emitted at the failing
+            # setup/solve with in_recovery=true) carries the resident
+            # ledger snapshot
+            extra["oom"] = True
         telemetry.event("recovery_attempt", kind=kind.value,
                         action=action, attempt=int(attempt),
                         outcome=outcome, solver=solver.config_name,
-                        wall_s=round(wall_s, 6),
-                        **({"detail": detail[:200]} if detail else {}))
+                        wall_s=round(wall_s, 6), **extra)
         if getattr(solver, "telemetry_path", ""):
             # the audit lands AFTER the attempt solve's own incremental
             # flush — without this, a streaming trace would always be
@@ -286,7 +293,8 @@ def maybe_recover(solver, b, x0, zero_initial_guess: bool, result):
                 attempt += 1
                 _audit(kind, action, attempt, "error", solver,
                        time.perf_counter() - t0,
-                       detail=f"{type(e).__name__}: {e}")
+                       detail=f"{type(e).__name__}: {e}",
+                       oom=telemetry.memledger.is_oom_error(e))
                 last_action = action
                 continue
             attempt += 1

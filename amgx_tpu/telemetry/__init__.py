@@ -35,6 +35,12 @@ the grid-stats table:
   emitted as the ``device_anatomy`` event and
   ``amgx_device_time_seconds_total{scope}``), and :mod:`.overlap`
   (measured interior/halo overlap riding the same plumbing);
+* **HBM ledger** (PR 18): :mod:`.memledger` — device-memory ownership
+  attribution under the versioned ``amgx/<owner>/<name>`` taxonomy
+  (registry + ``jax.live_arrays`` census + backend ``memory_stats``
+  truth, honesty invariant ``accounted + unaccounted ≡ bytes_in_use``),
+  ``hbm_snapshot`` sampling and ``oom_postmortem`` bundles — gated by
+  the ``memledger`` knob;
 * **live serving observability**: :mod:`.slo` (time-windowed
   request-outcome reservoir → attainment / error-budget burn rate /
   overload detection) and :mod:`.httpd` (in-process
@@ -48,9 +54,9 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 """
 from __future__ import annotations
 
-from . import (costmodel, deviceprof, export, forensics, metrics, overlap,
-               proftrace, recorder, runstate, scopes, setup_profile, slo,
-               tracefile)
+from . import (costmodel, deviceprof, export, forensics, memledger,
+               metrics, overlap, proftrace, recorder, runstate, scopes,
+               setup_profile, slo, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -73,7 +79,7 @@ __all__ = [
     "chrome_trace", "write_chrome_trace", "validate_chrome_trace",
     "costmodel", "forensics", "setup_profile", "runstate",
     "slo", "httpd",
-    "proftrace", "scopes", "deviceprof", "overlap",
+    "proftrace", "scopes", "deviceprof", "overlap", "memledger",
     "reset",
 ]
 
@@ -101,3 +107,4 @@ def reset():
     recorder.reset_dropped()
     metrics.registry().reset()
     setup_profile.reset()
+    memledger.reset()

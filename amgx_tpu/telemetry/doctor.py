@@ -232,6 +232,8 @@ def diagnose(paths: List[str]) -> dict:
     agglomerations: List[dict] = []
     krylov_events: List[dict] = []
     device_anatomy: Optional[dict] = None
+    hbm_snapshot: Optional[dict] = None
+    oom_postmortems: List[dict] = []
     for s in agg["sessions"]:
         for r in s["records"]:
             if r["kind"] != "event":
@@ -246,6 +248,11 @@ def diagnose(paths: List[str]) -> dict:
             elif r["name"] == "device_anatomy":
                 # last anatomy wins — one capture per profiled solve
                 device_anatomy = dict(r["attrs"])
+            elif r["name"] == "hbm_snapshot":
+                # last ledger snapshot wins — what is resident NOW
+                hbm_snapshot = dict(r["attrs"])
+            elif r["name"] == "oom_postmortem":
+                oom_postmortems.append(dict(r["attrs"]))
     local_bytes = sum(float(d.get("bytes_per_apply") or 0)
                       for d in levels.values())
     if not local_bytes and op_cost:
@@ -775,6 +782,38 @@ def diagnose(paths: List[str]) -> dict:
                 "or an uninstrumented kernel; scripts/telemetry_check "
                 "lints registered kernels)")
 
+    # ---- device memory (PR 18: telemetry/memledger.py) --------------
+    memory = None
+    if hbm_snapshot is not None or oom_postmortems:
+        memory = {"snapshot": hbm_snapshot,
+                  "oom_postmortems": oom_postmortems}
+        if hbm_snapshot and hbm_snapshot.get("measured"):
+            for dev, d in (hbm_snapshot.get("devices") or {}).items():
+                limit = d.get("bytes_limit") or 0
+                head = d.get("headroom_bytes") or 0
+                if limit > 0 and head / limit < 0.10:
+                    top = sorted(
+                        ((d.get("owners") or {})).items(),
+                        key=lambda kv: -kv[1])[:1]
+                    who = f" (largest owner {top[0][0]}, " \
+                          f"{_fmt_bytes(top[0][1])})" if top else ""
+                    hints.append(
+                        f"device memory: {dev} is near its ceiling — "
+                        f"{_fmt_bytes(head)} headroom of "
+                        f"{_fmt_bytes(limit)}{who}; shrink "
+                        "serve_cache_bytes, store hierarchies in "
+                        "bfloat16 (hierarchy_dtype), or evict sessions "
+                        "before the next setup OOMs")
+        for pm in oom_postmortems:
+            top = pm.get("top_owners") or []
+            who = f"; top owner {top[0][0]} " \
+                  f"({_fmt_bytes(top[0][1])})" if top else ""
+            hints.append(
+                f"device OOM in {pm.get('where')}"
+                f"{' (injected)' if pm.get('injected') else ''}{who} — "
+                "see the oom_postmortem event for the full ledger "
+                "snapshot and eviction suggestions")
+
     return {
         "files": list(paths),
         "sessions": agg["n_sessions"], "records": agg["n_records"],
@@ -800,6 +839,7 @@ def diagnose(paths: List[str]) -> dict:
         },
         "krylov": krylov,
         "device": device_anatomy,
+        "memory": memory,
         "serving": serving,
         "serving_lanes": lanes_diag,
         "slo": slo,
@@ -1249,6 +1289,53 @@ def render(d: dict) -> str:
                 L.append(f"  {label} {name}: "
                          f"{float(rows[name]) * 1e3:.3f} ms")
 
+    mem = d.get("memory")
+    if mem:
+        L.append("")
+        L.append("Device memory (HBM ledger)")
+        L.append("-" * 40)
+        snap = mem.get("snapshot")
+        if snap:
+            if not snap.get("measured"):
+                L.append("  measured: NO — no device exposed "
+                         "memory_stats() (CPU backend); bytes_in_use "
+                         "below is the live-array census total")
+            for dname in sorted(snap.get("devices") or {}):
+                dd = snap["devices"][dname]
+                line = (f"  {dname}: in use "
+                        f"{_fmt_bytes(dd.get('bytes_in_use'))}   "
+                        f"accounted "
+                        f"{_fmt_bytes(dd.get('accounted_bytes'))}   "
+                        f"unaccounted "
+                        f"{_fmt_bytes(dd.get('unaccounted_bytes'))}")
+                if snap.get("measured"):
+                    line += (f"   headroom "
+                             f"{_fmt_bytes(dd.get('headroom_bytes'))}"
+                             f"   peak "
+                             f"{_fmt_bytes(dd.get('peak_bytes'))}")
+                L.append(line)
+            owners = snap.get("owners") or {}
+            for name, nb in sorted(owners.items(),
+                                   key=lambda kv: -kv[1])[:8]:
+                L.append(f"    {name:<34} {_fmt_bytes(nb):>10}")
+            for name, nb in sorted((snap.get("host_owners")
+                                    or {}).items()):
+                L.append(f"    {name:<34} {_fmt_bytes(nb):>10} (host)")
+            L.append(f"  live arrays {snap.get('n_live_arrays', 0)} "
+                     f"(owned {snap.get('n_owned_arrays', 0)}), "
+                     f"registered entries "
+                     f"{snap.get('registered_entries', 0)} "
+                     f"[ledger contract "
+                     f"v{snap.get('ledger_version', '?')}]")
+        for pm in mem.get("oom_postmortems") or []:
+            inj = " (injected)" if pm.get("injected") else ""
+            L.append(f"  OOM in {pm.get('where')}{inj}: "
+                     f"{str(pm.get('error'))[:80]}")
+            for name, nb in (pm.get("top_owners") or [])[:3]:
+                L.append(f"    held by {name:<28} {_fmt_bytes(nb):>10}")
+            for s in pm.get("suggestions") or []:
+                L.append(f"    try: {s.get('knob')} — {s.get('hint')}")
+
     srv = d.get("serving")
     if srv:
         L.append("")
@@ -1603,12 +1690,44 @@ def diff(da: dict, db: dict) -> dict:
                 word = "worsened" if b > a else "improved"
                 drifts.append(f"device time {s} {word} "
                               f"{a * 1e3:.2f} → {b * 1e3:.2f} ms")
+    # HBM ledger A/B: per-owner resident bytes side by side.  Same
+    # both-measured rule as the anatomy for the backend-truth fields;
+    # the owner table diffs in stub mode too (census bytes are real
+    # either way)
+    memory = None
+    mema = (da.get("memory") or {}).get("snapshot") or {}
+    memb = (db.get("memory") or {}).get("snapshot") or {}
+    if mema and memb:
+        oa, ob = mema.get("owners") or {}, memb.get("owners") or {}
+        memory = {
+            "measured": {"a": mema.get("measured"),
+                         "b": memb.get("measured")},
+            "owners": {o: {"a": oa.get(o), "b": ob.get(o)}
+                       for o in sorted(set(oa) | set(ob))},
+        }
+        if mema.get("measured") and memb.get("measured"):
+            pa = {dev: d.get("peak_bytes")
+                  for dev, d in (mema.get("devices") or {}).items()}
+            pb = {dev: d.get("peak_bytes")
+                  for dev, d in (memb.get("devices") or {}).items()}
+            memory["peak_bytes"] = {
+                dev: {"a": pa.get(dev), "b": pb.get(dev)}
+                for dev in sorted(set(pa) | set(pb))}
+        for o, v in memory["owners"].items():
+            a, b = v["a"], v["b"]
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                    and a > 0 and (b / a >= 1.5 or b / a <= 1 / 1.5) \
+                    and max(a, b) >= 1 << 20:     # ignore sub-MiB noise
+                word = "grew" if b > a else "shrank"
+                drifts.append(f"HBM owner {o} {word} "
+                              f"{_fmt_bytes(a)} → {_fmt_bytes(b)}")
     return {"a": da["files"], "b": db["files"],
             "convergence": {k: pair(k) for k in
                             ("iterations", "final_relres", "rate",
                              "asymptotic_rate")},
             "rows": rows, "phases": phases, "levels": levels,
             "device": device,
+            "memory": memory,
             "drifts": drifts}
 
 
@@ -1684,6 +1803,18 @@ def render_diff(dd: dict) -> str:
             b = (v["b"] or 0) * 1e3 if v["b"] is not None else None
             L.append(f"  {s:<34}{_fmt_num(a):>10} vs "
                      f"{_fmt_num(b):>10}")
+    if dd.get("memory"):
+        L.append("")
+        L.append("device memory (A vs B, resident bytes per owner)")
+        L.append("-" * 40)
+        for o, v in dd["memory"]["owners"].items():
+            fa = _fmt_bytes(v["a"]) if v["a"] is not None else "-"
+            fb = _fmt_bytes(v["b"]) if v["b"] is not None else "-"
+            L.append(f"  {o:<34}{fa:>10} vs {fb:>10}")
+        for dev, v in (dd["memory"].get("peak_bytes") or {}).items():
+            fa = _fmt_bytes(v["a"]) if v["a"] is not None else "-"
+            fb = _fmt_bytes(v["b"]) if v["b"] is not None else "-"
+            L.append(f"  peak {dev:<29}{fa:>10} vs {fb:>10}")
     L.append("")
     if dd["drifts"]:
         L.append("drifts")

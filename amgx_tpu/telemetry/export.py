@@ -340,6 +340,80 @@ def validate_record(rec: dict):
                  "device_setup_fallback event missing component")
             need(a.get("level") is None or isinstance(a["level"], int),
                  "device_setup_fallback event has non-integer level")
+
+        def _check_ledger_snapshot(s, what):
+            # shared shape of the HBM ledger snapshot (memledger.py):
+            # the honesty invariant is validated per device, so a trace
+            # can never carry an unbalanced ledger
+            from . import memledger as _ml
+            need(isinstance(s.get("measured"), bool),
+                 f"{what} missing measured bool")
+            need(isinstance(s.get("ledger_version"), int)
+                 and s["ledger_version"] >= 1,
+                 f"{what} missing ledger_version")
+            devs = s.get("devices")
+            need(isinstance(devs, dict), f"{what} missing devices dict")
+            for dev, d in devs.items():
+                need(isinstance(d, dict),
+                     f"{what} device {dev!r} is not an object")
+                for k in ("bytes_in_use", "accounted_bytes",
+                          "unaccounted_bytes", "census_bytes",
+                          "peak_bytes", "bytes_limit",
+                          "headroom_bytes"):
+                    need(isinstance(d.get(k), int) and d[k] >= 0,
+                         f"{what} device {dev!r} missing integer {k}")
+                need(d["accounted_bytes"] + d["unaccounted_bytes"]
+                     == d["bytes_in_use"],
+                     f"{what} device {dev!r} violates the honesty "
+                     f"invariant accounted + unaccounted == "
+                     f"bytes_in_use")
+                ow = d.get("owners")
+                need(isinstance(ow, dict),
+                     f"{what} device {dev!r} missing owners dict")
+                for name, nb in ow.items():
+                    need(_ml.validate(name),
+                         f"{what} owner {name!r} violates the "
+                         f"amgx/<owner>/<name> contract")
+                    need(isinstance(nb, int) and nb >= 0,
+                         f"{what} owner {name!r} has non-integer "
+                         f"bytes")
+            need(isinstance(s.get("owners"), dict),
+                 f"{what} missing owners dict")
+
+        if rec["name"] == "hbm_snapshot":
+            # the HBM ledger sample (telemetry/memledger.py): the
+            # doctor's "Device memory" input and the chrome-trace
+            # `hbm <device>` counter track
+            _check_ledger_snapshot(rec["attrs"], "hbm_snapshot event")
+        if rec["name"] == "oom_postmortem":
+            # the OOM post-mortem bundle: a RESOURCE_EXHAUSTED without
+            # one of these is an unexplained death
+            a = rec["attrs"]
+            need(isinstance(a.get("where"), str) and a["where"],
+                 "oom_postmortem event missing where")
+            need(isinstance(a.get("error"), str),
+                 "oom_postmortem event missing error")
+            for k in ("injected", "in_recovery", "measured"):
+                need(isinstance(a.get(k), bool),
+                     f"oom_postmortem event missing {k} bool")
+            need(isinstance(a.get("snapshot"), dict),
+                 "oom_postmortem event missing snapshot")
+            _check_ledger_snapshot(a["snapshot"],
+                                   "oom_postmortem snapshot")
+            to = a.get("top_owners")
+            need(isinstance(to, list) and all(
+                isinstance(p, list) and len(p) == 2
+                and isinstance(p[0], str)
+                and isinstance(p[1], int) and p[1] >= 0
+                for p in to),
+                 "oom_postmortem event missing top_owners pairs")
+            need(isinstance(a.get("headroom_history"), list),
+                 "oom_postmortem event missing headroom_history")
+            sg = a.get("suggestions")
+            need(isinstance(sg, list) and sg and all(
+                isinstance(s, dict) and isinstance(s.get("knob"), str)
+                and isinstance(s.get("hint"), str) for s in sg),
+                 "oom_postmortem event missing suggestions")
     else:   # counter / gauge / hist
         need(isinstance(rec.get("labels"), dict), "metric missing labels")
         v = rec.get("value")

@@ -34,6 +34,9 @@ Routes:
   capture of the live process for N seconds (clamped to
   [0.05, 60]); responds with the trace directory.  One capture at a
   time — concurrent requests get 409.
+* ``GET /debug/memory`` — the HBM ledger's live view
+  (:mod:`amgx_tpu.telemetry.memledger`): a fresh ownership snapshot,
+  top owners and the recent headroom history.
 
 Handlers never touch solver internals beyond the read-only stats
 surface, so a scrape cannot perturb a solve beyond the GIL.
@@ -96,13 +99,15 @@ class _Handler(BaseHTTPRequestHandler):
                 "/debug/trace": self._debug_trace,
                 "/debug/profile": self._debug_profile,
                 "/debug/deviceprof": self._debug_deviceprof,
+                "/debug/memory": self._debug_memory,
             }.get(url.path)
             if route is None:
                 self._json(404, {"error": f"no route {url.path}",
                                  "routes": ["/metrics", "/healthz",
                                             "/statusz", "/debug/trace",
                                             "/debug/profile",
-                                            "/debug/deviceprof"]})
+                                            "/debug/deviceprof",
+                                            "/debug/memory"]})
                 return
             route(q)
         except BrokenPipeError:
@@ -178,6 +183,19 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._json(200, {"dir": out["dir"], "seconds": out["seconds"],
                          "device_anatomy": out.get("device_anatomy")})
+
+    def _debug_memory(self, q):
+        # the HBM ledger's live view: a fresh snapshot (not the last
+        # sampled one) plus the recent headroom history — works with
+        # the ledger knob off too, just with no registered owners
+        from . import memledger
+        snap = memledger.snapshot()
+        self._json(200, {
+            "enabled": memledger.is_enabled(),
+            "snapshot": snap,
+            "top_owners": memledger.top_owners(snap),
+            "headroom_history": memledger.headroom_history(),
+        })
 
     def _capture_profile(self, q) -> dict:
         """One-shot profiler capture + parsed summaries.  Returns the

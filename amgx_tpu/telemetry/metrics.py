@@ -288,6 +288,18 @@ METRICS: Dict[str, Tuple[str, str]] = {
                   "quarantine"),
     "amgx_serve_breaker_trips_total":
         ("counter", "executor-lane circuit-breaker trips {lane}"),
+    # ---- HBM ledger (telemetry/memledger.py, ISSUE 18) --------------
+    "amgx_hbm_bytes":
+        ("gauge", "owner-attributed device bytes of the last ledger "
+                  "sample {device,owner}"),
+    "amgx_hbm_headroom_bytes":
+        ("gauge", "bytes_limit - bytes_in_use of one device at the "
+                  "last ledger sample (measured platforms only) "
+                  "{device}"),
+    "amgx_hbm_peak_bytes":
+        ("gauge", "allocator peak_bytes_in_use of one device at the "
+                  "last ledger sample (measured platforms only) "
+                  "{device}"),
 }
 
 #: wall-clock histogram bucket upper bounds (seconds)

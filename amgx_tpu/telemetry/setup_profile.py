@@ -228,6 +228,10 @@ class _Phase:
                     prof["frames"].append(
                         dict(rec, tid=threading.get_ident()))
         recorder.event("setup_phase", **rec)
+        # HBM-ledger phase boundary (rate-limited by memledger_sample_s;
+        # one attribute check when the ledger is off)
+        from . import memledger
+        memledger.maybe_sample(phase=self.component)
         return False
 
 

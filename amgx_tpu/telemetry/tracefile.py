@@ -102,6 +102,24 @@ def _session_events(records: List[dict], pid: int, offset_s: float,
                 "tid": r["tid"], "ts": ts(r["t"]), "s": "t",
                 "args": _args(r.get("attrs", {})),
             })
+            if r["name"] == "hbm_snapshot":
+                # the HBM ledger sample additionally draws one counter
+                # track per device (bytes_in_use) so memory pressure is
+                # plottable next to the phase spans that caused it
+                devs = (r.get("attrs") or {}).get("devices")
+                if isinstance(devs, dict):
+                    for dev, d in sorted(devs.items()):
+                        v = d.get("bytes_in_use") \
+                            if isinstance(d, dict) else None
+                        if isinstance(v, (int, float)) \
+                                and not isinstance(v, bool):
+                            out.append({
+                                "ph": "C",
+                                "name": f"hbm {dev}",
+                                "pid": pid, "tid": 0,
+                                "ts": ts(r["t"]),
+                                "args": {"value": v},
+                            })
             if r["name"] == "device_anatomy":
                 # the device-time anatomy additionally draws one counter
                 # track per attributed scope (seconds of measured device

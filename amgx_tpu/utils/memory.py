@@ -57,15 +57,26 @@ def device_tree_bytes(tree) -> int:
     accounting unit of the serving setup cache (serve/cache.py): one
     prepared solver's bindings pytree is exactly its resident hierarchy
     + smoother data, so summing leaf ``nbytes`` prices a cache entry
-    without touching backend allocator stats."""
+    without touching backend allocator stats.
+
+    Leaves are deduplicated by buffer identity: shallow views
+    (``precision_view`` / ``placement_view`` / lane replicas) share the
+    same device arrays, and a shared buffer costs its bytes once — a
+    double count here makes cache budgets over-evict."""
     import jax
 
     total = 0
+    seen = set()
     for leaf in jax.tree_util.tree_leaves(tree):
         nb = getattr(leaf, "nbytes", None)
-        if nb is not None:
-            try:
-                total += int(nb)
-            except Exception:
-                pass
+        if nb is None:
+            continue
+        key = id(leaf)
+        if key in seen:
+            continue
+        seen.add(key)
+        try:
+            total += int(nb)
+        except Exception:
+            pass
     return total

@@ -365,6 +365,46 @@ def _bench_device_anatomy(slv, n, dtype):
             trace or {"traceEvents": []}, records=cap.records)
 
 
+def _bench_memory(slv):
+    """HBM-ledger snapshot of the kept headline solver (ISSUE 18):
+    enable the ledger post-hoc (the timed solves above ran with it off
+    — the zero-overhead contract), register the resident hierarchy,
+    and report peak HBM + top owners.  perf_gate checks the block's
+    SHAPE only and never ratchets it — ``memory_stats()`` availability
+    varies by platform, and on CPU the block honestly reports
+    measured=false with the census as the stand-in."""
+    from amgx_tpu import telemetry
+    from amgx_tpu.telemetry import recorder
+    ml = telemetry.memledger
+    was_ml = ml.is_enabled()
+    was_rec = recorder.is_enabled()
+    hier = None
+    ml.enable(sample_s=0.0)
+    try:
+        hier = getattr(getattr(slv, "preconditioner", None),
+                       "hierarchy", None) or getattr(slv, "hierarchy",
+                                                     None)
+        if hier is not None and hasattr(hier, "_register_memledger"):
+            hier._register_memledger()
+        snap = ml.snapshot()
+        devs = snap["devices"].values()
+        return {"measured": bool(snap["measured"]),
+                "ledger_version": int(snap["ledger_version"]),
+                "peak_hbm_bytes": int(max(
+                    (d.get("peak_bytes", 0) for d in devs), default=0)),
+                "bytes_in_use": int(sum(
+                    d.get("bytes_in_use", 0) for d in devs)),
+                "top_owners": [[k, int(v)]
+                               for k, v in ml.top_owners(snap)]}
+    finally:
+        if hier is not None and hasattr(hier, "release_memledger"):
+            hier.release_memledger()
+        if not was_ml:
+            ml.disable()
+        if not was_rec:
+            recorder.disable()
+
+
 def _hier_cycle_bytes(slv):
     """(modelled bytes one V-cycle streams, per-level dtypes) of a kept
     solver's hierarchy — the cost-model numerator of the bench's
@@ -1706,6 +1746,21 @@ def main():
             traceback.print_exc()
             device_anatomy = {"error": str(e)[:200]}
 
+    # HBM-ledger snapshot (ISSUE 18): peak HBM + top owners for the
+    # kept headline solver.  Best-effort and shape-only for perf_gate;
+    # bench_trend prints the peakHBM column.  AMGX_BENCH_MEMLEDGER=0
+    # skips.
+    memory = None
+    if os.environ.get("AMGX_BENCH_MEMLEDGER", "1") != "0" and hold_f32:
+        try:
+            memory = _bench_memory(hold_f32[0])
+        except Exception as e:
+            import traceback
+            print(f"[bench] memory-ledger snapshot failed: {e}",
+                  file=sys.stderr)
+            traceback.print_exc()
+            memory = {"error": str(e)[:200]}
+
     metric_name = f"poisson{n_side}_fgmres_agg_amg_solve_s"
     # vs_baseline against the newest recorded round with the same metric
     # (BENCH_r*.json written by the driver): >1 = faster than baseline
@@ -1768,6 +1823,7 @@ def main():
             **({"distributed": distributed} if distributed else {}),
             **({"device_anatomy": device_anatomy}
                if device_anatomy else {}),
+            **({"memory": memory} if memory else {}),
             **extra_cases,
         },
         # the backend init needed its one-retry backoff this round —

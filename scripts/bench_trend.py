@@ -93,11 +93,22 @@ CASES = (
     # recovered-solve overhead of one injected NaN-poison fault vs the
     # clean headline solve; non-chaos rounds render "-"
     ("recov", _x(("extras", "chaos", "overhead_x"))),
+    # HBM ledger (ISSUE 18): peak device memory of the kept headline
+    # solver in MiB.  Pre-PR-18 rounds lack the `memory` block and
+    # render "-"; so do unmeasured rounds (CPU — no memory_stats(),
+    # peak is honestly absent rather than fabricated)
+    ("peakHBM", lambda d: _mib(_x(
+        ("extras", "memory", "peak_hbm_bytes"))(d))),
 )
 
 
 def _pct(v):
     return round(v * 100.0, 1) if isinstance(v, (int, float)) else None
+
+
+def _mib(v):
+    return round(v / 2**20, 1) \
+        if isinstance(v, (int, float)) and v > 0 else None
 
 
 #: cases whose setup-profile top phases are worth a per-round

@@ -159,14 +159,15 @@ def load_round(path: str) -> dict:
                           "solve_s": parsed.get("value"),
                           "iterations": extras.get("iterations")}}
     for name, d in extras.items():
-        # telemetry/serving/distributed/device_anatomy are per-round
-        # observability blocks, not solve cases — their numeric fields
-        # must not become baselines (distributed feeds the gate through
-        # its weak_eff floor below; device_anatomy is checked for
-        # schema shape below, never ratcheted)
+        # telemetry/serving/distributed/device_anatomy/memory are
+        # per-round observability blocks, not solve cases — their
+        # numeric fields must not become baselines (distributed feeds
+        # the gate through its weak_eff floor below; device_anatomy and
+        # memory are checked for schema shape below, never ratcheted)
         if not isinstance(d, dict) or "error" in d or \
                 name in ("telemetry", "serving", "distributed",
-                         "spmv_gflops_by_format", "device_anatomy"):
+                         "spmv_gflops_by_format", "device_anatomy",
+                         "memory"):
             continue
         vals = {k: d.get(k) for k, _ in TRACKED
                 if isinstance(d.get(k), (int, float))}
@@ -223,6 +224,17 @@ def load_round(path: str) -> dict:
         if probs:
             raise ValueError(f"{path}: device_anatomy block violates "
                              f"its schema: {'; '.join(probs)}")
+    # HBM ledger (ISSUE 18): same contract as device_anatomy — the
+    # memory block is never a baseline and --update never ratchets it
+    # (memory_stats() availability varies by platform; a CPU round
+    # honestly reports measured=false with peak 0), but a PRESENT
+    # block must keep its schema shape
+    mm = extras.get("memory")
+    if isinstance(mm, dict) and "error" not in mm:
+        probs = memory_problems(mm)
+        if probs:
+            raise ValueError(f"{path}: memory block violates its "
+                             f"schema: {'; '.join(probs)}")
     return cases
 
 
@@ -258,6 +270,36 @@ def device_anatomy_problems(da: dict) -> list:
                       or not isinstance(v, (int, float)))
         if badv:
             probs.append(f"non-numeric scope seconds: {badv[:4]}")
+    return probs
+
+
+def memory_problems(mm: dict) -> list:
+    """Structural problems of a round's HBM-ledger ``memory`` extras
+    block (empty list when sound).  Mirrors the telemetry validator's
+    snapshot schema without importing the package: ``measured``
+    provenance bool, integer ledger_version, non-negative byte counts,
+    top_owners as [contract-shaped owner name, bytes] pairs."""
+    probs = []
+    if not isinstance(mm.get("measured"), bool):
+        probs.append("measured is not a bool")
+    lv = mm.get("ledger_version")
+    if isinstance(lv, bool) or not isinstance(lv, int) or lv < 1:
+        probs.append("ledger_version is not a positive int")
+    for k in ("peak_hbm_bytes", "bytes_in_use"):
+        v = mm.get(k)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            probs.append(f"{k} is not a non-negative int")
+    to = mm.get("top_owners")
+    if not isinstance(to, list):
+        probs.append("top_owners is not a list")
+    else:
+        for p in to:
+            if not (isinstance(p, list) and len(p) == 2
+                    and _SCOPE_SHAPE_RE.match(str(p[0]))
+                    and not isinstance(p[1], bool)
+                    and isinstance(p[1], int) and p[1] >= 0):
+                probs.append(f"malformed top_owners pair: {p!r:.80}")
+                break
     return probs
 
 

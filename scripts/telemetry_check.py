@@ -1075,6 +1075,161 @@ def main():
     except ValueError as e:
         fail(f"measured=false anatomy stub failed validation: {e}")
 
+    # 19. HBM ledger (ISSUE 18): (a) a real small solve with the
+    # `memledger=1` knob emits schema-valid hbm_snapshot events and the
+    # registry↔census join balances — honesty invariant per device,
+    # owners attribute the resident hierarchy; (b) an injected OOM
+    # (fault point `oom`) yields exactly one schema-valid
+    # oom_postmortem whose top owner is resident and whose suggestions
+    # carry config knobs; (c) the doctor hint fires both ways: a
+    # measured near-ceiling snapshot triggers it, the healthy
+    # (unmeasured CPU) trace stays silent
+    import copy
+
+    from amgx_tpu.telemetry import memledger
+    from amgx_tpu.utils import faultinject
+
+    path_mem = path + ".memledger"
+    path_oom = path + ".oom"
+    path_nc = path + ".nearceiling"
+    for p in (path_mem, path_oom, path_nc):
+        if os.path.exists(p):
+            os.unlink(p)
+    telemetry.reset()
+    faultinject.reset()
+    telemetry.enable(ring_size=65536)
+    cfg_mem = amgx.AMGConfig(
+        "config_version=2, solver(s)=AMG, s:max_iters=60, "
+        "s:tolerance=1e-6, s:monitor_residual=1, "
+        "s:convergence=RELATIVE_INI, "
+        "s:smoother(sm)=BLOCK_JACOBI, s:presweeps=1, s:postsweeps=1, "
+        "s:max_levels=4, s:coarse_solver(cs)=DENSE_LU_SOLVER, "
+        "memledger=1, memledger_sample_s=0")
+    slv_mem = amgx.create_solver(cfg_mem)
+    if not memledger.is_enabled():
+        fail("memledger=1 config knob did not enable the ledger")
+    slv_mem.setup(amgx.Matrix(A))
+    res_mem = slv_mem.solve(np.ones(A.shape[0]))
+    if int(res_mem.status) != 0:
+        fail(f"memledger solve did not converge ({res_mem.status})")
+    if memledger.entry_count() == 0:
+        fail("setup registered nothing in the HBM ledger")
+    snap_mem = memledger.snapshot()
+    # registry↔census cross-check on the live solve: the invariant is
+    # exact arithmetic per device, the resident hierarchy is owned,
+    # and owned arrays are a subset of the census
+    if not snap_mem["devices"]:
+        fail("ledger snapshot saw no devices on a live solve")
+    for dev, d in snap_mem["devices"].items():
+        if d["accounted_bytes"] + d["unaccounted_bytes"] \
+                != d["bytes_in_use"]:
+            fail(f"honesty invariant violated on {dev}: "
+                 f"{d['accounted_bytes']} + {d['unaccounted_bytes']} "
+                 f"!= {d['bytes_in_use']}")
+        if not snap_mem["measured"] \
+                and d["bytes_in_use"] != d["census_bytes"]:
+            fail(f"unmeasured stub must define bytes_in_use as the "
+                 f"census total on {dev}")
+        if sum(d["owners"].values()) != d["accounted_bytes"]:
+            fail(f"owner bytes do not sum to accounted_bytes on {dev}")
+    if not any(o.startswith("amgx/hierarchy/")
+               for o in snap_mem["owners"]):
+        fail("census attributed no amgx/hierarchy/* owner after setup "
+             f"(owners: {sorted(snap_mem['owners'])})")
+    bad_owner = [o for o in snap_mem["owners"]
+                 if not memledger.validate(o)]
+    if bad_owner:
+        fail(f"snapshot owners violate the taxonomy: {bad_owner}")
+    if snap_mem["n_owned_arrays"] > snap_mem["n_live_arrays"]:
+        fail("census join claims more arrays than are live")
+    telemetry.dump_jsonl(path_mem)      # the HEALTHY ledger trace
+    with open(path_mem) as f:
+        lines_mem = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_mem)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"memledger trace failed schema validation: {e}")
+    recs_mem = [json.loads(l) for l in lines_mem if l.strip()]
+    if not any(r["kind"] == "event" and r["name"] == "hbm_snapshot"
+               for r in recs_mem):
+        fail("memledger solve emitted no hbm_snapshot event")
+    if not any(r["kind"] == "gauge" and r["name"] == "amgx_hbm_bytes"
+               for r in recs_mem):
+        fail("memledger solve set no amgx_hbm_bytes gauge")
+
+    # (b) injected OOM → schema-valid post-mortem naming the resident
+    faultinject.configure("oom:count=1")
+    victim = amgx.create_solver(cfg_mem)
+    try:
+        victim.setup(amgx.Matrix(A))
+    except Exception:
+        pass
+    else:
+        fail("fault point oom:count=1 did not raise in setup")
+    finally:
+        faultinject.reset()
+    telemetry.dump_jsonl(path_oom)
+    with open(path_oom) as f:
+        lines_oom = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_oom)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"oom trace failed schema validation: {e}")
+    recs_oom = [json.loads(l) for l in lines_oom if l.strip()]
+    pms = [r for r in recs_oom if r["kind"] == "event"
+           and r["name"] == "oom_postmortem"]
+    if len(pms) != 1:
+        fail(f"expected exactly 1 oom_postmortem, got {len(pms)}")
+    pm_a = pms[0]["attrs"]
+    if pm_a["where"] != "setup" or pm_a["injected"] is not True:
+        fail(f"post-mortem misattributed the OOM: where="
+             f"{pm_a['where']!r} injected={pm_a['injected']!r}")
+    if not pm_a["top_owners"]:
+        fail("post-mortem names no resident owners")
+    if not pm_a["suggestions"]:
+        fail("post-mortem carries no eviction suggestions")
+    diag_oom = doctor.diagnose([path_oom])
+    if not (diag_oom.get("memory") or {}).get("oom_postmortems"):
+        fail("doctor diagnosis missed the oom_postmortem event")
+    rep_oom = doctor.render(diag_oom)
+    if "Device memory (HBM ledger)" not in rep_oom:
+        fail("doctor render has no Device memory section")
+    if not any("device OOM in setup" in h
+               for h in diag_oom.get("hints", [])):
+        fail("doctor raised no OOM hint for an oom_postmortem trace")
+
+    # (c) the near-ceiling hint BOTH WAYS: fires on a measured
+    # <10%-headroom snapshot, silent on the healthy trace
+    diag_mem = doctor.diagnose([path_mem])
+    if any("near its ceiling" in h for h in diag_mem.get("hints", [])):
+        fail("near-ceiling hint fired on a healthy trace")
+    snap_nc = copy.deepcopy(snap_mem)
+    snap_nc["measured"] = True
+    for d in snap_nc["devices"].values():
+        in_use = d["bytes_in_use"]
+        d["bytes_limit"] = in_use + max(in_use // 20, 1)
+        d["headroom_bytes"] = d["bytes_limit"] - in_use
+        d["peak_bytes"] = in_use
+    telemetry.reset()
+    telemetry.enable(ring_size=4096)
+    memledger.emit(snap_nc, phase="check")
+    telemetry.dump_jsonl(path_nc)
+    telemetry.disable()
+    with open(path_nc) as f:
+        try:
+            telemetry.validate_jsonl(f.readlines())
+        except (ValueError, json.JSONDecodeError) as e:
+            fail(f"near-ceiling trace failed schema validation: {e}")
+    diag_nc = doctor.diagnose([path_nc])
+    if not any("near its ceiling" in h
+               for h in diag_nc.get("hints", [])):
+        fail("near-ceiling hint did not fire on a measured "
+             "low-headroom snapshot")
+    slv_mem.release_memledger()
+    victim.release_memledger()
+    memledger.disable()
+    telemetry.reset()
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
@@ -1082,7 +1237,7 @@ def main():
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
           f"serving-obs OK, mixed-precision OK, serving-lanes OK, "
           f"distributed OK, failures-recovery OK, krylov-comm OK, "
-          f"device-anatomy OK)")
+          f"device-anatomy OK, memledger OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -1101,6 +1256,9 @@ def main():
         os.unlink(path_r)
         os.unlink(path_k)
         os.unlink(path_dp)
+        os.unlink(path_mem)
+        os.unlink(path_oom)
+        os.unlink(path_nc)
 
 
 def dist_child(trace_path: str) -> int:

@@ -80,6 +80,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "deviceprof: device-time attribution fast tests "
                    "(tier-1; pytest -m deviceprof selects just these)")
+    config.addinivalue_line(
+        "markers", "memledger: HBM-ledger / device-memory attribution "
+                   "fast tests (tier-1; pytest -m memledger selects "
+                   "just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.

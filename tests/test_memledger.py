@@ -1,0 +1,410 @@
+"""HBM ledger tests (telemetry/memledger.py): the ownership-taxonomy
+contract, register/release balance across setup → resetup → teardown,
+the live-array census join and its honesty invariant
+(``accounted + unaccounted == bytes_in_use``), shared-buffer dedupe,
+injected-OOM post-mortems, doctor/chrome surfacing, and the
+zero-overhead off contract."""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import amgx_tpu as amgx
+from amgx_tpu import telemetry
+from amgx_tpu.io import poisson5pt
+from amgx_tpu.telemetry import doctor, memledger, tracefile
+from amgx_tpu.telemetry.export import dump_jsonl, validate_record
+from amgx_tpu.utils import faultinject
+from amgx_tpu.utils.memory import device_tree_bytes
+
+pytestmark = pytest.mark.memledger
+
+AMG_CFG = ("config_version=2, solver(s)=AMG, s:max_iters=15, "
+           "s:tolerance=1e-8, s:monitor_residual=1, "
+           "s:smoother(sm)=BLOCK_JACOBI, s:presweeps=1, s:postsweeps=1, "
+           "s:max_levels=4, s:coarse_solver(cs)=DENSE_LU_SOLVER")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    faultinject.reset()
+    yield
+    faultinject.reset()
+    telemetry.reset()
+
+
+def _amg_solver(extra: str = ""):
+    return amgx.create_solver(amgx.AMGConfig(AMG_CFG + extra))
+
+
+# ------------------------------------------------------ owner taxonomy
+def test_owner_name_contract():
+    assert memledger.owner_name("hierarchy", "level0") == \
+        "amgx/hierarchy/level0"
+    assert memledger.owner_name("serve", "Lane0/ABC-123") == \
+        "amgx/serve/lane0/abc_123"
+    assert memledger.validate("amgx/transfer/level2")
+    assert not memledger.validate("amgx/hierarchy")      # no leaf
+    assert not memledger.validate("amgx/bogus/thing")    # unknown area
+    assert not memledger.validate("AMGX/solve/bindings")  # case matters
+    with pytest.raises(ValueError):
+        memledger.owner_name("bogus", "x")
+
+
+def test_every_owner_area_yields_valid_names():
+    for area in memledger.OWNERS:
+        assert memledger.validate(memledger.owner_name(area, "thing"))
+
+
+# --------------------------------------- register / release balance
+def test_register_release_balance_setup_resetup_teardown():
+    memledger.enable(sample_s=0.0)
+    assert memledger.entry_count() == 0
+    A = poisson5pt(14, 14)
+    slv = _amg_solver()
+    slv.setup(amgx.Matrix(A))
+    n_setup = memledger.entry_count()
+    assert n_setup > 0
+    # values-only resetup re-registers in place: old tokens released,
+    # entry count stays bounded instead of growing per resetup
+    A2 = A.copy()
+    A2.data = A2.data * 1.25
+    slv.resetup(amgx.Matrix(A2))
+    assert memledger.entry_count() <= n_setup + 1
+    slv.solve(np.ones(A.shape[0]))
+    # teardown drops every entry this solver registered — zero leak
+    slv.release_memledger()
+    assert memledger.entry_count() == 0
+
+
+def test_disabled_register_returns_none_and_release_accepts_it():
+    assert not memledger.is_enabled()
+    tok = memledger.register("amgx/hierarchy/level0", [np.ones(4)])
+    assert tok is None
+    memledger.release(tok)              # must not raise
+    assert memledger.entry_count() == 0
+
+
+# --------------------------------- census join + honesty invariant
+def test_census_join_and_honesty_invariant():
+    memledger.enable(sample_s=0.0)
+    A = poisson5pt(16, 16)
+    slv = _amg_solver()
+    slv.setup(amgx.Matrix(A))
+    slv.solve(np.ones(A.shape[0]))
+    snap = memledger.snapshot()
+    # CPU backend exposes no memory_stats(): honest degradation
+    assert snap["measured"] is False
+    assert snap["ledger_version"] == memledger.LEDGER_VERSION
+    assert snap["devices"], "census found no devices"
+    for d in snap["devices"].values():
+        # the invariant is exact arithmetic in BOTH modes (stub mode
+        # defines bytes_in_use as the census total)
+        assert d["accounted_bytes"] + d["unaccounted_bytes"] \
+            == d["bytes_in_use"]
+        assert d["bytes_in_use"] == d["census_bytes"]
+        assert 0 <= d["accounted_bytes"] <= d["bytes_in_use"]
+    owners = snap["owners"]
+    # a live AMG hierarchy attributes under the specific owners, and
+    # the lazily-materialised P/R packs claim under amgx/transfer/…
+    assert any(k.startswith("amgx/hierarchy/level") for k in owners)
+    assert any(k.startswith("amgx/transfer/") for k in owners)
+    assert any(k.startswith("amgx/smoother/") for k in owners)
+    for name, nb in owners.items():
+        assert memledger.validate(name)
+        assert nb >= 0
+    assert snap["n_owned_arrays"] <= snap["n_live_arrays"]
+    slv.release_memledger()
+
+
+def test_top_owners_sorted_descending():
+    snap = {"owners": {"amgx/a/b": 5, "amgx/c/d": 50, "amgx/e/f": 7}}
+    top = memledger.top_owners(snap, n=2)
+    assert top == [("amgx/c/d", 50), ("amgx/e/f", 7)]
+
+
+# ------------------------------------------------ shared-buffer dedupe
+def test_device_tree_bytes_dedupes_shared_buffers():
+    # satellite regression: two sessions (or a precision/placement
+    # view) sharing ONE device pack must cost its bytes once
+    import jax.numpy as jnp
+    a = jnp.ones(1024, jnp.float32)
+    b = jnp.ones(256, jnp.float32)
+    once = device_tree_bytes([a, b])
+    assert device_tree_bytes([a, b, a, {"again": a}]) == once
+    assert device_tree_bytes([[a, a], [a]]) == device_tree_bytes([a])
+
+
+def test_census_counts_shared_pack_once():
+    memledger.enable(sample_s=0.0)
+    import jax.numpy as jnp
+    pack = jnp.arange(4096, dtype=jnp.float32)
+    # one pack registered by two owners (a lane replica + the solve
+    # aggregate): first claim wins, bytes charged exactly once
+    t1 = memledger.register("amgx/hierarchy/level0", pack)
+    t2 = memledger.register("amgx/serve/lane0_x", {"dup": pack})
+    snap = memledger.snapshot()
+    total = sum(snap["owners"].values())
+    assert snap["owners"].get("amgx/hierarchy/level0") == pack.nbytes
+    assert "amgx/serve/lane0_x" not in snap["owners"]
+    assert total == pack.nbytes
+    memledger.release(t1)
+    memledger.release(t2)
+
+
+def test_register_bytes_is_host_side_only():
+    memledger.enable(sample_s=0.0)
+    tok = memledger.register_bytes("amgx/aot/cache", 12345)
+    snap = memledger.snapshot()
+    assert snap["host_owners"].get("amgx/aot/cache") == 12345
+    # host bytes stay OUT of the device invariant
+    for d in snap["devices"].values():
+        assert d["accounted_bytes"] + d["unaccounted_bytes"] \
+            == d["bytes_in_use"]
+    memledger.release(tok)
+
+
+def test_weakref_entries_stop_counting_when_arrays_die():
+    memledger.enable(sample_s=0.0)
+    import jax.numpy as jnp
+    arr = jnp.ones(2048, jnp.float32)
+    tok = memledger.register("amgx/matrix/tmp", arr)
+    assert memledger.snapshot()["owners"].get("amgx/matrix/tmp") \
+        == arr.nbytes
+    del arr
+    snap = memledger.snapshot()
+    assert "amgx/matrix/tmp" not in snap["owners"]
+    memledger.release(tok)
+
+
+# ------------------------------------------------------- event schemas
+def test_hbm_snapshot_event_schema_roundtrip():
+    A = poisson5pt(12, 12)
+    with telemetry.capture() as cap:
+        memledger.enable(sample_s=0.0)
+        slv = _amg_solver()
+        slv.setup(amgx.Matrix(A))
+        slv.solve(np.ones(A.shape[0]))
+        slv.release_memledger()
+    snaps = [r for r in cap.records
+             if r["kind"] == "event" and r["name"] == "hbm_snapshot"]
+    assert snaps, "no hbm_snapshot sampled at the phase boundaries"
+    for r in snaps:
+        validate_record(r)
+
+
+def test_memledger_config_knob_enables_ledger():
+    with telemetry.capture():
+        slv = _amg_solver(", memledger=1, memledger_sample_s=0")
+        assert memledger.is_enabled()
+        A = poisson5pt(10, 10)
+        slv.setup(amgx.Matrix(A))
+        assert memledger.entry_count() > 0
+        slv.release_memledger()
+
+
+# --------------------------------------------------- OOM post-mortems
+@pytest.mark.chaos
+def test_injected_oom_yields_postmortem_with_resident_hierarchy():
+    A = poisson5pt(16, 16)
+    with telemetry.capture() as cap:
+        memledger.enable(sample_s=0.0)
+        resident = _amg_solver()
+        resident.setup(amgx.Matrix(A))       # what the ledger should name
+        faultinject.configure("oom:count=1")
+        victim = _amg_solver()
+        with pytest.raises(Exception):
+            victim.setup(amgx.Matrix(A))
+    pms = [r for r in cap.records
+           if r["kind"] == "event" and r["name"] == "oom_postmortem"]
+    assert len(pms) == 1                     # idempotent per exception
+    validate_record(pms[0])
+    a = pms[0]["attrs"]
+    assert a["where"] == "setup"
+    assert a["injected"] is True
+    assert a["in_recovery"] is False
+    # acceptance: the top owner is the resident hierarchy
+    top_area = a["top_owners"][0][0].split("/")[1]
+    assert top_area in ("hierarchy", "transfer")
+    assert a["suggestions"], "post-mortem carries no eviction advice"
+    assert any(s["knob"] == "hierarchy_dtype" for s in a["suggestions"])
+    resident.release_memledger()
+
+
+def test_is_oom_error_vocabulary():
+    from amgx_tpu.errors import AMGXError, RC
+    assert memledger.is_oom_error(
+        AMGXError("injected device out-of-memory", RC.NO_MEMORY))
+    assert memledger.is_oom_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"))
+    assert not memledger.is_oom_error(ValueError("shape mismatch"))
+
+
+def test_postmortem_emission_is_idempotent_per_exception():
+    with telemetry.capture() as cap:
+        memledger.enable(sample_s=0.0)
+        err = RuntimeError("RESOURCE_EXHAUSTED: oom")
+        assert memledger.emit_postmortem(err, "setup") is not None
+        assert memledger.emit_postmortem(err, "serve") is None
+    pms = [r for r in cap.records
+           if r["kind"] == "event" and r["name"] == "oom_postmortem"]
+    assert len(pms) == 1
+
+
+@pytest.mark.chaos
+def test_recovery_audit_carries_oom_attr():
+    from amgx_tpu.errors import AMGXError, RC
+    from amgx_tpu.solvers.recovery import FailureKind, _audit
+
+    class _Slv:
+        config_name = "solver"
+        telemetry_path = ""
+
+    with telemetry.capture() as cap:
+        oom = AMGXError("injected device out-of-memory", RC.NO_MEMORY)
+        _audit(FailureKind.DEVICE_ERROR, "resetup", 1, "error", _Slv(),
+               0.01, detail=str(oom),
+               oom=memledger.is_oom_error(oom))
+    evs = [r for r in cap.records
+           if r["kind"] == "event" and r["name"] == "recovery_attempt"]
+    assert evs and evs[0]["attrs"].get("oom") is True
+
+
+# -------------------------------------------------- surfacing: gauges
+def test_emit_publishes_owner_gauges_and_clears_stale_series():
+    import jax.numpy as jnp
+    with telemetry.capture() as cap:
+        memledger.enable(sample_s=0.0)
+        arr = jnp.ones(512, jnp.float32)
+        tok = memledger.register("amgx/matrix/gaugecase", arr)
+        memledger.emit(memledger.snapshot())
+        memledger.release(tok)
+        del arr
+        memledger.emit(memledger.snapshot())
+    from amgx_tpu.telemetry import metrics
+    _, gauges, _ = metrics.registry().items()
+    # the released owner must not survive as a stale series
+    stale = [k for k in gauges
+             if k[0] == "amgx_hbm_bytes"
+             and any(lk == "owner" and lv == "amgx/matrix/gaugecase"
+                     for lk, lv in k[1])]
+    assert not stale
+
+
+# ------------------------------------- doctor + chrome-trace surfacing
+def _trace_with_oom(tmpdir: str) -> str:
+    A = poisson5pt(14, 14)
+    telemetry.enable()
+    memledger.enable(sample_s=0.0)
+    resident = _amg_solver()
+    resident.setup(amgx.Matrix(A))
+    faultinject.configure("oom:count=1")
+    victim = _amg_solver()
+    with pytest.raises(Exception):
+        victim.setup(amgx.Matrix(A))
+    faultinject.reset()
+    path = os.path.join(tmpdir, "trace.jsonl")
+    dump_jsonl(path)
+    resident.release_memledger()
+    return path
+
+
+def test_doctor_reports_device_memory_section(tmp_path):
+    path = _trace_with_oom(str(tmp_path))
+    d = doctor.diagnose([path])
+    mem = d.get("memory")
+    assert mem and mem["snapshot"], "doctor lost the ledger snapshot"
+    assert len(mem["oom_postmortems"]) == 1
+    out = doctor.render(d)
+    assert "Device memory (HBM ledger)" in out
+    assert "amgx/hierarchy/" in out
+    assert "OOM in setup (injected)" in out
+    assert any("device OOM in setup" in h for h in d["hints"])
+
+
+def test_doctor_diff_pairs_memory_owners(tmp_path):
+    path = _trace_with_oom(str(tmp_path))
+    d = doctor.diagnose([path])
+    dd = doctor.diff(d, d)
+    mem = dd.get("memory")
+    assert mem and mem["owners"]
+    for v in mem["owners"].values():
+        assert v["a"] == v["b"]          # identical traces: no drift
+    assert not any(h.startswith("HBM owner") for h in dd["drifts"])
+    assert "device memory (A vs B" in doctor.render_diff(dd)
+
+
+def test_chrome_trace_gets_hbm_counter_track():
+    A = poisson5pt(12, 12)
+    with telemetry.capture() as cap:
+        memledger.enable(sample_s=0.0)
+        slv = _amg_solver()
+        slv.setup(amgx.Matrix(A))
+        slv.solve(np.ones(A.shape[0]))
+        slv.release_memledger()
+    doc = tracefile.chrome_trace(cap.records)
+    counters = [e for e in doc["traceEvents"]
+                if e.get("ph") == "C"
+                and str(e.get("name", "")).startswith("hbm ")]
+    assert counters, "no hbm counter track in the chrome trace"
+    for e in counters:
+        assert e["args"]["value"] >= 0
+    tracefile.validate_chrome_trace(doc)
+
+
+# ----------------------------------------------- zero-overhead when off
+def test_ledger_off_changes_no_traces():
+    # acceptance: with the knob off (default) solve traces are
+    # byte-identical — the ledger's presence adds ZERO retraces either
+    # way, counter-asserted on amgx_jit_trace_total
+    A = poisson5pt(12, 12)
+    b = np.ones(A.shape[0])
+
+    def _run(enable_ledger: bool):
+        telemetry.reset()
+        with telemetry.capture() as cap:
+            if enable_ledger:
+                memledger.enable(sample_s=0.0)
+            slv = _amg_solver()
+            slv.setup(amgx.Matrix(A))
+            x = slv.solve(b)
+            slv.release_memledger()
+        return cap.counter_total("amgx_jit_trace_total"), np.asarray(x.x)
+
+    traces_off, x_off = _run(False)
+    traces_on, x_on = _run(True)
+    assert traces_on == traces_off
+    np.testing.assert_array_equal(x_off, x_on)
+
+
+def test_off_entry_points_are_noops():
+    assert not memledger.is_enabled()
+    assert memledger.maybe_sample(phase="setup") is None
+    assert memledger.register("amgx/matrix/x", [np.ones(3)]) is None
+    assert memledger.register_bytes("amgx/aot/cache", 10) is None
+    assert memledger.emit_postmortem(RuntimeError("oom"), "x") is None
+
+
+# ------------------------------------------------- serve-layer ledger
+def test_setup_cache_registers_and_releases_sessions():
+    from amgx_tpu.serve.cache import SetupCache
+    memledger.enable(sample_s=0.0)
+    cache = SetupCache(max_bytes=1 << 30, lane=0)
+    A = poisson5pt(10, 10)
+    m = amgx.Matrix(A)
+    cfg = amgx.AMGConfig(AMG_CFG)
+    session, created = cache.get_or_create(cfg, m)
+    assert created
+    session.prepare(m)
+    session.solve_batch(np.ones((1, A.shape[0])))
+    cache.account(session)
+    # one aggregate entry per resident session (amgx/serve/lane0_…);
+    # hierarchy buffers inside it keep their specific owners
+    assert cache._ml_tokens, "cache.account registered no ledger entry"
+    n = memledger.entry_count()
+    cache.clear()
+    assert memledger.entry_count() < n
