@@ -41,6 +41,16 @@ the grid-stats table:
   truth, honesty invariant ``accounted + unaccounted ≡ bytes_in_use``),
   ``hbm_snapshot`` sampling and ``oom_postmortem`` bundles — gated by
   the ``memledger`` knob;
+* **mesh flight recorder** (PR 20): :mod:`.meshtrace` — clock-aligned
+  cross-rank timelines (per-session offset+drift fit over the paired
+  ``t_perf``/``t_unix`` samples), collective-rendezvous reconstruction
+  (halo hops, fused Krylov reductions, agglomerations matched by
+  (op, group, sequence)), per-rank wait/straggler attribution under
+  the honesty invariant ``compute + wait + unattributed ≡ wall``
+  (schema-enforced ``mesh_health`` events), and silent-rank/desync
+  detection — surfaced as ``amgx_mesh_*`` metrics, the doctor's
+  "Mesh health" section, Chrome-trace rendezvous flow arrows and
+  ``/debug/mesh``;
 * **live serving observability**: :mod:`.slo` (time-windowed
   request-outcome reservoir → attainment / error-budget burn rate /
   overload detection) and :mod:`.httpd` (in-process
@@ -55,8 +65,8 @@ with the ``telemetry=1`` knob (plus ``telemetry_path`` /
 from __future__ import annotations
 
 from . import (costmodel, deviceprof, export, forensics, memledger,
-               metrics, overlap, proftrace, recorder, runstate, scopes,
-               setup_profile, slo, tracefile)
+               meshtrace, metrics, overlap, proftrace, recorder,
+               runstate, scopes, setup_profile, slo, tracefile)
 from .export import (aggregate_sessions, dump_jsonl, flush_jsonl,
                      prometheus_text, read_sessions, validate_jsonl,
                      validate_record)
@@ -80,6 +90,7 @@ __all__ = [
     "costmodel", "forensics", "setup_profile", "runstate",
     "slo", "httpd",
     "proftrace", "scopes", "deviceprof", "overlap", "memledger",
+    "meshtrace",
     "reset",
 ]
 

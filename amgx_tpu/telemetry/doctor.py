@@ -71,6 +71,16 @@ GALERKIN_ERR_HINT = 1e-6
 NULLSPACE_HINT = 0.9
 #: absolute component-factor drift that earns a diff-mode callout
 DIFF_DRIFT = 0.1
+#: share of a rendezvous group's collectives in which ONE rank arrives
+#: last past which the doctor names it a straggler (a balanced mesh
+#: rotates the last arrival; a fixed last rank is a partition problem)
+MESH_STRAGGLER_SHARE = 0.6
+#: rendezvous count below which a group earns no straggler hint (a
+#: handful of collectives can all land on one rank by chance)
+MESH_MIN_COLLECTIVES = 4
+#: fraction of total mesh wait inside fused Krylov reductions past
+#: which the doctor points at compute skew instead of the collective
+MESH_KRYLOV_WAIT_SHARE = 0.5
 
 
 def _label_get(labels: Tuple, key: str):
@@ -814,6 +824,71 @@ def diagnose(paths: List[str]) -> dict:
                 "see the oom_postmortem event for the full ledger "
                 "snapshot and eviction suggestions")
 
+    # ---- mesh flight recorder (PR 20: telemetry/meshtrace.py) -------
+    # cross-rank rendezvous join; single-rank traces stay silent (the
+    # per-rank sections above already cover them)
+    mesh = None
+    if agg["n_sessions"] >= 2:
+        from . import meshtrace
+        m = meshtrace.analyze_sessions(agg["sessions"])
+        if m["n_ranks"] >= 2:
+            mesh = m
+    if mesh and mesh.get("measured"):
+        _mesh_noun = {"halo": "halo exchanges",
+                      "krylov": "Krylov reductions",
+                      "agglomerate": "agglomerations"}
+        for g in (mesh.get("groups") or {}).values():
+            share = g.get("last_share")
+            lr = g.get("last_rank_mode")
+            if g["collectives"] >= MESH_MIN_COLLECTIVES \
+                    and isinstance(share, (int, float)) \
+                    and share >= MESH_STRAGGLER_SHARE \
+                    and g["wait_s"] > 0:
+                ind = (mesh["ranks"].get(lr) or {}).get(
+                    "induced_wait_s") or 0.0
+                hints.append(
+                    f"mesh straggler: rank {lr} arrives last in "
+                    f"{share:.0%} of {g['group']} "
+                    f"{_mesh_noun.get(g['op'], g['op'])} (induced "
+                    f"{ind:.3f}s of peer wait) → partition imbalance "
+                    "— check amgx_dist_boundary_fraction and the "
+                    "per-part row split before tuning the collective")
+        total_wait = mesh.get("total_wait_s") or 0.0
+        kry_wait = (mesh.get("wait_by_op") or {}).get("krylov", 0.0)
+        if total_wait > 0 and kry_wait / total_wait \
+                > MESH_KRYLOV_WAIT_SHARE \
+                and any(rv.get("fused")
+                        for rv in mesh.get("rendezvous") or []):
+            hints.append(
+                f"mesh wait is {kry_wait / total_wait:.0%} fused "
+                "Krylov reductions — the solver is already at one "
+                "collective per iteration, so the reduction itself is "
+                "not the lever: the ranks reach it at different "
+                "times; look at compute skew (arrival spread) and "
+                "rebalance the partition")
+        _miss: Dict = {}
+        for e in mesh.get("desync") or []:
+            if e["kind"] == "silent":
+                hints.append(
+                    f"mesh desync: rank {e['rank']}'s trace goes "
+                    f"silent {e['gap_s']:.3f}s "
+                    f"({e['gap_fraction']:.0%} of the mesh span) "
+                    "before its peers stop — a crashed rank or a "
+                    "stalled flush; check its tail for "
+                    "mesh_truncated_tail / oom_postmortem events")
+            elif e["kind"] == "missing_collectives":
+                _miss.setdefault(e["rank"], []).append(e)
+        for rnk, es in sorted(_miss.items()):
+            e = es[0]
+            more = f" (+{len(es) - 1} more group(s))" if len(es) > 1 \
+                else ""
+            hints.append(
+                f"mesh desync: rank {rnk} ran {e['ran']} "
+                f"{e['group']} {e['op']} collective(s) vs peers' "
+                f"{e['peers_ran']}{more} — divergent control flow or "
+                "an early exit; on real hardware the mesh deadlocks "
+                "at the first collective this rank skips")
+
     return {
         "files": list(paths),
         "sessions": agg["n_sessions"], "records": agg["n_records"],
@@ -840,6 +915,7 @@ def diagnose(paths: List[str]) -> dict:
         "krylov": krylov,
         "device": device_anatomy,
         "memory": memory,
+        "mesh": mesh,
         "serving": serving,
         "serving_lanes": lanes_diag,
         "slo": slo,
@@ -1336,6 +1412,59 @@ def render(d: dict) -> str:
             for s in pm.get("suggestions") or []:
                 L.append(f"    try: {s.get('knob')} — {s.get('hint')}")
 
+    mesh = d.get("mesh")
+    if mesh:
+        L.append("")
+        L.append("Mesh health (cross-rank flight recorder)")
+        L.append("-" * 40)
+        if not mesh.get("measured"):
+            L.append("  measured: NO — "
+                     + ("; ".join(mesh.get("notes") or [])
+                        or "no cross-rank rendezvous reconstructed"))
+        colls = ", ".join(f"{k}: {v}" for k, v
+                          in sorted((mesh.get("collectives")
+                                     or {}).items()))
+        L.append(f"  ranks: {mesh['n_ranks']}   rendezvous: "
+                 f"{len(mesh.get('rendezvous') or [])}"
+                 + (f" ({colls})" if colls else "")
+                 + f"   total wait: "
+                 f"{float(mesh.get('total_wait_s') or 0):.4f} s")
+        ranks = mesh.get("ranks") or {}
+        if ranks:
+            L.append(f"  {'rank':<6}{'compute_s':>11}{'wait_s':>9}"
+                     f"{'straggler':>11}{'last':>6}{'halo':>10}"
+                     f"{'skew_ms':>9}")
+            for rank_id in sorted(ranks, key=lambda k: int(k)):
+                r = ranks[rank_id]
+                L.append(
+                    f"  {str(rank_id):<6}"
+                    f"{float(r['compute_s']):>11.4f}"
+                    f"{float(r['wait_s']):>9.4f}"
+                    f"{float(r['straggler_score']):>11.2f}"
+                    f"{int(r['arrived_last']):>6}"
+                    f"{_fmt_bytes(r['halo_bytes']):>10}"
+                    f"{float(r['clock_skew_s']) * 1e3:>9.3f}")
+        for gkey, g in sorted((mesh.get("groups") or {}).items()):
+            share = g.get("last_share")
+            L.append(
+                f"  {gkey}: {int(g['collectives'])} rendezvous, "
+                f"wait {float(g['wait_s']):.4f} s, mean spread "
+                f"{float(g.get('mean_spread_s') or 0) * 1e3:.3f} ms"
+                + (f", rank {g['last_rank_mode']} last {share:.0%}"
+                   if isinstance(share, (int, float)) else ""))
+        for e in mesh.get("desync") or []:
+            if e["kind"] == "silent":
+                L.append(f"  DESYNC rank {e['rank']}: silent for "
+                         f"{float(e['gap_s']):.3f} s "
+                         f"({float(e['gap_fraction']):.0%} of span)")
+            else:
+                L.append(f"  DESYNC rank {e['rank']}: {e['ran']} vs "
+                         f"{e['peers_ran']} {e['group']} {e['op']} "
+                         "collective(s)")
+        if mesh.get("truncated_tails"):
+            L.append(f"  truncated trailing line(s) skipped: "
+                     f"{mesh['truncated_tails']}")
+
     srv = d.get("serving")
     if srv:
         L.append("")
@@ -1721,6 +1850,29 @@ def diff(da: dict, db: dict) -> dict:
                 word = "grew" if b > a else "shrank"
                 drifts.append(f"HBM owner {o} {word} "
                               f"{_fmt_bytes(a)} → {_fmt_bytes(b)}")
+    # mesh A/B: per-rank wait side by side.  Same both-measured rule
+    # as the anatomy — a single-rank trace has no rendezvous, and
+    # comparing one against a mesh would read as a regression
+    mesh = None
+    ma, mb = da.get("mesh") or {}, db.get("mesh") or {}
+    if ma.get("measured") and mb.get("measured"):
+        ra, rb = ma.get("ranks") or {}, mb.get("ranks") or {}
+        mesh = {
+            "total_wait_s": {"a": ma.get("total_wait_s"),
+                             "b": mb.get("total_wait_s")},
+            "ranks": {r: {"a": (ra.get(r) or {}).get("wait_s"),
+                          "b": (rb.get(r) or {}).get("wait_s")}
+                      for r in sorted(set(ra) | set(rb),
+                                      key=lambda k: int(k))},
+        }
+        for r, v in mesh["ranks"].items():
+            a, b = v["a"], v["b"]
+            if isinstance(a, (int, float)) and isinstance(b, (int, float)) \
+                    and a > 0 and (b / a >= 1.5 or b / a <= 1 / 1.5) \
+                    and max(a, b) >= 0.01:        # ignore sub-10ms noise
+                word = "worsened" if b > a else "improved"
+                drifts.append(f"mesh wait rank {r} {word} "
+                              f"{a * 1e3:.1f} → {b * 1e3:.1f} ms")
     return {"a": da["files"], "b": db["files"],
             "convergence": {k: pair(k) for k in
                             ("iterations", "final_relres", "rate",
@@ -1728,6 +1880,7 @@ def diff(da: dict, db: dict) -> dict:
             "rows": rows, "phases": phases, "levels": levels,
             "device": device,
             "memory": memory,
+            "mesh": mesh,
             "drifts": drifts}
 
 
@@ -1815,6 +1968,16 @@ def render_diff(dd: dict) -> str:
             fa = _fmt_bytes(v["a"]) if v["a"] is not None else "-"
             fb = _fmt_bytes(v["b"]) if v["b"] is not None else "-"
             L.append(f"  peak {dev:<29}{fa:>10} vs {fb:>10}")
+    if dd.get("mesh"):
+        L.append("")
+        L.append("mesh wait (A vs B, seconds per rank)")
+        L.append("-" * 40)
+        t = dd["mesh"]["total_wait_s"]
+        L.append(f"  {'total':<10}{_fmt_num(t['a'], '.4f'):>10} vs "
+                 f"{_fmt_num(t['b'], '.4f'):>10}")
+        for r, v in dd["mesh"]["ranks"].items():
+            L.append(f"  rank {str(r):<5}{_fmt_num(v['a'], '.4f'):>10}"
+                     f" vs {_fmt_num(v['b'], '.4f'):>10}")
     L.append("")
     if dd["drifts"]:
         L.append("drifts")

@@ -39,6 +39,12 @@ _flush_lock = threading.Lock()
 _flushed_seq = {}
 #: per-path ring-overflow count at the last flush (drop detection)
 _flushed_dropped = {}
+#: per-path perf_counter of the last clock (re-)sample; the meta header
+#: pairs the clocks once at session start, which bakes any later drift
+#: into the whole trace — flush_jsonl re-pairs them at most every
+#: CLOCK_RESAMPLE_S so meshtrace can fit a per-session offset+slope
+_clock_sampled = {}
+CLOCK_RESAMPLE_S = 60.0
 
 #: one id per writing process — the session identity the aggregator and
 #: the Chrome-trace exporter key on (a telemetry_path appended by every
@@ -315,6 +321,75 @@ def validate_record(rec: dict):
                  "krylov_comm event missing fused bool")
             need(isinstance(a.get("n_parts"), int) and a["n_parts"] >= 1,
                  "krylov_comm event missing n_parts")
+        if rec["name"] == "clock_sample":
+            # rate-limited clock re-pairing (flush_jsonl): the input of
+            # meshtrace's per-session offset+slope fit — a sample
+            # missing either clock would silently skew the whole mesh
+            # timeline
+            a = rec["attrs"]
+            for k in ("t_perf", "t_unix"):
+                need(isinstance(a.get(k), (int, float))
+                     and not isinstance(a.get(k), bool),
+                     f"clock_sample event missing numeric {k}")
+        if rec["name"] == "mesh_truncated_tail":
+            # a rank killed mid-write left a partial trailing line;
+            # read_sessions skips it and says so IN the trace
+            a = rec["attrs"]
+            need(isinstance(a.get("line"), int) and a["line"] >= 1,
+                 "mesh_truncated_tail event missing line number")
+            need(isinstance(a.get("bytes"), int) and a["bytes"] >= 0,
+                 "mesh_truncated_tail event missing byte count")
+        if rec["name"] == "mesh_rendezvous":
+            # one reconstructed cross-rank collective (meshtrace.py):
+            # arrival spread + induced wait, per (op, group, sequence)
+            a = rec["attrs"]
+            need(a.get("op") in ("halo", "krylov", "agglomerate"),
+                 f"mesh_rendezvous event has unknown op {a.get('op')!r}")
+            need(isinstance(a.get("group"), str) and a["group"],
+                 "mesh_rendezvous event missing group")
+            need(isinstance(a.get("seq"), int) and a["seq"] >= 0,
+                 "mesh_rendezvous event missing seq")
+            need(isinstance(a.get("n_ranks"), int) and a["n_ranks"] >= 2,
+                 "mesh_rendezvous event has fewer than 2 ranks")
+            need(isinstance(a.get("last_rank"), int)
+                 and a["last_rank"] >= 0,
+                 "mesh_rendezvous event missing last_rank")
+            for k in ("spread_s", "wait_total_s"):
+                need(isinstance(a.get(k), (int, float))
+                     and not isinstance(a.get(k), bool) and a[k] >= 0,
+                     f"mesh_rendezvous event missing numeric {k}")
+            need(isinstance(a.get("measured"), bool),
+                 "mesh_rendezvous event missing measured bool")
+        if rec["name"] == "mesh_health":
+            # per-rank mesh accounting (meshtrace.py): the honesty
+            # invariant compute + wait + unattributed ≡ wall is
+            # enforced HERE, so a trace can never carry wait the rank
+            # did not observably spend
+            a = rec["attrs"]
+            need(isinstance(a.get("measured"), bool),
+                 "mesh_health event missing measured bool")
+            need(isinstance(a.get("mesh_version"), int)
+                 and a["mesh_version"] >= 1,
+                 "mesh_health event missing mesh_version")
+            need(isinstance(a.get("rank"), int) and a["rank"] >= 0,
+                 "mesh_health event missing rank")
+            for k in ("wall_s", "compute_s", "wait_s",
+                      "unattributed_s"):
+                need(isinstance(a.get(k), (int, float))
+                     and not isinstance(a.get(k), bool) and a[k] >= 0,
+                     f"mesh_health event missing numeric {k}")
+            need(abs(a["compute_s"] + a["wait_s"] + a["unattributed_s"]
+                     - a["wall_s"])
+                 <= 1e-6 * max(1.0, abs(a["wall_s"])),
+                 "mesh_health event violates the honesty invariant "
+                 "compute + wait + unattributed == wall")
+            need(isinstance(a.get("straggler_score"), (int, float))
+                 and not isinstance(a.get("straggler_score"), bool)
+                 and 0.0 <= a["straggler_score"] <= 1.0,
+                 "mesh_health event missing straggler_score in [0,1]")
+            for k in ("arrived_last", "collectives", "halo_bytes"):
+                need(isinstance(a.get(k), int) and a[k] >= 0,
+                     f"mesh_health event missing integer {k}")
         if rec["name"] == "fault_injected":
             # chaos-run provenance: every synthetic failure in a trace
             # must name its injection point
@@ -512,6 +587,18 @@ def flush_jsonl(path: str) -> int:
                            dropped_total=dropped,
                            ring_size=recorder._STATE.ring_size)
         _flushed_dropped[path] = dropped
+        # rate-limited clock re-pairing: the meta header samples
+        # (t_perf, t_unix) once at session start, which bakes clock
+        # drift into long traces — re-sample at most every
+        # CLOCK_RESAMPLE_S so meshtrace can fit offset+slope per
+        # session instead of a single offset
+        now = time.perf_counter()
+        if first_flush:
+            _clock_sampled[path] = now      # the meta IS the first pair
+        elif now - _clock_sampled.get(path, 0.0) >= CLOCK_RESAMPLE_S:
+            _clock_sampled[path] = now
+            recorder.event("clock_sample", t_perf=now,
+                           t_unix=time.time())
         recs = [r for r in recorder.records() if r["seq"] > last]
         if first_flush or recs:
             with open(path, "a") as f:
@@ -540,12 +627,30 @@ def read_sessions(source: Union[str, Iterable[str]]) -> List[dict]:
     session — ``{"meta": <meta record>, "records": [...]}`` — split at
     the meta headers (each appending process restates one; PR 2's
     validator contract).  The lines are validated on the way in, so a
-    drifted trace fails loudly here rather than mis-merging."""
+    drifted trace fails loudly here rather than mis-merging.
+
+    One tolerated defect: a TRAILING line that is not parseable JSON —
+    a rank killed mid-write leaves exactly that, and crash postmortems
+    are the mesh flight recorder's whole point — is skipped with a
+    synthetic ``mesh_truncated_tail`` warning event appended to the
+    last session instead of raising.  A malformed line anywhere else
+    is still schema drift and still raises."""
     if isinstance(source, str):
         with open(source) as f:
             lines = f.readlines()
     else:
         lines = list(source)
+    truncated = None
+    for i in range(len(lines) - 1, -1, -1):
+        line = lines[i].strip()
+        if not line:
+            continue
+        try:
+            json.loads(line)
+        except ValueError:
+            truncated = {"line": i + 1, "bytes": len(lines[i])}
+            lines = lines[:i]
+        break
     sessions: List[dict] = []
     for rec in _iter_validated(lines):
         if rec["kind"] == "meta":
@@ -554,6 +659,15 @@ def read_sessions(source: Union[str, Iterable[str]]) -> List[dict]:
             if "value" in rec:
                 rec["value"] = _restore_nonfinite(rec["value"])
             sessions[-1]["records"].append(rec)
+    if truncated is not None and sessions:
+        last = sessions[-1]["records"]
+        rec = {"kind": "event", "name": "mesh_truncated_tail",
+               "seq": (last[-1]["seq"] + 1 if last else 1),
+               "t": (last[-1]["t"] if last
+                     else sessions[-1]["meta"].get("t_perf", 0.0)),
+               "tid": 0, "sid": None, "attrs": truncated}
+        validate_record(rec)    # the synthetic warning obeys the schema
+        last.append(rec)
     return sessions
 
 

@@ -37,6 +37,11 @@ Routes:
 * ``GET /debug/memory`` — the HBM ledger's live view
   (:mod:`amgx_tpu.telemetry.memledger`): a fresh ownership snapshot,
   top owners and the recent headroom history.
+* ``GET /debug/mesh`` — the mesh flight recorder's view of the
+  current telemetry ring (:mod:`amgx_tpu.telemetry.meshtrace`):
+  clock-aligned rendezvous join, per-rank wait/straggler table and
+  desync detection; an honest ``measured=false`` stub on a
+  single-rank process.
 
 Handlers never touch solver internals beyond the read-only stats
 surface, so a scrape cannot perturb a solve beyond the GIL.
@@ -100,6 +105,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "/debug/profile": self._debug_profile,
                 "/debug/deviceprof": self._debug_deviceprof,
                 "/debug/memory": self._debug_memory,
+                "/debug/mesh": self._debug_mesh,
             }.get(url.path)
             if route is None:
                 self._json(404, {"error": f"no route {url.path}",
@@ -107,7 +113,8 @@ class _Handler(BaseHTTPRequestHandler):
                                             "/statusz", "/debug/trace",
                                             "/debug/profile",
                                             "/debug/deviceprof",
-                                            "/debug/memory"]})
+                                            "/debug/memory",
+                                            "/debug/mesh"]})
                 return
             route(q)
         except BrokenPipeError:
@@ -196,6 +203,26 @@ class _Handler(BaseHTTPRequestHandler):
             "top_owners": memledger.top_owners(snap),
             "headroom_history": memledger.headroom_history(),
         })
+
+    def _debug_mesh(self, q):
+        # the mesh flight recorder is a trace-file consumer like the
+        # doctor — hand it a ring snapshot through a temp file so the
+        # live view and the offline one can never drift apart.  A
+        # single-process ring is one rank: the reply is then the
+        # honest measured=false stub, not an error
+        from . import meshtrace
+        from .export import dump_jsonl
+        fd, path = tempfile.mkstemp(suffix=".jsonl",
+                                    prefix="amgx_mesh_")
+        os.close(fd)
+        try:
+            dump_jsonl(path)
+            self._json(200, meshtrace.analyze(path))
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def _capture_profile(self, q) -> dict:
         """One-shot profiler capture + parsed summaries.  Returns the

@@ -96,6 +96,22 @@ METRICS: Dict[str, Tuple[str, str]] = {
         ("counter", "reduction collectives executed by Krylov solve "
                     "loops: trace-time per-iteration profile x executed "
                     "iterations {op=dot|norm|gram|fused|replace}"),
+    # ---- mesh flight recorder (telemetry/meshtrace.py; PR 20):
+    # cross-rank rendezvous reconstruction over clock-aligned
+    # per-rank traces ------------------------------------------------
+    "amgx_mesh_wait_seconds_total":
+        ("counter", "per-rank wall seconds spent waiting for the last "
+                    "arrival at reconstructed collective rendezvous "
+                    "(halo exchanges, fused Krylov reductions, "
+                    "agglomerations) {rank}"),
+    "amgx_mesh_straggler_score":
+        ("gauge", "share of mesh-wide induced wait caused by one rank "
+                  "arriving last at collectives (0 = never last, "
+                  "1 = every second of wait) {rank}"),
+    "amgx_mesh_clock_skew_seconds":
+        ("gauge", "fitted wall-clock offset of one rank's trace "
+                  "relative to rank 0 (per-session offset+slope fit "
+                  "over meta + clock_sample pairs) {rank}"),
     # ---- convergence forensics (telemetry/forensics.py) ------------
     "amgx_forensics_nullspace":
         ("gauge", "near-nullspace preservation |A*1|inf/|A|inf of one "

@@ -35,8 +35,10 @@ from . import recorder
 from .export import _sanitize, read_sessions
 
 #: trace-event phases this exporter emits (telemetry_check validates);
-#: "b"/"e" are the async request-lifecycle slices
-PHASES = ("X", "i", "C", "M", "b", "e")
+#: "b"/"e" are the async request-lifecycle slices, "s"/"f" the
+#: rendezvous flow arrows of a multi-rank mesh trace (early arrival →
+#: last arrival of one reconstructed collective)
+PHASES = ("X", "i", "C", "M", "b", "e", "s", "f")
 
 
 def _args(d: dict) -> dict:
@@ -197,6 +199,8 @@ def chrome_trace(source: Union[None, str, List[str], List[dict]] = None
             t0s.append(m["t_unix"] - m["t_perf"])
     base = min(t0s) if t0s else None
     events: List[dict] = []
+    offsets: List[float] = []
+    pids: List[int] = []
     for i, s in enumerate(sessions):
         m = s["meta"] or {}
         pid = int(m.get("pid", i + 1))
@@ -210,8 +214,43 @@ def chrome_trace(source: Union[None, str, List[str], List[dict]] = None
         else:
             ts_all = [r["t"] for r in s["records"]]
             offset = -min(ts_all) if ts_all else 0.0
+        offsets.append(offset)
+        pids.append(pid)
         events.extend(_session_events(s["records"], pid, offset, label))
+    events.extend(_rendezvous_flows(sessions, pids, offsets))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _rendezvous_flows(sessions: List[dict], pids: List[int],
+                      offsets: List[float]) -> List[dict]:
+    """Flow arrows of a multi-rank trace: for every reconstructed
+    collective rendezvous (meshtrace join), one ``s`` → ``f`` arrow
+    from each early rank's arrival to the last rank's — Perfetto draws
+    who the mesh waited on.  Empty on single-session traces."""
+    if len(sessions) < 2:
+        return []
+    from . import meshtrace
+    out: List[dict] = []
+    for n, rv in enumerate(meshtrace.rendezvous_from_sessions(sessions)):
+        arr = sorted(rv["arrivals"],
+                     key=lambda a: a["t"] + offsets[a["session"]])
+        last = arr[-1]
+        t_last = max((last["t"] + offsets[last["session"]]) * 1e6, 0.0)
+        name = f"rendezvous:{rv['op']}:{rv['group']}"
+        for a in arr[:-1]:
+            fid = f"rv{n}-r{a['rank']}"
+            out.append({
+                "ph": "s", "cat": "rendezvous", "id": fid,
+                "name": name, "pid": pids[a["session"]],
+                "tid": a["tid"],
+                "ts": max((a["t"] + offsets[a["session"]]) * 1e6, 0.0),
+            })
+            out.append({
+                "ph": "f", "cat": "rendezvous", "id": fid, "bp": "e",
+                "name": name, "pid": pids[last["session"]],
+                "tid": last["tid"], "ts": t_last,
+            })
+    return out
 
 
 def write_chrome_trace(path_or_file: Union[str, IO],
@@ -255,13 +294,18 @@ def validate_chrome_trace(trace: dict) -> int:
         if e["ph"] == "X":
             need(isinstance(e.get("dur"), (int, float))
                  and e["dur"] >= 0, f"bad dur: {e!r}")
-        if e["ph"] in ("b", "e"):
-            # async pairs match on (cat, id) — either missing breaks
-            # the request slice silently in Perfetto
+        if e["ph"] in ("b", "e", "s", "f"):
+            # async pairs and flow arrows match on (cat, id) — either
+            # missing breaks the slice/arrow silently in Perfetto
             need(isinstance(e.get("id"), str) and e["id"],
-                 f"async event missing id: {e!r}")
+                 f"async/flow event missing id: {e!r}")
             need(isinstance(e.get("cat"), str) and e["cat"],
-                 f"async event missing cat: {e!r}")
+                 f"async/flow event missing cat: {e!r}")
+        if e["ph"] == "f":
+            # binding point "e" attaches the arrow head to the
+            # ENCLOSING slice at ts — without it Perfetto binds to the
+            # next slice and the arrow points at the wrong span
+            need(e.get("bp") == "e", f"flow finish missing bp: {e!r}")
         if "args" in e:
             need(isinstance(e["args"], dict), f"bad args: {e!r}")
     # the whole thing must be strict JSON (Perfetto rejects bare NaN)

@@ -89,6 +89,13 @@ CASES = (
     # contract) — pre-PR-16 rounds lack the A/B block and render "-"
     ("coll/iter", _x(("extras", "distributed", "krylov_ab_8",
                       "coll_per_iter_ca"))),
+    # mesh flight recorder (ISSUE 20): the largest per-rank wait share
+    # of the 8-part virtual-mesh solve (wait_s / wall_s of the worst
+    # rank — how much of a rank's wall the mesh join attributes to
+    # waiting on peers).  Pre-PR-20 rounds lack the block and render
+    # "-"; so do rounds whose mesh block errored
+    ("wait%", lambda d: _pct(_x(
+        ("extras", "distributed", "mesh", "max_wait_share"))(d))),
     # breakdown recovery (ISSUE 13, AMGX_BENCH_CHAOS=1 rounds): the
     # recovered-solve overhead of one injected NaN-poison fault vs the
     # clean headline solve; non-chaos rounds render "-"

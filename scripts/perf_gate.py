@@ -235,6 +235,16 @@ def load_round(path: str) -> dict:
         if probs:
             raise ValueError(f"{path}: memory block violates its "
                              f"schema: {'; '.join(probs)}")
+    # mesh flight recorder (ISSUE 20): shape-only, NEVER a baseline and
+    # never ratcheted — wall-clock skew between virtual ranks varies by
+    # host load, so any pinned wait number would be noise.  A PRESENT
+    # block must keep its schema shape
+    ms = ds.get("mesh") if isinstance(ds, dict) else None
+    if isinstance(ms, dict) and "error" not in ms:
+        probs = mesh_problems(ms)
+        if probs:
+            raise ValueError(f"{path}: mesh block violates its "
+                             f"schema: {'; '.join(probs)}")
     return cases
 
 
@@ -299,6 +309,51 @@ def memory_problems(mm: dict) -> list:
                     and not isinstance(p[1], bool)
                     and isinstance(p[1], int) and p[1] >= 0):
                 probs.append(f"malformed top_owners pair: {p!r:.80}")
+                break
+    return probs
+
+
+def mesh_problems(ms: dict) -> list:
+    """Structural problems of a round's ``distributed.mesh`` extras
+    block (empty list when sound).  Mirrors the mesh_health event
+    schema without importing the package: ``measured``/``virtual``
+    provenance bools, an int rank count, wait shares as a str->number
+    dict in [0, 1], and straggler rows as [rank, score] pairs.  The
+    VALUES are deliberately unchecked against any baseline — see the
+    never-ratcheted note at the call site."""
+    probs = []
+    for k in ("measured", "virtual"):
+        if not isinstance(ms.get(k), bool):
+            probs.append(f"{k} is not a bool")
+    nr = ms.get("n_ranks")
+    if isinstance(nr, bool) or not isinstance(nr, int) or nr < 1:
+        probs.append("n_ranks is not a positive int")
+    tw = ms.get("total_wait_s")
+    if isinstance(tw, bool) or not isinstance(tw, (int, float)) \
+            or tw < 0:
+        probs.append("total_wait_s is not a non-negative number")
+    wsh = ms.get("wait_share")
+    if not isinstance(wsh, dict):
+        probs.append("wait_share is not a dict")
+    else:
+        for r, v in wsh.items():
+            if not isinstance(r, str) or isinstance(v, bool) \
+                    or not isinstance(v, (int, float)) \
+                    or not 0.0 <= v <= 1.0:
+                probs.append(f"malformed wait_share entry: "
+                             f"{r!r}: {v!r}")
+                break
+    st = ms.get("straggler")
+    if not isinstance(st, list):
+        probs.append("straggler is not a list")
+    else:
+        for p in st:
+            if not (isinstance(p, list) and len(p) == 2
+                    and isinstance(p[0], int)
+                    and not isinstance(p[1], bool)
+                    and isinstance(p[1], (int, float))
+                    and 0.0 <= p[1] <= 1.0):
+                probs.append(f"malformed straggler pair: {p!r:.80}")
                 break
     return probs
 

@@ -1230,6 +1230,118 @@ def main():
     memledger.disable()
     telemetry.reset()
 
+    # 20. mesh flight recorder (ISSUE 20): (a) the section-15
+    # distributed child trace, mirrored into a second rank identity
+    # (the house single-process SPMD pattern), joins into a measured
+    # 2-rank mesh whose emitted mesh_health / mesh_rendezvous records
+    # pass the schema — including the compute + wait + unattributed
+    # ≡ wall honesty invariant — and the doctor renders the "Mesh
+    # health" section; (b) the straggler hint BOTH WAYS on synthetic
+    # 3-rank traces (an injected-skew mesh fires it, the balanced
+    # mesh stays silent)
+    from amgx_tpu.telemetry import meshtrace
+
+    path_mesh = path + ".mesh"
+    path_me = path + ".mesh_emit"
+    path_mskew = path + ".mesh_skew"
+    path_mbal = path + ".mesh_bal"
+    for p in (path_mesh, path_me, path_mskew, path_mbal):
+        if os.path.exists(p):
+            os.unlink(p)
+    meta2 = json.loads(lines_dd[0])
+    meta2["pid"] += 1
+    meta2["session"] = "c0ffee000002"
+    with open(path_mesh, "w") as f:
+        f.writelines(lines_dd)
+        f.write(json.dumps(meta2) + "\n")
+        f.writelines(lines_dd[1:])
+    mesh = meshtrace.analyze(path_mesh)
+    if not mesh["measured"] or mesh["n_ranks"] != 2:
+        fail(f"mirrored distributed trace did not join into a measured "
+             f"2-rank mesh (measured={mesh['measured']} "
+             f"n_ranks={mesh['n_ranks']})")
+    if mesh["collectives"].get("halo", 0) <= 0:
+        fail("mesh join reconstructed no halo rendezvous from the "
+             "distributed child's dist_spmv spans")
+    telemetry.enable(ring_size=16384)
+    meshtrace.emit(mesh)
+    telemetry.dump_jsonl(path_me)
+    telemetry.disable()
+    with open(path_me) as f:
+        lines_me = f.readlines()
+    try:
+        telemetry.validate_jsonl(lines_me)
+    except (ValueError, json.JSONDecodeError) as e:
+        fail(f"emitted mesh records failed schema validation: {e}")
+    recs_me = [json.loads(l) for l in lines_me if l.strip()]
+    mh = [r for r in recs_me if r["kind"] == "event"
+          and r["name"] == "mesh_health"]
+    if len(mh) != 2:
+        fail(f"expected 2 mesh_health events (one per rank), got "
+             f"{len(mh)}")
+    for r in mh:
+        a = r["attrs"]
+        if abs(a["compute_s"] + a["wait_s"] + a["unattributed_s"]
+               - a["wall_s"]) > 1e-6 * max(1.0, abs(a["wall_s"])):
+            fail(f"mesh_health honesty invariant violated: {a}")
+    if not any(r["kind"] == "event" and r["name"] == "mesh_rendezvous"
+               for r in recs_me):
+        fail("meshtrace.emit wrote no mesh_rendezvous records")
+    diag_mesh = doctor.diagnose([path_mesh])
+    if not diag_mesh.get("mesh"):
+        fail("doctor diagnose has no mesh analysis for a 2-rank trace")
+    if "Mesh health" not in doctor.render(diag_mesh):
+        fail("doctor report is missing the Mesh health section")
+
+    # (b) the straggler hint, both ways, on synthetic 3-rank meshes —
+    # each rank on its own perf epoch (the offsets the clock fit must
+    # undo); rank 702 begins every hop `late_s` after its peers
+    def _mesh_rank(pid, session, offset, late_s=0.0, span_dur=0.1):
+        meta = {"kind": "meta", "name": "amgx-telemetry",
+                "schema": telemetry.SCHEMA_VERSION, "pid": pid,
+                "session": session, "host": "checkhost",
+                "t_perf": 0.0 - offset, "t_unix": 0.0, "dropped": 0}
+        out = [json.dumps(meta)]
+        recs = [{"kind": "span_begin", "name": "solve",
+                 "t": 0.0 - offset, "tid": 1, "sid": 1,
+                 "parent": None, "attrs": {}}]
+        for k in range(6):
+            t0 = 0.2 + 0.25 * k + late_s
+            recs.append({"kind": "span_begin", "name": "exchange_halo",
+                         "t": t0 - offset, "tid": 1, "sid": 10 + k,
+                         "parent": 1, "attrs": {"ring": 1}})
+            recs.append({"kind": "span_end", "name": "exchange_halo",
+                         "t": t0 + span_dur - offset, "tid": 1,
+                         "sid": 10 + k, "dur": span_dur})
+        recs.append({"kind": "span_end", "name": "solve",
+                     "t": 2.0 - offset, "tid": 1, "sid": 1, "dur": 2.0})
+        for i, rr in enumerate(recs):
+            rr["seq"] = i + 1
+            out.append(json.dumps(rr))
+        return out
+
+    def _mesh_fixture(dst, late_s):
+        with open(dst, "w") as f:
+            for pid, sess, off, late in (
+                    (700, "beef00000000", 100.0, 0.0),
+                    (701, "beef00000001", 900.0, 0.0),
+                    (702, "beef00000002", 400.0, late_s)):
+                sd = 0.02 if late else 0.1
+                f.write("\n".join(
+                    _mesh_rank(pid, sess, off, late, sd)) + "\n")
+
+    _mesh_fixture(path_mskew, 0.05)
+    diag_ms = doctor.diagnose([path_mskew])
+    if not any("mesh straggler" in h for h in diag_ms.get("hints", ())):
+        fail(f"straggler hint did not fire on the injected-skew mesh: "
+             f"{diag_ms.get('hints')}")
+    _mesh_fixture(path_mbal, 0.0)
+    diag_mb = doctor.diagnose([path_mbal])
+    if any("mesh straggler" in h for h in diag_mb.get("hints", ())):
+        fail(f"straggler hint fired on a balanced mesh: "
+             f"{diag_mb.get('hints')}")
+    telemetry.reset()
+
     print(f"telemetry_check: OK — {n_rec} records validated "
           f"({res.iterations} iterations, "
           f"{len(names_by_kind.get('span_end', ()))} span names, "
@@ -1237,7 +1349,7 @@ def main():
           f"setup-profile OK, coverage {cov:.0%}, device-setup OK, "
           f"serving-obs OK, mixed-precision OK, serving-lanes OK, "
           f"distributed OK, failures-recovery OK, krylov-comm OK, "
-          f"device-anatomy OK, memledger OK)")
+          f"device-anatomy OK, memledger OK, mesh OK)")
     if not keep:
         os.unlink(path)
         os.unlink(path_f)
@@ -1259,6 +1371,10 @@ def main():
         os.unlink(path_mem)
         os.unlink(path_oom)
         os.unlink(path_nc)
+        os.unlink(path_mesh)
+        os.unlink(path_me)
+        os.unlink(path_mskew)
+        os.unlink(path_mbal)
 
 
 def dist_child(trace_path: str) -> int:

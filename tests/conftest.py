@@ -84,6 +84,10 @@ def pytest_configure(config):
         "markers", "memledger: HBM-ledger / device-memory attribution "
                    "fast tests (tier-1; pytest -m memledger selects "
                    "just these)")
+    config.addinivalue_line(
+        "markers", "meshtrace: mesh flight-recorder / cross-rank "
+                   "rendezvous fast tests (tier-1; pytest -m "
+                   "meshtrace selects just these)")
     if not _tpu_tier(config):
         # The axon TPU plugin ignores JAX_PLATFORMS env; the config knob
         # works.
